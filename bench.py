@@ -1,0 +1,112 @@
+"""Benchmark: GPT pretraining throughput + MFU on one TPU chip.
+
+North star (BASELINE.json): tokens/sec/chip + MFU on GPT. The whole train
+step (fwd + bwd + AdamW) is one XLA executable via jit.TrainStep; bf16
+compute with fp32 master weights (multi_precision), activation recompute,
+Pallas flash attention.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
+vs_baseline = MFU / 0.45 (the driver's v5p-128 target ratio).
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip():
+    """bf16 peak FLOP/s of the local accelerator."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    # TPU v5 lite (v5e): 197 TFLOP/s bf16; v5p: 459; v4: 275; v3: 123
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v3" in kind:
+        return 123e12
+    return 197e12  # default to v5e
+
+
+def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16"):
+    import paddle_tpu as paddle
+    from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
+                                   GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    cfg = GPTConfig.preset(preset, seq_len=seq_len, dtype=dtype,
+                           dropout=0.0, use_recompute=True)
+    model = GPTForPretraining(GPTModel(cfg))
+    crit = GPTPretrainingCriterion()
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
+                                 parameters=model.parameters())
+
+    def step_fn(tokens, labels):
+        loss = crit(model(tokens), labels)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train = paddle.jit.TrainStep(step_fn, model, opt)
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (batch, seq_len)).astype(np.int64)
+    labels = np.roll(toks, -1, axis=1)
+    tokens_t = paddle.to_tensor(toks)
+    labels_t = paddle.to_tensor(labels)
+
+    for _ in range(warmup):
+        loss = train(tokens_t, labels_t)
+    float(loss)  # sync
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = train(tokens_t, labels_t)
+    final = float(loss)  # sync
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens_per_step = batch * seq_len
+    tps = tokens_per_step / dt
+    flops = cfg.flops_per_token() * tokens_per_step
+    mfu = flops / dt / peak_flops_per_chip()
+    return tps, mfu, final, cfg
+
+
+def main():
+    configs = [
+        ("gpt2-medium", 8, 1024),
+        ("gpt2-small", 8, 1024),
+        ("gpt2-tiny", 8, 128),
+    ]
+    last_err = None
+    for preset, batch, seq in configs:
+        try:
+            tps, mfu, loss, cfg = run(preset, batch, seq)
+            print(json.dumps({
+                "metric": f"GPT({preset}) train tokens/sec/chip "
+                          f"(bf16, seq{seq}, bs{batch})",
+                "value": round(tps, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(mfu / 0.45, 4),
+                "mfu": round(mfu, 4),
+                "loss": round(loss, 4),
+            }))
+            return 0
+        except Exception as e:  # noqa: BLE001 — fall back to smaller config
+            last_err = e
+            continue
+    print(json.dumps({"metric": "GPT train tokens/sec/chip", "value": 0,
+                      "unit": "tokens/s/chip", "vs_baseline": 0,
+                      "error": str(last_err)[:300]}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,298 @@
+// TCPStore — native rendezvous key-value store.
+//
+// TPU-native equivalent of the reference's TCPStore
+// (/root/reference/paddle/phi/core/distributed/store/tcp_store.h:120,
+//  socket.h) used for multi-host bootstrap: ranks publish/await keys
+// (coordinator address, per-host device counts, barrier counters) before
+// jax.distributed / the launcher brings up the ICI/DCN world.
+//
+// Single-threaded poll() server + blocking client, C ABI for ctypes.
+// Protocol per request:
+//   u8 op | u32 klen | key bytes | u64 vlen | value bytes
+// ops: 1=SET 2=GET 3=ADD(i64 delta) 4=CHECK 5=DELETE 6=NUMKEYS
+// response: u8 status(0 ok,1 missing) | u64 vlen | value bytes
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Server {
+  int listen_fd = -1;
+  std::atomic<bool> running{false};
+  std::thread thread;
+  std::mutex mu;
+  std::map<std::string, std::vector<uint8_t>> data;
+  int port = 0;
+};
+
+bool read_full(int fd, void* buf, size_t n) {
+  auto* p = static_cast<uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_full(int fd, const void* buf, size_t n) {
+  const auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t r = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+void handle_request(Server* s, int fd) {
+  uint8_t op;
+  uint32_t klen;
+  uint64_t vlen;
+  if (!read_full(fd, &op, 1) || !read_full(fd, &klen, 4)) return;
+  std::string key(klen, '\0');
+  if (klen && !read_full(fd, key.data(), klen)) return;
+  if (!read_full(fd, &vlen, 8)) return;
+  std::vector<uint8_t> val(vlen);
+  if (vlen && !read_full(fd, val.data(), vlen)) return;
+
+  uint8_t status = 0;
+  std::vector<uint8_t> resp;
+  {
+    std::lock_guard<std::mutex> lk(s->mu);
+    switch (op) {
+      case 1:  // SET
+        s->data[key] = val;
+        break;
+      case 2: {  // GET
+        auto it = s->data.find(key);
+        if (it == s->data.end()) {
+          status = 1;
+        } else {
+          resp = it->second;
+        }
+        break;
+      }
+      case 3: {  // ADD
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        auto it = s->data.find(key);
+        if (it != s->data.end() && it->second.size() == 8) {
+          std::memcpy(&cur, it->second.data(), 8);
+        }
+        cur += delta;
+        std::vector<uint8_t> nv(8);
+        std::memcpy(nv.data(), &cur, 8);
+        s->data[key] = nv;
+        resp = nv;
+        break;
+      }
+      case 4: {  // CHECK
+        status = s->data.count(key) ? 0 : 1;
+        break;
+      }
+      case 5:  // DELETE
+        status = s->data.erase(key) ? 0 : 1;
+        break;
+      case 6: {  // NUMKEYS
+        int64_t n = static_cast<int64_t>(s->data.size());
+        resp.resize(8);
+        std::memcpy(resp.data(), &n, 8);
+        break;
+      }
+      default:
+        status = 1;
+    }
+  }
+  uint64_t rlen = resp.size();
+  write_full(fd, &status, 1);
+  write_full(fd, &rlen, 8);
+  if (rlen) write_full(fd, resp.data(), rlen);
+}
+
+void server_loop(Server* s) {
+  std::vector<pollfd> fds;
+  fds.push_back({s->listen_fd, POLLIN, 0});
+  while (s->running.load()) {
+    int n = ::poll(fds.data(), fds.size(), 200);
+    if (n <= 0) continue;
+    if (fds[0].revents & POLLIN) {
+      int c = ::accept(s->listen_fd, nullptr, nullptr);
+      if (c >= 0) {
+        int one = 1;
+        ::setsockopt(c, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        fds.push_back({c, POLLIN, 0});
+      }
+    }
+    for (size_t i = 1; i < fds.size();) {
+      if (fds[i].revents & (POLLIN | POLLHUP | POLLERR)) {
+        uint8_t peek;
+        ssize_t r = ::recv(fds[i].fd, &peek, 1, MSG_PEEK);
+        if (r <= 0) {
+          ::close(fds[i].fd);
+          fds.erase(fds.begin() + i);
+          continue;
+        }
+        handle_request(s, fds[i].fd);
+      }
+      ++i;
+    }
+  }
+  for (auto& p : fds) ::close(p.fd);
+}
+
+struct Client {
+  int fd = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tcpstore_server_start(int port) {
+  auto* s = new Server();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 64) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->running.store(true);
+  s->thread = std::thread(server_loop, s);
+  return s;
+}
+
+int tcpstore_server_port(void* handle) {
+  return handle ? static_cast<Server*>(handle)->port : -1;
+}
+
+void tcpstore_server_stop(void* handle) {
+  if (!handle) return;
+  auto* s = static_cast<Server*>(handle);
+  s->running.store(false);
+  if (s->thread.joinable()) s->thread.join();
+  ::close(s->listen_fd);
+  delete s;
+}
+
+void* tcpstore_client_connect(const char* host, int port) {
+  auto* c = new Client();
+  c->fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  if (::connect(c->fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) !=
+      0) {
+    ::close(c->fd);
+    delete c;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(c->fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  return c;
+}
+
+void tcpstore_client_close(void* handle) {
+  if (!handle) return;
+  auto* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+static int request(Client* c, uint8_t op, const char* key, const void* val,
+                   uint64_t vlen, std::vector<uint8_t>* out) {
+  uint32_t klen = static_cast<uint32_t>(std::strlen(key));
+  if (!write_full(c->fd, &op, 1) || !write_full(c->fd, &klen, 4) ||
+      !write_full(c->fd, key, klen) || !write_full(c->fd, &vlen, 8)) {
+    return -1;
+  }
+  if (vlen && !write_full(c->fd, val, vlen)) return -1;
+  uint8_t status;
+  uint64_t rlen;
+  if (!read_full(c->fd, &status, 1) || !read_full(c->fd, &rlen, 8)) return -1;
+  out->resize(rlen);
+  if (rlen && !read_full(c->fd, out->data(), rlen)) return -1;
+  return status;
+}
+
+int tcpstore_set(void* handle, const char* key, const uint8_t* val,
+                 uint64_t len) {
+  std::vector<uint8_t> out;
+  return request(static_cast<Client*>(handle), 1, key, val, len, &out);
+}
+
+// Returns value length, or -1 missing / -2 error. Copies at most cap bytes.
+int64_t tcpstore_get(void* handle, const char* key, uint8_t* buf,
+                     uint64_t cap) {
+  std::vector<uint8_t> out;
+  int st = request(static_cast<Client*>(handle), 2, key, nullptr, 0, &out);
+  if (st < 0) return -2;
+  if (st == 1) return -1;
+  uint64_t n = out.size() < cap ? out.size() : cap;
+  if (n) std::memcpy(buf, out.data(), n);
+  return static_cast<int64_t>(out.size());
+}
+
+int64_t tcpstore_add(void* handle, const char* key, int64_t delta) {
+  std::vector<uint8_t> out;
+  int st = request(static_cast<Client*>(handle), 3, key,
+                   reinterpret_cast<uint8_t*>(&delta), 8, &out);
+  if (st != 0 || out.size() != 8) return INT64_MIN;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+int tcpstore_check(void* handle, const char* key) {
+  std::vector<uint8_t> out;
+  int st = request(static_cast<Client*>(handle), 4, key, nullptr, 0, &out);
+  return st == 0 ? 1 : (st == 1 ? 0 : -1);
+}
+
+int64_t tcpstore_num_keys(void* handle) {
+  std::vector<uint8_t> out;
+  int st = request(static_cast<Client*>(handle), 6, "", nullptr, 0, &out);
+  if (st != 0 || out.size() != 8) return -1;
+  int64_t v;
+  std::memcpy(&v, out.data(), 8);
+  return v;
+}
+
+}  // extern "C"

@@ -1,0 +1,15 @@
+"""AMP — automatic mixed precision.
+
+Reference: `python/paddle/amp/auto_cast.py:668` (auto_cast), `:730` (decorate),
+`python/paddle/amp/grad_scaler.py:602` (GradScaler backed by
+check_finite_and_unscale / update_loss_scaling ops in fluid/operators/amp/).
+
+TPU re-design: bfloat16 is the native mixed-precision dtype (no loss scaling
+required — bf16 has fp32's exponent range), but the fp16 GradScaler API is
+kept for parity and works when fp16 is requested. The O1 cast lists hook into
+`core.dispatch.forward` — exactly where the reference's generated
+`*_ad_func` AMP blocks sit (eager_gen.py AMP logic).
+"""
+from .auto_cast import (WHITE_LIST, BLACK_LIST, amp_guard, auto_cast,  # noqa: F401
+                        decorate, amp_state)
+from .grad_scaler import AmpScaler, GradScaler  # noqa: F401

@@ -1,0 +1,112 @@
+"""auto_cast / decorate (reference `python/paddle/amp/auto_cast.py`)."""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dispatch
+from ..core import dtype as dtypes
+
+# Reference op lists (auto_cast.py WHITE_LIST/BLACK_LIST): matmul-class ops
+# run in low precision; numerically-sensitive ops stay fp32.
+WHITE_LIST = {
+    "matmul", "mm", "bmm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "addmm",
+}
+BLACK_LIST = {
+    "exp", "square", "log", "log2", "log10", "log1p", "mean", "sum", "std",
+    "var", "cos_sim", "softmax", "log_softmax", "cross_entropy",
+    "softmax_with_cross_entropy", "layer_norm", "batch_norm", "group_norm",
+    "instance_norm", "rms_norm", "norm", "p_norm", "logsumexp", "erf",
+    "erfinv", "pow", "cumsum", "cumprod", "nll_loss", "kl_div",
+    "binary_cross_entropy", "bce_with_logits", "mse_loss", "l1_loss",
+    "smooth_l1_loss", "sigmoid_focal_loss", "global_norm",
+}
+
+
+class _AmpState:
+    def __init__(self):
+        self.enabled = False
+        self.level = "O1"
+        self.dtype = jnp.bfloat16
+        self.white = WHITE_LIST
+        self.black = BLACK_LIST
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def _is_float(a):
+    return jnp.issubdtype(jnp.result_type(a), jnp.floating)
+
+
+def _cast_hook(op_name, arrays):
+    """Installed as dispatch.amp_cast_hook while auto_cast is active."""
+    if not _state.enabled:
+        return arrays
+    low = _state.dtype
+    if _state.level == "O2":
+        if op_name in _state.black:
+            return [a.astype(jnp.float32) if _is_float(a) and
+                    a.dtype in (low, jnp.float16) else a for a in arrays]
+        return [a.astype(low) if _is_float(a) else a for a in arrays]
+    # O1
+    if op_name in _state.white:
+        return [a.astype(low) if _is_float(a) else a for a in arrays]
+    if op_name in _state.black:
+        return [a.astype(jnp.float32) if _is_float(a) and a.dtype == low
+                else a for a in arrays]
+    return arrays
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """`paddle.amp.auto_cast` (auto_cast.py:668)."""
+    prev = (_state.enabled, _state.level, _state.dtype, _state.white,
+            _state.black, dispatch.amp_cast_hook)
+    _state.enabled = enable
+    _state.level = level
+    _state.dtype = jnp.float16 if dtype == "float16" else jnp.bfloat16
+    _state.white = WHITE_LIST | set(custom_white_list or ())
+    _state.black = (BLACK_LIST | set(custom_black_list or ())) - set(
+        custom_white_list or ())
+    dispatch.amp_cast_hook = _cast_hook if enable else dispatch.amp_cast_hook
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.level, _state.dtype, _state.white,
+         _state.black, dispatch.amp_cast_hook) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """`paddle.amp.decorate` (auto_cast.py:730): O2 casts model params to the
+    low dtype; optimizers get master fp32 weights (multi_precision)."""
+    single_model = not isinstance(models, (list, tuple))
+    model_list = [models] if single_model else list(models)
+    if level == "O2":
+        low = "float16" if dtype == "float16" else "bfloat16"
+        for m in model_list:
+            for p in m.parameters():
+                if p.dtype.is_floating_point() and p.dtype == dtypes.float32:
+                    p._data = p._data.astype(dtypes.convert_dtype(low))
+        if optimizers is not None:
+            opts = optimizers if isinstance(optimizers, (list, tuple)) \
+                else [optimizers]
+            for o in opts:
+                if hasattr(o, "_multi_precision"):
+                    o._multi_precision = True if master_weight is None \
+                        else master_weight
+    if optimizers is None:
+        return models if single_model else model_list
+    return (models if single_model else model_list), optimizers

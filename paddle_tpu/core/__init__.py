@@ -1,0 +1,2 @@
+from . import dtype, place, random, flags, autograd, tensor  # noqa: F401
+from .tensor import Tensor, Parameter  # noqa: F401

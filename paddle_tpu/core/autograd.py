@@ -1,0 +1,321 @@
+"""Eager autograd engine.
+
+TPU-native re-design of the reference's eager autograd
+(`/root/reference/paddle/fluid/eager/backward.cc:104` RunBackward,
+`eager/grad_node_info.h:168` GradNodeBase, `eager/grad_tensor_holder.cc`).
+
+Design: every differentiable op is executed through `jax.vjp`, which returns
+the primal outputs plus a pullback closure holding on-device residuals (the
+analog of the reference's TensorWrapper saved inputs). A `GradNode` wraps that
+pullback and the edges to producer nodes. `backward()` runs the same
+in-degree-counted reverse BFS as the reference (`backward.cc:RunBackward`),
+accumulating multi-consumer gradients in per-node holders
+(GradTensorHolder) and writing leaf `.grad` at accumulation edges
+(`eager/accumulation/`). Because the pullbacks are pure JAX functions, the
+entire backward pass is jit-traceable: wrapping a train step in `jax.jit`
+compiles forward+backward+update into a single XLA program.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "GradNode", "backward", "grad", "no_grad", "enable_grad", "set_grad_enabled",
+    "is_grad_enabled",
+]
+
+_grad_enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+class set_grad_enabled:
+    """Context manager + callable, mirroring paddle.set_grad_enabled."""
+
+    def __init__(self, mode: bool):
+        global _grad_enabled
+        self.prev = _grad_enabled
+        _grad_enabled = bool(mode)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self.prev
+        return False
+
+
+class _scoped:
+    def __init__(self, mode):
+        self.mode = mode
+
+    def __enter__(self):
+        global _grad_enabled
+        self.prev = _grad_enabled
+        _grad_enabled = self.mode
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self.prev
+        return False
+
+
+class no_grad(_scoped):
+    """`paddle.no_grad` — usable as context manager or decorator."""
+
+    def __init__(self, fn=None):
+        super().__init__(False)
+        self._fn = fn
+
+    def __call__(self, *args, **kwargs):
+        if self._fn is not None:
+            with _scoped(False):
+                return self._fn(*args, **kwargs)
+        # paddle.no_grad()(fn) style
+        fn = args[0]
+        import functools
+
+        @functools.wraps(fn)
+        def wrapper(*a, **k):
+            with _scoped(False):
+                return fn(*a, **k)
+
+        return wrapper
+
+
+class enable_grad(_scoped):
+    def __init__(self):
+        super().__init__(True)
+
+
+class GradNode:
+    """One node in the reverse graph (GradNodeBase equivalent).
+
+    Attributes:
+      vjp_fn: pullback from jax.vjp; consumes a tuple of output cotangents and
+        returns one cotangent per primal input array.
+      out_avals: (shape, dtype) per forward output — used to zero-fill
+        cotangents for outputs never used downstream (GradTensorHolder's
+        zero-init semantics).
+      edges: per forward input, either None (no grad path), ("leaf", tensor)
+        (GradNodeAccumulation equivalent), or (GradNode, slot).
+    """
+
+    __slots__ = ("name", "vjp_fn", "out_avals", "edges", "hooks", "__weakref__")
+
+    def __init__(self, name: str, vjp_fn: Callable, out_avals, edges):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.out_avals = out_avals
+        self.edges = edges
+        self.hooks = None  # {slot: [fn, ...]} applied to incoming cotangent
+
+    def add_hook(self, slot: int, fn):
+        if self.hooks is None:
+            self.hooks = {}
+        self.hooks.setdefault(slot, []).append(fn)
+
+    def __repr__(self):
+        return f"<GradNode {self.name}>"
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(jnp.result_type(x), jnp.floating) or jnp.issubdtype(
+        jnp.result_type(x), jnp.complexfloating
+    )
+
+
+def _accumulate_leaf(tensor, g):
+    """GradNodeAccumulation: write/accumulate `.grad` on a leaf tensor."""
+    from .tensor import Tensor
+
+    if tensor._hooks:
+        for h in tensor._hooks:
+            out = h(Tensor(g, stop_gradient=True))
+            if out is not None:
+                g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+    if tensor.grad is None:
+        tensor.grad = Tensor(g, stop_gradient=True)
+    else:
+        tensor.grad = Tensor(tensor.grad._data + g, stop_gradient=True)
+
+
+def _run_engine(seeds, retain_graph=False, capture=None):
+    """Reverse BFS with in-degree bookkeeping (backward.cc:104 RunBackward).
+
+    seeds: list of (node, slot, cotangent_array).
+    capture: optional dict {id(tensor): tensor} — when given, leaf-edge grads
+      for those tensors are returned instead of written to `.grad`
+      (GeneralGrad / paddle.grad semantics, `eager/general_grad.h`).
+    """
+    holders: dict[GradNode, list] = {}
+    indeg: dict[GradNode, int] = {}
+
+    # Discover reachable graph & in-degrees.
+    roots = {node for node, _, _ in seeds}
+    visited = set()
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if node in visited:
+            continue
+        visited.add(node)
+        for e in node.edges:
+            if e is not None and e[0] != "leaf":
+                tgt = e[0]
+                indeg[tgt] = indeg.get(tgt, 0) + 1
+                if tgt not in visited:
+                    stack.append(tgt)
+
+    def _add(node, slot, g):
+        h = holders.setdefault(node, [None] * len(node.out_avals))
+        h[slot] = g if h[slot] is None else h[slot] + g
+
+    for node, slot, g in seeds:
+        _add(node, slot, g)
+
+    captured = {} if capture is not None else None
+    queue = deque(n for n in visited if indeg.get(n, 0) == 0)
+    processed = set()
+    while queue:
+        node = queue.popleft()
+        if node in processed:
+            continue
+        processed.add(node)
+        holder = holders.pop(node, None)
+        if holder is None:
+            holder = [None] * len(node.out_avals)
+        # Zero-fill unused output cotangents; apply hooks.
+        cts = []
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            g = holder[i]
+            if g is None:
+                g = jnp.zeros(shape, dtype)
+            if node.hooks and i in node.hooks:
+                from .tensor import Tensor
+
+                for h in node.hooks[i]:
+                    out = h(Tensor(g, stop_gradient=True))
+                    if out is not None:
+                        g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+            cts.append(g)
+        if node.vjp_fn is None:
+            raise RuntimeError(
+                f"GradNode {node.name} was already released; pass "
+                "retain_graph=True to backward() to run it twice."
+            )
+        in_grads = node.vjp_fn(cts)
+        if not isinstance(in_grads, tuple):
+            in_grads = (in_grads,)
+        if not retain_graph:
+            node.vjp_fn = None  # free residuals eagerly, like GC'd TensorWrappers
+        for e, g in zip(node.edges, in_grads):
+            if e is None:
+                continue
+            # jax uses float0 for non-differentiable inputs
+            if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+                continue
+            if e[0] == "leaf":
+                t = e[1]
+                if captured is not None and id(t) in capture:
+                    if id(t) in captured:
+                        captured[id(t)] = captured[id(t)] + g
+                    else:
+                        captured[id(t)] = g
+                else:
+                    _accumulate_leaf(t, g)
+            else:
+                tgt, slot = e
+                _add(tgt, slot, g)
+                indeg[tgt] -= 1
+                if indeg[tgt] == 0:
+                    queue.append(tgt)
+    return captured
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    """`paddle.autograd.backward` (pybind eager_functions.cc:1127)."""
+    from .tensor import Tensor
+
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    seeds = []
+    with _scoped(False):
+        for t, gt in zip(tensors, grad_tensors):
+            if t.stop_gradient and t._grad_node is None:
+                continue
+            g = (
+                jnp.ones(t._data.shape, t._data.dtype)
+                if gt is None
+                else jnp.broadcast_to(
+                    (gt._data if isinstance(gt, Tensor) else jnp.asarray(gt)).astype(
+                        t._data.dtype
+                    ),
+                    t._data.shape,
+                )
+            )
+            if t._grad_node is not None:
+                seeds.append((t._grad_node, t._out_idx, g))
+            else:
+                _accumulate_leaf(t, g)
+        if seeds:
+            _run_engine(seeds, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False):
+    """`paddle.grad` — GeneralGrad semantics (`eager/general_grad.h`)."""
+    from .tensor import Tensor
+
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.incubate.autograd.vjp/jvp for "
+            "higher-order AD (jax-native)."
+        )
+    if isinstance(outputs, Tensor):
+        outputs = [outputs]
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+    elif isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    retain = bool(retain_graph) if retain_graph is not None else False
+    capture = {id(t): t for t in inputs}
+    seeds = []
+    with _scoped(False):
+        for t, gt in zip(outputs, grad_outputs):
+            if t._grad_node is None:
+                continue
+            g = (
+                jnp.ones(t._data.shape, t._data.dtype)
+                if gt is None
+                else (gt._data if isinstance(gt, Tensor) else jnp.asarray(gt))
+            )
+            seeds.append((t._grad_node, t._out_idx, g))
+        captured = _run_engine(seeds, retain_graph=retain, capture=capture) or {}
+    results = []
+    for t in inputs:
+        g = captured.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears unused in the "
+                    "graph; pass allow_unused=True to return None for it."
+                )
+            results.append(None)
+        else:
+            results.append(Tensor(g, stop_gradient=True))
+    return results

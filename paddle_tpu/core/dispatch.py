@@ -1,0 +1,127 @@
+"""Single op-dispatch point.
+
+This is the TPU-native collapse of the reference's entire dispatch stack
+(CS-1 in SURVEY.md): generated Python-C bindings → `*_ad_func` (AMP cast,
+GradNode creation; `eager/auto_code_generator/generator/eager_gen.py`) → PHI
+API kernel selection (`phi/api/lib/kernel_dispatch.h:102`,
+`phi/core/kernel_factory.cc:166`) → device kernel launch.
+
+On TPU every "kernel" is a pure JAX function lowered by XLA, so the whole
+pipeline reduces to one function, `forward()`:
+  1. AMP auto-cast of inputs     (eager_gen.py AMP block equivalent)
+  2. static-mode recording hook  (OpDesc append equivalent, see static/)
+  3. `jax.vjp` execution + GradNode wiring when grad is required
+  4. per-op `jax.jit` compile cache for the no-grad eager path
+     (KernelFactory + autotune cache equivalent — XLA owns the autotuning)
+
+InferMeta (shape/dtype inference, `phi/infermeta/`) falls out of
+`jax.eval_shape` and is used by the static recorder.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+from . import autograd as ag
+from .tensor import Tensor
+
+# Pluggable hooks -------------------------------------------------------------
+# static graph recorder: callable(fn, name, inputs, attrs) -> outputs or None
+static_recorder = None
+# AMP cast hook: callable(op_name, arrays) -> arrays
+amp_cast_hook = None
+
+
+def unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+@functools.lru_cache(maxsize=8192)
+def _jitted(fn, attr_items):
+    attrs = dict(attr_items)
+    return jax.jit(functools.partial(fn, **attrs))
+
+
+def _hashable_attrs(attrs):
+    try:
+        items = tuple(sorted(attrs.items()))
+        hash(items)
+        return items
+    except TypeError:
+        return None
+
+
+def _wrap_out(arrays, node, multi):
+    if not multi:
+        t = Tensor(arrays, stop_gradient=node is None)
+        if node is not None:
+            t._grad_node, t._out_idx = node, 0
+        return t
+    outs = []
+    for i, a in enumerate(arrays):
+        t = Tensor(a, stop_gradient=node is None)
+        if node is not None:
+            t._grad_node, t._out_idx = node, i
+        outs.append(t)
+    return tuple(outs)
+
+
+def forward(fn, inputs, attrs=None, name=None, nondiff=False):
+    """Execute op `fn(*input_arrays, **attrs)` with autograd/AMP/static hooks.
+
+    `inputs` must contain only Tensors / jax arrays / numpy arrays; all python
+    scalars and config go in `attrs` (the reference's OpDesc attr map).
+    """
+    attrs = attrs or {}
+    name = name or getattr(fn, "__name__", "op")
+
+    if static_recorder is not None:
+        out = static_recorder(fn, name, inputs, attrs)
+        if out is not NotImplemented:
+            return out
+
+    arrays = [unwrap(x) for x in inputs]
+    if amp_cast_hook is not None:
+        arrays = amp_cast_hook(name, arrays)
+
+    needs_grad = (
+        not nondiff
+        and ag.is_grad_enabled()
+        and any(isinstance(t, Tensor) and not t.stop_gradient for t in inputs)
+    )
+
+    if not needs_grad:
+        # Only jit module-level fns: closures are fresh objects per call and
+        # would defeat the compile cache (recompile storm). Closure ops run
+        # through JAX eager dispatch, which is itself compiled per-primitive.
+        items = (_hashable_attrs(attrs)
+                 if getattr(fn, "__closure__", None) is None else None)
+        if items is not None:
+            out = _jitted(fn, items)(*arrays)
+        else:
+            out = fn(*arrays, **attrs)
+        return _wrap_out(out, None, isinstance(out, (tuple, list)))
+
+    f = functools.partial(fn, **attrs)
+    out, vjp_fn = jax.vjp(f, *arrays)
+    multi = isinstance(out, (tuple, list))
+    outs_flat = list(out) if multi else [out]
+    out_avals = [(o.shape, o.dtype) for o in outs_flat]
+
+    edges = []
+    for t in inputs:
+        if isinstance(t, Tensor) and not t.stop_gradient:
+            if t._grad_node is not None:
+                edges.append((t._grad_node, t._out_idx))
+            else:
+                edges.append(("leaf", t))
+        else:
+            edges.append(None)
+    # Normalize: engine always passes a list of cotangents, one per output.
+    if multi:
+        node_vjp = lambda cts, _v=vjp_fn: _v(tuple(cts))
+    else:
+        node_vjp = lambda cts, _v=vjp_fn: _v(cts[0])
+    node = ag.GradNode(name, node_vjp, out_avals, edges)
+    return _wrap_out(out, node, multi)

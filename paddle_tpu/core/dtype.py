@@ -1,0 +1,132 @@
+"""Dtype system for paddle_tpu.
+
+TPU-native re-design of the reference's DataType enum
+(`/root/reference/paddle/phi/common/data_type.h`): instead of a C++ enum with
+per-backend size tables, dtypes are thin named wrappers over numpy/jax dtypes so
+they flow straight into XLA with zero conversion cost. bfloat16 is first-class
+(the TPU MXU native type).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+import ml_dtypes
+
+
+class DType:
+    """A framework dtype: compares equal to its string name and numpy dtype."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.np_dtype == other.np_dtype
+        if isinstance(other, str):
+            try:
+                return self.np_dtype == convert_dtype(other)
+            except (TypeError, ValueError):
+                return False
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+    def is_floating_point(self):
+        return (
+            np.issubdtype(self.np_dtype, np.floating)
+            or self.np_dtype == ml_dtypes.bfloat16
+        )
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", ml_dtypes.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16, float32,
+        float64, complex64, complex128]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NP = {d.np_dtype: d for d in _ALL}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """Mirror of paddle.set_default_dtype (`python/paddle/framework/framework.py`)."""
+    global _default_dtype
+    d = to_paddle_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only supports floating dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype.name
+
+
+def default_dtype() -> DType:
+    return _default_dtype
+
+
+def convert_dtype(d) -> np.dtype:
+    """Normalize any dtype spec (DType, str, numpy/jax dtype) to a numpy dtype."""
+    if d is None:
+        return _default_dtype.np_dtype
+    if isinstance(d, DType):
+        return d.np_dtype
+    if isinstance(d, str):
+        if d == "bfloat16":
+            return np.dtype(ml_dtypes.bfloat16)
+        if d == "bool":
+            return np.dtype(np.bool_)
+        return np.dtype(d)
+    return np.dtype(d)
+
+
+def to_paddle_dtype(d) -> DType:
+    npd = convert_dtype(d)
+    try:
+        return _BY_NP[npd]
+    except KeyError:
+        raise TypeError(f"unsupported dtype: {d!r}")
+
+
+def jnp_dtype(d):
+    """Dtype as jax.numpy accepts it."""
+    return convert_dtype(d)
+
+
+def is_integer(d) -> bool:
+    return np.issubdtype(convert_dtype(d), np.integer) or convert_dtype(d) == np.bool_
+
+
+def is_floating(d) -> bool:
+    npd = convert_dtype(d)
+    return np.issubdtype(npd, np.floating) or npd == ml_dtypes.bfloat16
+
+
+def promote(a, b) -> np.dtype:
+    return jnp.promote_types(convert_dtype(a), convert_dtype(b))

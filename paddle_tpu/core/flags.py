@@ -1,0 +1,54 @@
+"""Global flag registry.
+
+Equivalent of the reference's exported gflags + `paddle.set_flags`/`get_flags`
+(`/root/reference/paddle/phi/core/flags.cc`, `fluid/pybind/pybind.cc` globals).
+Flags are plain Python values; env vars `FLAGS_*` seed the defaults, matching
+the reference's env-var initialization.
+"""
+from __future__ import annotations
+
+import os
+
+_FLAGS = {}
+
+
+def define_flag(name: str, default, help_: str = ""):
+    env = os.environ.get(name)
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _FLAGS[name] = default
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        if k not in _FLAGS:
+            raise KeyError(f"unknown flag {k!r}")
+        _FLAGS[k] = v
+
+
+def get_flags(flags):
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _FLAGS[k] for k in flags}
+
+
+def flag(name: str):
+    return _FLAGS[name]
+
+
+# Defaults mirroring the reference flags that still make sense on TPU
+# (phi/core/flags.cc exports 95; the allocator/cudnn ones are owned by PJRT).
+define_flag("FLAGS_check_nan_inf", False, "scan op outputs for nan/inf")
+define_flag("FLAGS_benchmark", False, "block on every op for timing")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "no-op on TPU (PJRT GC)")
+define_flag("FLAGS_use_autotune", True, "let XLA autotune (always on)")
+define_flag("FLAGS_cudnn_deterministic", False, "deterministic ops (XLA flag)")
+define_flag("FLAGS_embedding_deterministic", 0, "deterministic embedding grad")
+define_flag("FLAGS_jit_ops", True, "per-op jit compile cache for eager mode")

@@ -1,0 +1,82 @@
+"""Global RNG state.
+
+Re-design of the reference's `phi::Generator` (`/root/reference/paddle/phi/core/
+generator.h:36`) for JAX: instead of a mutable per-device Philox engine, we keep
+a functional PRNG key that every random op splits. The state is an ordinary
+array, so a traced train step can feed a fresh key per step and the whole step
+stays jit-compatible (no host-side RNG in the compiled path).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Generator:
+    """Splittable key generator (phi/core/generator.h analog).
+
+    Key creation is lazy: importing the framework must not initialize the
+    JAX backend (the reference likewise defers device init until first use).
+    """
+
+    def __init__(self, seed: int = 0):
+        self._key = None
+        self._seed = seed
+
+    def _ensure(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = seed
+        self._key = None
+        return self
+
+    def get_state(self):
+        self._ensure()
+        return self._key
+
+    def set_state(self, state):
+        self._key = state
+
+    def split(self):
+        """Return a fresh subkey, advancing the state."""
+        self._ensure()
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def initial_seed(self):
+        return self._seed
+
+
+_default_generator = Generator(0)
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def seed(s: int):
+    """`paddle.seed` equivalent."""
+    _default_generator.manual_seed(int(s))
+    return _default_generator
+
+
+def split_key():
+    return _default_generator.split()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)
+
+
+def get_cuda_rng_state():  # reference-API parity
+    return [get_rng_state()]
+
+
+def set_cuda_rng_state(states):
+    set_rng_state(states[0] if isinstance(states, (list, tuple)) else states)

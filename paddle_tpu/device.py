@@ -1,0 +1,29 @@
+"""`paddle.device` namespace parity (`python/paddle/device/__init__.py`)."""
+from .core.place import (  # noqa: F401
+    set_device, get_device, device_count, is_compiled_with_tpu, Place,
+    CPUPlace, TPUPlace, CUDAPlace, current_place,
+)
+
+
+def get_all_device_type():
+    return ["cpu", "tpu"] if is_compiled_with_tpu() else ["cpu"]
+
+
+def get_available_device():
+    return ["tpu:0"] if is_compiled_with_tpu() else ["cpu"]
+
+
+class cuda:  # namespace shim: paddle.device.cuda.*
+    @staticmethod
+    def device_count():
+        return device_count()
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax
+
+        (jax.device_put(0) + 0).block_until_ready()
+
+
+def synchronize(device=None):
+    cuda.synchronize(device)

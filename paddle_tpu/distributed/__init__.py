@@ -1,0 +1,24 @@
+"""paddle_tpu.distributed (reference `python/paddle/distributed/`).
+
+See SURVEY §5 "Distributed communication backend": the ProcessGroup/NCCL
+world is re-imagined as mesh axes + XLA collectives over ICI. Fleet hybrid
+parallelism lives in `fleet/`; the compiled SPMD engine in
+`fleet/hybrid_engine.py`.
+"""
+from .parallel_env import (ParallelEnv, barrier, get_rank,  # noqa: F401
+                           get_world_size, init_parallel_env, is_initialized)
+from .collective import (Group, ReduceOp, all_gather, all_reduce,  # noqa: F401
+                         alltoall, all_to_all, broadcast, get_group,
+                         new_group, reduce, reduce_scatter, scatter, send,
+                         recv, wait, get_global_mesh, set_global_mesh)
+from .parallel import DataParallel  # noqa: F401
+from . import collective  # noqa: F401
+from . import fleet  # noqa: F401
+from . import meta_parallel  # noqa: F401
+from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Reference `distributed/spawn.py`. Under single-controller SPMD all
+    local chips belong to one process: run func once (rank 0 drives)."""
+    func(*args)

@@ -1,0 +1,116 @@
+"""Fleet facade.
+
+Reference: `python/paddle/distributed/fleet/fleet.py:168` (fleet.init) →
+`_init_hybrid_parallel_env:385` → CommunicateTopology(:428) +
+HybridCommunicateGroup(:432); `distributed_model` (fleet/model.py:134);
+`distributed_optimizer` (fleet.py:1058).
+"""
+from __future__ import annotations
+
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .hybrid_engine import HybridParallelEngine  # noqa: F401
+from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
+from . import utils  # noqa: F401
+
+_fleet_state = {"initialized": False, "hcg": None, "strategy": None}
+
+
+def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+    """fleet.init (fleet.py:168)."""
+    from .. import parallel_env
+
+    parallel_env.init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        ["data", "pipe", "sharding", "model"],
+        [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+         hc.get("sharding_degree", 1), hc.get("mp_degree", 1)])
+    hcg = HybridCommunicateGroup(topo)
+    _fleet_state.update(initialized=True, hcg=hcg, strategy=strategy)
+    return
+
+
+def is_initialized():
+    return _fleet_state["initialized"]
+
+
+def get_hybrid_communicate_group() -> HybridCommunicateGroup:
+    return _fleet_state["hcg"]
+
+
+def get_strategy():
+    return _fleet_state["strategy"]
+
+
+def distributed_model(model, criterion=None, optimizer=None):
+    """fleet.distributed_model (fleet/model.py:30,134-170).
+
+    dp-only mode returns the model wrapped in DataParallel semantics (a
+    no-op under SPMD: gradient sync is compiled into the step); hybrid mode
+    returns a HybridParallelEngine when an optimizer is supplied via
+    `distributed_optimizer` first, else the model annotated for GSPMD."""
+    hcg = _fleet_state["hcg"]
+    if hcg is None:
+        raise RuntimeError("call fleet.init() first")
+    mode = hcg.get_parallel_mode()
+    if mode in ("single", "data_parallel"):
+        from ..parallel import DataParallel
+
+        return DataParallel(model)
+    opt = optimizer or _fleet_state.get("optimizer")
+    if opt is None:
+        return model
+    engine = HybridParallelEngine(model, opt.inner_opt if hasattr(
+        opt, "inner_opt") else opt, hcg, _fleet_state["strategy"], criterion)
+    return engine
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """fleet.distributed_optimizer (fleet.py:1058) — wraps the inner
+    optimizer; cross-group grad sync/clip is compiled into the engine step
+    (HybridParallelOptimizer, hybrid_parallel_optimizer.py:186, collapses)."""
+    _fleet_state["optimizer"] = optimizer
+
+    class _DistOpt:
+        inner_opt = optimizer
+
+        def __getattr__(self, k):
+            return getattr(optimizer, k)
+
+        def step(self):
+            optimizer.step()
+
+        def clear_grad(self):
+            optimizer.clear_grad()
+
+        def minimize(self, loss, **kw):
+            return optimizer.minimize(loss, **kw)
+
+    return _DistOpt()
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *a, **k):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, *a, **k):
+        pass
+
+
+def worker_index():
+    from .. import parallel_env
+
+    return parallel_env.get_rank()
+
+
+def worker_num():
+    from .. import parallel_env
+
+    return parallel_env.get_world_size()
+
+
+def is_first_worker():
+    return worker_index() == 0

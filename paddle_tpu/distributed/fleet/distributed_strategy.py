@@ -1,0 +1,53 @@
+"""DistributedStrategy.
+
+Reference: `python/paddle/distributed/fleet/base/distributed_strategy.py:117`
+(protobuf-backed). Plain attributes here — the strategy surface that maps to
+TPU concepts is kept; GPU-only toggles (dgc, localsgd, fp16_allreduce) are
+accepted and ignored with the same defaults so reference configs parse.
+"""
+from __future__ import annotations
+
+__all__ = ["DistributedStrategy"]
+
+
+class DistributedStrategy:
+    def __init__(self):
+        # hybrid parallel degrees (distributed_strategy.py hybrid_configs)
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+        }
+        self.amp = False
+        self.amp_configs = {"init_loss_scaling": 32768.0,
+                            "use_pure_fp16": False, "use_bf16": True}
+        self.recompute = False
+        self.recompute_configs = {"checkpoints": []}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1,
+                                 "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {"tensor_parallel_degree": 1}
+        self.sharding = False
+        self.sharding_configs = {"stage": 1, "offload": False}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.fp16_allreduce = False
+        self.find_unused_parameters = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.without_graph_optimization = True
+        self.heter_ccl_mode = False
+        self.a_sync = False
+        self.a_sync_configs = {}
+
+    def __repr__(self):
+        keys = ["hybrid_configs", "amp", "recompute", "sharding", "pipeline"]
+        body = ", ".join(f"{k}={getattr(self, k)!r}" for k in keys)
+        return f"DistributedStrategy({body})"

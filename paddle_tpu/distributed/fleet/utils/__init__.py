@@ -1,0 +1,63 @@
+"""fleet.utils — recompute + helpers.
+
+Reference: `python/paddle/distributed/fleet/recompute/recompute.py:69`
+(PyLayer-based activation checkpointing), `fleet/utils/hybrid_parallel_util.
+py:194` (fused_allreduce_gradients).
+"""
+from __future__ import annotations
+
+import jax
+
+from ....core import autograd
+from ....core.dispatch import forward
+from ....core.tensor import Tensor
+
+__all__ = ["recompute", "fused_allreduce_gradients"]
+
+
+def recompute(function, *args, layer=None, use_reentrant=True, **kwargs):
+    """Activation recomputation via `jax.checkpoint`.
+
+    The reference re-runs forward inside a custom PyLayer backward
+    (recompute.py:69 RecomputeFunction); `jax.checkpoint` expresses the same
+    trade inside XLA, so the rematerialized forward fuses into the backward
+    pass. `layer` (or function.__self__) supplies the parameters that must
+    receive gradients."""
+    if layer is None:
+        layer = getattr(function, "__self__", None)
+    params = [p for p in layer.parameters()] if layer is not None else []
+    tensor_args = [a if isinstance(a, Tensor) else Tensor(a) for a in args]
+    n_args = len(tensor_args)
+
+    def pure(*arrays):
+        arg_arrays = arrays[:n_args]
+        param_arrays = arrays[n_args:]
+        saved = [p._data for p in params]
+        for p, arr in zip(params, param_arrays):
+            p._data = arr
+        try:
+            with autograd._scoped(False):
+                out = function(*[Tensor(a) for a in arg_arrays], **kwargs)
+            if isinstance(out, (tuple, list)):
+                return tuple(o._data if isinstance(o, Tensor) else o
+                             for o in out)
+            return out._data
+        finally:
+            for p, arr in zip(params, saved):
+                p._data = arr
+
+    return forward(jax.checkpoint(pure), (*tensor_args, *params),
+                   name="recompute")
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Reference hybrid_parallel_util.py:194-212. Under SPMD jit, dp-grad
+    all-reduce is inserted by GSPMD; eager path reduces over the dp group."""
+    from .. import collective
+
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    if group is None or group.nranks <= 1:
+        return
+    for p in parameter_list:
+        if p.grad is not None:
+            collective.all_reduce(p.grad, group=group)

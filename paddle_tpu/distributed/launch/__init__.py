@@ -1,0 +1,14 @@
+"""`python -m paddle_tpu.distributed.launch` — multi-host launcher.
+
+Reference: `python/paddle/distributed/launch/` (Controllers build a Pod of
+trainer processes with PADDLE_TRAINER_* env, rendezvous via HTTPMaster/
+ETCDMaster, log watcher — controllers/collective.py:21, controllers/
+master.py:27).
+
+TPU re-design: one process per HOST (not per chip) — JAX's single-controller
+model. The launcher assigns PADDLE_TRAINER_ID/PADDLE_TRAINERS_NUM/
+PADDLE_MASTER, which `init_parallel_env` feeds to
+`jax.distributed.initialize`; rendezvous uses the native TCPStore
+(csrc/tcpstore) instead of etcd, with the rank-0 process hosting it.
+"""
+from .main import launch  # noqa: F401

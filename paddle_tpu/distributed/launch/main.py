@@ -1,0 +1,99 @@
+"""Launcher implementation (reference launch/main.py + controllers/)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def _parse():
+    p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=None,
+                   help="rank0 endpoint ip:port (HTTPMaster equivalent)")
+    p.add_argument("--nnodes", type=int, default=1, help="number of hosts")
+    p.add_argument("--rank", type=int, default=0, help="this host's rank")
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per host (1 for TPU single-controller)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--devices", default=None,
+                   help="visible device ids (TPU_VISIBLE_DEVICES)")
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+class Pod:
+    """Group of local trainer procs (reference launch/job/pod.py)."""
+
+    def __init__(self):
+        self.procs: list[subprocess.Popen] = []
+
+    def spawn(self, cmd, env, log_path):
+        os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
+        f = open(log_path, "w")
+        proc = subprocess.Popen(cmd, env=env, stdout=f, stderr=f)
+        self.procs.append(proc)
+        return proc
+
+    def watch(self):
+        """Reference watcher: exit when any proc fails, kill the rest."""
+        try:
+            while True:
+                for p in self.procs:
+                    rc = p.poll()
+                    if rc is not None:
+                        if rc != 0:
+                            self.terminate()
+                            return rc
+                        if all(q.poll() is not None for q in self.procs):
+                            return 0
+                time.sleep(0.5)
+        except KeyboardInterrupt:
+            self.terminate()
+            return 1
+
+    def terminate(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        t0 = time.time()
+        while time.time() - t0 < 10:
+            if all(p.poll() is not None for p in self.procs):
+                return
+            time.sleep(0.2)
+        for p in self.procs:
+            if p.poll() is None:
+                p.kill()
+
+
+def launch():
+    args = _parse()
+    pod = Pod()
+    master = args.master or "127.0.0.1:8070"
+
+    for local_rank in range(args.nproc_per_node):
+        rank = args.rank * args.nproc_per_node + local_rank
+        world = args.nnodes * args.nproc_per_node
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_MASTER": master,
+            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{8071 + local_rank}",
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "FLAGS_selected_tpus": args.devices or "",
+        })
+        cmd = [sys.executable, "-u", args.training_script,
+               *args.training_script_args]
+        pod.spawn(cmd, env, os.path.join(args.log_dir,
+                                         f"workerlog.{local_rank}"))
+
+    rc = pod.watch()
+    sys.exit(rc)
+
+
+if __name__ == "__main__":
+    launch()

@@ -1,0 +1,38 @@
+"""fleet.meta_parallel layer library.
+
+Reference: `python/paddle/distributed/fleet/meta_parallel/` +
+`fleet/layers/mpu/`. TP layers here are *annotation* layers: they carry the
+PartitionSpec that makes GSPMD shard their weights over the 'mp' mesh axis
+inside a compiled step, while remaining ordinary dense layers eagerly.
+"""
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,  # noqa: F401
+                        RowParallelLinear, VocabParallelEmbedding)
+from .pp_layers import LayerDesc, PipelineLayer, SharedLayerDesc  # noqa: F401
+from .random_ctrl import RNGStatesTracker, get_rng_state_tracker, model_parallel_random_seed  # noqa: F401
+from .context_parallel import (RingAttention, gather_sequence,  # noqa: F401
+                               ring_attention, split_sequence)
+from ..parallel import DataParallel  # noqa: F401
+
+
+class TensorParallel(DataParallel):
+    """Reference meta_parallel/tensor_parallel.py — broadcast-on-init is a
+    no-op under SPMD (single logical copy, GSPMD shards it)."""
+
+
+class PipelineParallel(DataParallel):
+    """Dygraph PipelineParallel facade (pipeline_parallel.py:31). The actual
+    1F1B compiled schedule lives in fleet.HybridParallelEngine._pipelined;
+    use fleet.distributed_model(model, optimizer=...) to obtain the engine
+    with train_batch()."""
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from ..fleet import HybridParallelEngine, _fleet_state
+
+        engine = getattr(self, "_engine", None)
+        if engine is None:
+            engine = HybridParallelEngine(
+                self._layers, optimizer.inner_opt if hasattr(
+                    optimizer, "inner_opt") else optimizer,
+                _fleet_state["hcg"], _fleet_state["strategy"])
+            self.__dict__["_engine"] = engine
+        return engine.train_batch(data)

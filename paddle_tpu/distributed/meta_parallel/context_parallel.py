@@ -1,0 +1,147 @@
+"""Sequence / context parallelism: ring flash attention over ICI.
+
+The reference snapshot has NO sequence parallelism (SURVEY §5 "Long-context:
+ABSENT — ring attention / context parallel would be a new feature beyond
+parity"). This module supplies it TPU-natively:
+
+  - sequences are sharded over the 'sp' mesh axis: each device holds
+    [B, T/sp, N, H] of Q, K, V;
+  - attention runs as a ring: each of the sp steps computes one Q-shard ×
+    KV-shard block with the online-softmax merge (same math as the Pallas
+    flash kernel), then rotates the KV shard to the ring neighbor with
+    `lax.ppermute` — compute on step i overlaps the transfer for step i+1
+    on ICI (XLA schedules the collective-permute concurrently);
+  - causal masking skips fully-masked blocks' contribution via masking
+    (SPMD-uniform; no divergent control flow).
+
+jax.grad differentiates through the ring (ppermute transposes to the
+reverse rotation), giving the ring-attention backward pass for free.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ...core.dispatch import forward as _dispatch_forward
+from ...core.tensor import Tensor
+
+__all__ = ["ring_attention", "RingAttention", "split_sequence",
+           "gather_sequence"]
+
+
+def _ring_attention_shard(q, k, v, *, axis, sp, causal, scale):
+    """Per-device body (inside shard_map). q/k/v: [B, Tq, N, H] local."""
+    B, Tq, N, H = q.shape
+    Tk = k.shape[1]
+    idx = jax.lax.axis_index(axis)
+    qf = q.astype(jnp.float32) * scale
+    # [B, N, Tq, H] layout for the block matmuls
+    qf = jnp.swapaxes(qf, 1, 2)
+
+    m0 = jnp.full((B, N, Tq, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((B, N, Tq, 1), jnp.float32)
+    acc0 = jnp.zeros((B, N, Tq, H), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc, kk, vv = carry
+        src = (idx - i) % sp  # owner rank of the KV shard currently held
+        kf = jnp.swapaxes(kk.astype(jnp.float32), 1, 2)
+        vf = jnp.swapaxes(vv.astype(jnp.float32), 1, 2)
+        s = jnp.einsum("bnqh,bnkh->bnqk", qf, kf)
+        if causal:
+            qpos = idx * Tq + jax.lax.broadcasted_iota(
+                jnp.int32, (Tq, Tk), 0)
+            kpos = src * Tk + jax.lax.broadcasted_iota(
+                jnp.int32, (Tq, Tk), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        blk_m = s.max(axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, blk_m)
+        # fully-masked blocks: keep m finite so exp() stays 0 not nan
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(s - safe_m)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.einsum("bnqk,bnkh->bnqh", p, vf)
+        perm = [(j, (j + 1) % sp) for j in range(sp)]
+        kk = jax.lax.ppermute(kk, axis, perm)
+        vv = jax.lax.ppermute(vv, axis, perm)
+        return m_new, l_new, acc_new, kk, vv
+
+    m, l, acc, _, _ = jax.lax.fori_loop(0, sp, body, (m0, l0, acc0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh=None, sp_axis="sp", causal=False,
+                   scale=None):
+    """Ring flash attention on tensors sequence-sharded over `sp_axis`.
+
+    Accepts Tensors or jax arrays of [B, T, N, H] (global view). Works
+    eagerly (compiled shard_map) and inside jit/pjit steps.
+    """
+    from .. import collective
+
+    mesh = mesh or collective.get_global_mesh()
+    sp = mesh.shape[sp_axis]
+    H = (q.shape[-1] if not isinstance(q, Tensor) else q._data.shape[-1])
+    scale = float(scale) if scale is not None else H ** -0.5
+
+    inner = functools.partial(_ring_attention_shard, axis=sp_axis, sp=sp,
+                              causal=causal, scale=scale)
+    spec = P(None, sp_axis, None, None)
+    sm = jax.shard_map(inner, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    if isinstance(q, Tensor):
+        from jax.sharding import NamedSharding
+
+        def place(t):
+            p = t.detach()
+            p._data = jax.device_put(t._data, NamedSharding(mesh, spec))
+            p.stop_gradient = t.stop_gradient
+            p._grad_node, p._out_idx = t._grad_node, t._out_idx
+            return p
+
+        return _dispatch_forward(sm, (place(q), place(k), place(v)),
+                                 name="ring_attention")
+    return sm(q, k, v)
+
+
+class RingAttention:
+    """Layer-style wrapper for model code (context-parallel attention)."""
+
+    def __init__(self, mesh=None, sp_axis="sp", causal=True):
+        self.mesh = mesh
+        self.sp_axis = sp_axis
+        self.causal = causal
+
+    def __call__(self, q, k, v):
+        return ring_attention(q, k, v, self.mesh, self.sp_axis, self.causal)
+
+
+def split_sequence(x, mesh=None, sp_axis="sp", seq_dim=1):
+    """Shard a global tensor's sequence dim over the sp axis (device_put)."""
+    from jax.sharding import NamedSharding
+
+    from .. import collective
+
+    mesh = mesh or collective.get_global_mesh()
+    nd = x._data.ndim if isinstance(x, Tensor) else x.ndim
+    spec = P(*[sp_axis if i == seq_dim else None for i in range(nd)])
+    arr = x._data if isinstance(x, Tensor) else x
+    out = jax.device_put(arr, NamedSharding(mesh, spec))
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+def gather_sequence(x, mesh=None, sp_axis="sp", seq_dim=1):
+    """Replicate a sequence-sharded tensor (all-gather over sp)."""
+    from jax.sharding import NamedSharding
+
+    from .. import collective
+
+    mesh = mesh or collective.get_global_mesh()
+    arr = x._data if isinstance(x, Tensor) else x
+    out = jax.device_put(arr, NamedSharding(mesh, P()))
+    return Tensor(out) if isinstance(x, Tensor) else out

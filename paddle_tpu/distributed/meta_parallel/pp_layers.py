@@ -1,0 +1,79 @@
+"""Pipeline layer description.
+
+Reference: `python/paddle/distributed/fleet/meta_parallel/parallel_layers/
+pp_layers.py:57` (LayerDesc) and `:209` (PipelineLayer — segments a layer
+list into stages, handles shared weights).
+
+TPU re-design: PipelineLayer materializes ALL layers (single logical copy —
+GSPMD owns placement); stage segmentation metadata feeds the compiled GPipe
+schedule in fleet.hybrid_engine. Shared-weight groups (e.g. embedding ↔
+lm-head tying) are natural here since every parameter is one logical array.
+"""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, *args, forward_func=None,
+                 shared_weight_attr="weight", **kwargs):
+        super().__init__(layer_cls, *args, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(nn.Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self._num_stages = num_stages or 1
+        self._shared = {}
+        built = []
+        for i, d in enumerate(layers):
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(("shared", d.layer_name, d.forward_func))
+                    continue
+                layer = d.build_layer()
+                self._shared[d.layer_name] = layer
+                built.append(("layer", layer, d.forward_func))
+            elif isinstance(d, LayerDesc):
+                built.append(("layer", d.build_layer(), None))
+            elif callable(d) and not isinstance(d, nn.Layer):
+                built.append(("fn", d, None))
+            else:
+                built.append(("layer", d, None))
+        self.run_sequence = built
+        self.layers = nn.LayerList(
+            [b[1] for b in built if b[0] == "layer"])
+
+    def get_stage_from_index(self, idx):
+        n = len(self.run_sequence)
+        per = (n + self._num_stages - 1) // self._num_stages
+        return idx // per
+
+    def forward(self, x):
+        for kind, item, ffn in self.run_sequence:
+            if kind == "shared":
+                layer = self._shared[item]
+                x = ffn(layer, x) if ffn is not None else layer(x)
+            elif kind == "fn":
+                x = item(x)
+            else:
+                x = ffn(item, x) if ffn is not None else item(x)
+        return x

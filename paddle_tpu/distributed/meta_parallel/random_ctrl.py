@@ -1,0 +1,63 @@
+"""TP-safe RNG state tracking.
+
+Reference: `python/paddle/distributed/fleet/layers/mpu/random.py:29`
+(RNGStatesTracker: named CUDA rng states so dropout inside/outside mp
+regions draws from decorrelated streams).
+
+TPU re-design: functional keys — each named state is a fold of the global
+seed, so "local" (per-mp-rank) streams differ by folding in the axis index
+inside compiled code, while the "global" stream is shared.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+from ...core import random as prandom
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states = {}
+
+    def reset(self):
+        self.states = {}
+
+    def add(self, name, seed):
+        if name in self.states:
+            raise ValueError(f"state {name} already exists")
+        self.states[name] = jax.random.key(seed)
+
+    @contextlib.contextmanager
+    def rng_state(self, name=MODEL_PARALLEL_RNG):
+        if name not in self.states:
+            self.add(name, hash(name) % (2 ** 31))
+        orig = prandom.get_rng_state()
+        prandom.set_rng_state(self.states[name])
+        try:
+            yield
+        finally:
+            self.states[name] = prandom.get_rng_state()
+            prandom.set_rng_state(orig)
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker():
+    return _tracker
+
+
+def model_parallel_random_seed(seed=None):
+    import random as pyrandom
+
+    seed = seed if seed is not None else pyrandom.randint(0, 2 ** 31)
+    _tracker.reset()
+    prandom.seed(seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1007)

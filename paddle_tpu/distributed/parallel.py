@@ -1,0 +1,63 @@
+"""DataParallel wrapper.
+
+Reference: `python/paddle/distributed/parallel.py:202` (DataParallel +
+EagerReducer bucketed grad allreduce, `distributed/collective/reducer.cc`).
+
+TPU re-design: under single-controller SPMD, data parallelism is a sharding,
+not a wrapper behavior — batches sharded over 'dp' make XLA emit fused grad
+all-reduces (the compiler does the bucketing the EagerReducer hand-rolled).
+DataParallel therefore forwards transparently; its scale_loss/grad-sync API
+is kept for reference-code compatibility and performs the eager dp reduce
+when a multi-rank dp group exists.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.group = group
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        from . import collective
+        from .fleet import _fleet_state
+
+        hcg = _fleet_state.get("hcg")
+        group = self.group or (hcg.get_data_parallel_group() if hcg else None)
+        if group is None or group.nranks <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad, group=group)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, *args, **kwargs):
+        return self._layers.parameters(*args, **kwargs)
+
+    def named_parameters(self, *args, **kwargs):
+        return self._layers.named_parameters(*args, **kwargs)
+
+    def __getattr__(self, name):
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(self.__dict__["_sub_layers"]["_layers"], name)

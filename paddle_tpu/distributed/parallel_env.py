@@ -1,0 +1,92 @@
+"""Process/environment model for distributed execution.
+
+Reference: `python/paddle/distributed/parallel.py` (init_parallel_env,
+ParallelEnv over PADDLE_TRAINER_* env vars + TCP-store rendezvous).
+
+TPU re-design: JAX is single-controller SPMD — one Python process drives all
+local chips, and multi-host pods run one process per host coordinated by
+`jax.distributed.initialize` (the TCPStore/rendezvous equivalent lives in
+csrc/tcpstore + runtime/coordination). "rank" therefore maps to
+process_index and "world" to the global device count; collectives are
+compiled into programs rather than issued per-rank. The ParallelEnv API is
+kept verbatim so reference-style scripts run unchanged.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+           "barrier", "is_initialized"]
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Reference parallel.py:init_parallel_env. Multi-host: uses
+    PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER (launcher env
+    protocol, launch/controllers/collective.py:75) to bootstrap
+    jax.distributed; single-host SPMD needs no setup."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    master = os.environ.get("PADDLE_MASTER",
+                            os.environ.get("MASTER_ENDPOINT", ""))
+    if nranks > 1 and master:
+        jax.distributed.initialize(coordinator_address=master,
+                                   num_processes=nranks, process_id=rank)
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized():
+    return _initialized
+
+
+def get_rank(group=None):
+    if group is not None:
+        return group.get_group_rank(jax.process_index())
+    return jax.process_index()
+
+
+def get_world_size(group=None):
+    if group is not None:
+        return group.nranks
+    # SPMD: world = all devices (each device is a logical rank)
+    return max(jax.device_count(), 1)
+
+
+def barrier(group=None):
+    arr = jax.numpy.ones(())
+    jax.block_until_ready(arr + 0)
+
+
+class ParallelEnv:
+    """Reference parallel.py:663 ParallelEnv."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else [self.current_endpoint]
+
+    local_rank = rank
+    nranks = world_size

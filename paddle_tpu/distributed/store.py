@@ -1,0 +1,139 @@
+"""TCPStore — Python binding over the native store (csrc/tcpstore).
+
+Reference: `paddle/phi/core/distributed/store/tcp_store.h:120` (TCPStore),
+pybound at `fluid/pybind/communication.cc:61`. Same rendezvous semantics:
+the rank-0 host runs the server; every rank connects as a client and uses
+set/get/add/wait to exchange bootstrap info before the collective world
+exists. Binding is ctypes over a C ABI (no pybind11 in this image).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import time
+
+__all__ = ["TCPStore"]
+
+_LIB = None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(here, "lib", "libtcpstore.so")
+    if not os.path.exists(path):
+        # build on demand (g++ is in the image)
+        import subprocess
+
+        src = os.path.join(os.path.dirname(here), "csrc")
+        if os.path.exists(os.path.join(src, "Makefile")):
+            subprocess.run(["make", "-C", src], check=True,
+                           capture_output=True)
+    lib = ctypes.CDLL(path)
+    lib.tcpstore_server_start.restype = ctypes.c_void_p
+    lib.tcpstore_server_start.argtypes = [ctypes.c_int]
+    lib.tcpstore_server_port.restype = ctypes.c_int
+    lib.tcpstore_server_port.argtypes = [ctypes.c_void_p]
+    lib.tcpstore_server_stop.argtypes = [ctypes.c_void_p]
+    lib.tcpstore_client_connect.restype = ctypes.c_void_p
+    lib.tcpstore_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.tcpstore_client_close.argtypes = [ctypes.c_void_p]
+    lib.tcpstore_set.restype = ctypes.c_int
+    lib.tcpstore_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_uint64]
+    lib.tcpstore_get.restype = ctypes.c_int64
+    lib.tcpstore_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_char_p, ctypes.c_uint64]
+    lib.tcpstore_add.restype = ctypes.c_int64
+    lib.tcpstore_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int64]
+    lib.tcpstore_check.restype = ctypes.c_int
+    lib.tcpstore_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.tcpstore_num_keys.restype = ctypes.c_int64
+    lib.tcpstore_num_keys.argtypes = [ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+class TCPStore:
+    """TCPStore(host, port, is_master, world_size, timeout_s)."""
+
+    def __init__(self, host="127.0.0.1", port=0, is_master=False,
+                 world_size=1, timeout=30.0):
+        lib = _load()
+        self._lib = lib
+        self._server = None
+        self.timeout = timeout
+        if is_master:
+            self._server = lib.tcpstore_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore server failed on port {port}")
+            port = lib.tcpstore_server_port(self._server)
+        self.port = port
+        self.host = host
+        deadline = time.time() + timeout
+        self._client = None
+        while time.time() < deadline:
+            self._client = lib.tcpstore_client_connect(host.encode(), port)
+            if self._client:
+                break
+            time.sleep(0.05)
+        if not self._client:
+            raise TimeoutError(f"cannot connect TCPStore at {host}:{port}")
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        rc = self._lib.tcpstore_set(self._client, key.encode(), value,
+                                    len(value))
+        if rc != 0:
+            raise RuntimeError("TCPStore.set failed")
+
+    def get(self, key):
+        """Blocking get (reference TCPStore::get waits for the key)."""
+        deadline = time.time() + self.timeout
+        buf = ctypes.create_string_buffer(1 << 20)
+        while True:
+            n = self._lib.tcpstore_get(self._client, key.encode(), buf,
+                                       len(buf))
+            if n >= 0:
+                if n > len(buf):
+                    buf = ctypes.create_string_buffer(int(n))
+                    n = self._lib.tcpstore_get(self._client, key.encode(),
+                                               buf, len(buf))
+                return buf.raw[:n]
+            if n == -2:
+                raise RuntimeError("TCPStore.get transport error")
+            if time.time() > deadline:
+                raise TimeoutError(f"TCPStore.get({key!r}) timed out")
+            time.sleep(0.02)
+
+    def add(self, key, amount=1):
+        v = self._lib.tcpstore_add(self._client, key.encode(), amount)
+        if v == -(2 ** 63):
+            raise RuntimeError("TCPStore.add failed")
+        return v
+
+    def wait(self, keys, timeout=None):
+        if isinstance(keys, str):
+            keys = [keys]
+        deadline = time.time() + (timeout or self.timeout)
+        for k in keys:
+            while self._lib.tcpstore_check(self._client, k.encode()) != 1:
+                if time.time() > deadline:
+                    raise TimeoutError(f"TCPStore.wait({k!r}) timed out")
+                time.sleep(0.02)
+
+    def num_keys(self):
+        return self._lib.tcpstore_num_keys(self._client)
+
+    def __del__(self):
+        try:
+            if getattr(self, "_client", None):
+                self._lib.tcpstore_client_close(self._client)
+            if getattr(self, "_server", None):
+                self._lib.tcpstore_server_stop(self._server)
+        except Exception:
+            pass

@@ -1,0 +1,90 @@
+"""Framework-level utilities: save/load, dygraph/static mode switches.
+
+Reference: `python/paddle/framework/io.py:656,898` (paddle.save/paddle.load),
+`python/paddle/fluid/framework.py` mode switches.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .core.tensor import Tensor, Parameter
+
+
+def _to_saveable(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": obj.numpy(),
+                "stop_gradient": obj.stop_gradient,
+                "param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _to_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_to_saveable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _from_saveable(obj):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            cls = Parameter if obj.get("param") else Tensor
+            t = cls(obj["data"])
+            if not obj.get("param"):
+                t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _from_saveable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_from_saveable(v) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    """`paddle.save` — pickle of numpy-converted nests (io.py:656)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    """`paddle.load` (io.py:898). return_numpy=True yields raw ndarrays."""
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    if configs.get("return_numpy"):
+        def strip(o):
+            if isinstance(o, dict) and o.get("__tensor__"):
+                return o["data"]
+            if isinstance(o, dict):
+                return {k: strip(v) for k, v in o.items()}
+            if isinstance(o, (list, tuple)):
+                t = [strip(v) for v in o]
+                return t if isinstance(o, list) else tuple(t)
+            return o
+        return strip(obj)
+    return _from_saveable(obj)
+
+
+def in_dynamic_mode() -> bool:
+    from .core import dispatch
+
+    return dispatch.static_recorder is None
+
+
+def in_dygraph_mode() -> bool:
+    return in_dynamic_mode()
+
+
+def enable_static():
+    from .static import program as _prog
+
+    _prog._enable_static()
+
+
+def disable_static():
+    from .static import program as _prog
+
+    _prog._disable_static()

@@ -1,0 +1,5 @@
+"""High-level Model API (reference `python/paddle/hapi/model.py:1045`
+Model.fit/evaluate/predict/save/load, callbacks in hapi/callbacks.py)."""
+from .model import Model  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import Callback, EarlyStopping, LRScheduler, ModelCheckpoint, ProgBarLogger  # noqa: F401

@@ -1,0 +1,178 @@
+"""`paddle.Model` (reference `python/paddle/hapi/model.py:1045` fit, :1740).
+
+The dygraph/static dual-mode adapter collapses: train_batch is compiled
+whole via jit.TrainStep on first call (the TPU answer to hapi's static-mode
+speedup), so fit() gets compiled-step performance with eager ergonomics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..io import DataLoader
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self.stop_training = False
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
+            [metrics] if metrics else [])
+
+    # -- single-batch ops ------------------------------------------------------
+    def _compute_loss(self, outputs, labels):
+        if self._loss is None:
+            return outputs
+        return self._loss(outputs, *labels) if isinstance(labels, (list,
+                                                                   tuple)) \
+            else self._loss(outputs, labels)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        if self._train_step is None:
+            from .. import jit
+
+            def step(*args):
+                n_in = self._n_inputs
+                ins, labs = args[:n_in], args[n_in:]
+                out = self.network(*ins)
+                loss = self._compute_loss(out, list(labs) if len(labs) > 1
+                                          else labs[0])
+                loss.backward()
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                return loss
+
+            inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+            self._n_inputs = len(inputs_l)
+            self._train_step = jit.TrainStep(step, self.network,
+                                             self._optimizer)
+        inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels_l = labels if isinstance(labels, (list, tuple)) else (
+            [labels] if labels is not None else [])
+        loss = self._train_step(*inputs_l, *labels_l)
+        return [float(loss)]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        from ..core.autograd import no_grad
+
+        inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            out = self.network(*inputs_l)
+            res = {"loss": None}
+            if labels is not None and self._loss is not None:
+                res["loss"] = float(self._compute_loss(out, labels))
+        return out, res
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        from ..core.autograd import no_grad
+
+        inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            return self.network(*inputs_l)
+
+    # -- loops -----------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            **kwargs):
+        from .callbacks import CallbackList, ProgBarLogger
+
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last)
+        cbs = CallbackList((callbacks or []) +
+                           [ProgBarLogger(log_freq, verbose)])
+        for cb in cbs.callbacks:
+            cb.set_model(self)
+        cbs.on_train_begin()
+        history = []
+        for epoch in range(epochs):
+            if self.stop_training:
+                break
+            cbs.on_epoch_begin(epoch)
+            for step, batch in enumerate(loader):
+                *xs, y = batch if isinstance(batch, (list, tuple)) else [batch]
+                loss = self.train_batch(xs, y)
+                logs = {"loss": loss[0]}
+                cbs.on_train_batch_end(step, logs)
+            history.append(logs)
+            cbs.on_epoch_end(epoch, logs)
+            if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                cbs.on_eval_begin()
+                eval_logs = self.evaluate(eval_data, batch_size=batch_size,
+                                          verbose=0)
+                cbs.on_eval_end(eval_logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+        cbs.on_train_end()
+        return history
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            *xs, y = batch if isinstance(batch, (list, tuple)) else [batch]
+            out, res = self.eval_batch(xs, y)
+            if res["loss"] is not None:
+                losses.append(res["loss"])
+            for m in self._metrics:
+                m.update(m.compute(out, y) if hasattr(m, "compute") else out)
+        logs = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self._metrics:
+            logs[m.name()] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, callbacks=None, verbose=1):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        for batch in loader:
+            xs = batch[:-1] if isinstance(batch, (list, tuple)) and \
+                len(batch) > 1 else (batch if isinstance(batch, (list, tuple))
+                                     else [batch])
+            outs.append(self.predict_batch(xs).numpy())
+        if stack_outputs:
+            return [np.concatenate(outs)]
+        return [outs]
+
+    # -- persistence -----------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework import save
+
+        save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework import load
+
+        sd = load(path + ".pdparams")
+        self.network.set_state_dict(sd)
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        lines = [f"{type(self.network).__name__}: "
+                 f"{n_params:,} parameters"]
+        return "\n".join(lines)

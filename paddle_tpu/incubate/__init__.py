@@ -1,0 +1,4 @@
+"""paddle_tpu.incubate (reference `python/paddle/incubate/`)."""
+from . import autograd  # noqa: F401
+from . import nn  # noqa: F401
+from . import distributed  # noqa: F401

@@ -1,0 +1,127 @@
+"""Higher-order AD (reference `python/paddle/incubate/autograd/functional.py`
+vjp:22 / jvp:80, primapi forward_grad/grad).
+
+TPU re-design: these are direct surfaces over jax.vjp/jvp/jacobian — the
+reference's whole prim-op transform machinery (fluid/prim composite rules)
+exists to get transposable linearized programs, which JAX provides natively.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...core import autograd as _ag
+from ...core.tensor import Tensor
+
+__all__ = ["vjp", "jvp", "Jacobian", "Hessian", "jacobian", "hessian"]
+
+
+def _wrap_fn(func):
+    def pure(*arrays):
+        with _ag._scoped(False):
+            out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._data for o in out)
+        return out._data
+
+    return pure
+
+
+def _unwrap(xs):
+    if isinstance(xs, Tensor):
+        return xs._data
+    if isinstance(xs, (list, tuple)):
+        return tuple(_unwrap(x) for x in xs)
+    return jnp.asarray(xs)
+
+
+def _wrap(out):
+    if isinstance(out, tuple):
+        return tuple(_wrap(o) for o in out)
+    return Tensor(out)
+
+
+def vjp(func, xs, v=None):
+    """reference functional.py:22 — returns (outputs, vjp_result)."""
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_t]
+    out, pullback = jax.vjp(_wrap_fn(func), *arrays)
+    if v is None:
+        ct = jnp.ones_like(out) if not isinstance(out, tuple) else tuple(
+            jnp.ones_like(o) for o in out)
+    else:
+        ct = _unwrap(v)
+    grads = pullback(ct)
+    grads = grads if len(arrays) > 1 else grads
+    res = [_wrap(g) for g in grads]
+    return _wrap(out), res if len(res) > 1 else res[0]
+
+
+def jvp(func, xs, v=None):
+    """reference functional.py:80 — forward-mode, returns (outputs, jvp)."""
+    xs_t = xs if isinstance(xs, (list, tuple)) else [xs]
+    arrays = [_unwrap(x) for x in xs_t]
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        v_t = v if isinstance(v, (list, tuple)) else [v]
+        tangents = tuple(_unwrap(t) for t in v_t)
+    out, tangent_out = jax.jvp(_wrap_fn(func), tuple(arrays), tangents)
+    return _wrap(out), _wrap(tangent_out)
+
+
+class Jacobian:
+    """reference autograd.Jacobian — lazy full jacobian."""
+
+    def __init__(self, func, xs, is_batched=False):
+        self._func = func
+        self._xs = xs
+        arrays = _unwrap(xs if isinstance(xs, (list, tuple)) else [xs])
+        jac = jax.jacrev(self._wrap_first(func, len(arrays)))(*arrays)
+        self._jac = jac
+
+    @staticmethod
+    def _wrap_first(func, n):
+        def pure(*arrays):
+            with _ag._scoped(False):
+                out = func(*[Tensor(a) for a in arrays])
+            return out._data if isinstance(out, Tensor) else out[0]._data
+
+        return pure
+
+    def __getitem__(self, idx):
+        j = self._jac[0] if isinstance(self._jac, tuple) else self._jac
+        return Tensor(jnp.asarray(j))[idx]
+
+    @property
+    def shape(self):
+        j = self._jac[0] if isinstance(self._jac, tuple) else self._jac
+        return list(j.shape)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        arrays = _unwrap(xs if isinstance(xs, (list, tuple)) else [xs])
+        h = jax.hessian(Jacobian._wrap_first(func, len(arrays)))(*arrays)
+        self._h = h
+
+    def __getitem__(self, idx):
+        h = self._h[0] if isinstance(self._h, tuple) else self._h
+        if isinstance(h, tuple):
+            h = h[0]
+        return Tensor(jnp.asarray(h))[idx]
+
+    @property
+    def shape(self):
+        h = self._h
+        while isinstance(h, tuple):
+            h = h[0]
+        return list(h.shape)
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    return Jacobian(func, xs)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    return Hessian(func, xs)

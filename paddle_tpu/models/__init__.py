@@ -1,0 +1,11 @@
+"""Flagship model zoo (BASELINE configs): GPT / BERT / ERNIE."""
+from . import bert, ernie, gpt  # noqa: F401
+from .bert import (BertConfig, BertForPretraining,  # noqa: F401
+                   BertForSequenceClassification, BertModel,
+                   BertPretrainingCriterion, bert_base, bert_tiny)
+from .ernie import (ErnieConfig, ErnieForSequenceClassification,  # noqa: F401
+                    ErnieModel, build_static_inference_program,
+                    ernie_3p0_medium, ernie_tiny)
+from .gpt import (GPTConfig, GPTForPretraining, GPTModel,  # noqa: F401
+                  GPTPretrainingCriterion, gpt2_small, gpt3_1p3b, gpt3_6p7b,
+                  gpt_tiny)

@@ -1,0 +1,185 @@
+"""BERT family — BASELINE config 2 (BERT-base pretrain with fused attention).
+
+Reference model shape: `paddle.nn.TransformerEncoder`-based BERT as used in
+the reference's fused-attention benchmark path (incubate
+FusedTransformerEncoderLayer, fused_attention_op.cu). Here the encoder runs
+on the same flash-attention core (ops.pallas_ops) via nn.TransformerEncoder.
+"""
+from __future__ import annotations
+
+from .. import nn, ops
+from ..nn import functional as F
+from ..nn.initializer import Normal, TruncatedNormal
+
+
+class BertConfig:
+    PRESETS = {
+        "bert-tiny": dict(num_hidden_layers=2, num_attention_heads=2,
+                          hidden_size=128, intermediate_size=512),
+        "bert-base": dict(num_hidden_layers=12, num_attention_heads=12,
+                          hidden_size=768, intermediate_size=3072),
+        "bert-large": dict(num_hidden_layers=24, num_attention_heads=16,
+                           hidden_size=1024, intermediate_size=4096),
+    }
+
+    def __init__(self, vocab_size=30522, hidden_size=768,
+                 num_hidden_layers=12, num_attention_heads=12,
+                 intermediate_size=3072, hidden_act="gelu",
+                 hidden_dropout_prob=0.1, attention_probs_dropout_prob=0.1,
+                 max_position_embeddings=512, type_vocab_size=2,
+                 initializer_range=0.02, pad_token_id=0):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.num_hidden_layers = num_hidden_layers
+        self.num_attention_heads = num_attention_heads
+        self.intermediate_size = intermediate_size
+        self.hidden_act = hidden_act
+        self.hidden_dropout_prob = hidden_dropout_prob
+        self.attention_probs_dropout_prob = attention_probs_dropout_prob
+        self.max_position_embeddings = max_position_embeddings
+        self.type_vocab_size = type_vocab_size
+        self.initializer_range = initializer_range
+        self.pad_token_id = pad_token_id
+
+    @classmethod
+    def preset(cls, name, **overrides):
+        cfg = dict(cls.PRESETS[name])
+        cfg.update(overrides)
+        return cls(**cfg)
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        init = nn.ParamAttr(initializer=TruncatedNormal(
+            std=cfg.initializer_range))
+        self.word_embeddings = nn.Embedding(cfg.vocab_size, cfg.hidden_size,
+                                            weight_attr=init,
+                                            padding_idx=cfg.pad_token_id)
+        self.position_embeddings = nn.Embedding(cfg.max_position_embeddings,
+                                                cfg.hidden_size,
+                                                weight_attr=init)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size,
+                                                  weight_attr=init)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None):
+        T = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(0, T, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = ops.zeros_like(input_ids)
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertPooler(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.dense = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, hidden):
+        return ops.tanh(self.dense(hidden[:, 0]))
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads, cfg.intermediate_size,
+            dropout=cfg.hidden_dropout_prob, activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            act_dropout=0.0)
+        self.encoder = nn.TransformerEncoder(enc_layer, cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, T] 1/0 padding mask → additive [B, 1, 1, T]
+            attention_mask = (
+                (1.0 - attention_mask.cast("float32")) * -1e9
+            ).unsqueeze(1).unsqueeze(1)
+        x = self.embeddings(input_ids, token_type_ids, position_ids)
+        seq = self.encoder(x, attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertLMPredictionHead(nn.Layer):
+    def __init__(self, cfg: BertConfig, embedding_weights):
+        super().__init__()
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size, epsilon=1e-12)
+        self.decoder_weight = embedding_weights
+        self.decoder_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+        self.act = cfg.hidden_act
+
+    def forward(self, hidden):
+        h = getattr(F, self.act)(self.transform(hidden))
+        h = self.layer_norm(h)
+        return ops.matmul(h, self.decoder_weight,
+                          transpose_y=True) + self.decoder_bias
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP heads (reference bert pretraining fixture)."""
+
+    def __init__(self, bert: BertModel):
+        super().__init__()
+        self.bert = bert
+        cfg = bert.cfg
+        self.cls = BertLMPredictionHead(
+            cfg, bert.embeddings.word_embeddings.weight)
+        self.nsp = nn.Linear(cfg.hidden_size, 2)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        return self.cls(seq), self.nsp(pooled)
+
+
+class BertPretrainingCriterion(nn.Layer):
+    def __init__(self, vocab_size):
+        super().__init__()
+        self.vocab_size = vocab_size
+
+    def forward(self, prediction_scores, seq_relationship_score,
+                masked_lm_labels, next_sentence_labels, masked_lm_scale=1.0):
+        mlm = F.cross_entropy(
+            prediction_scores.reshape([-1, self.vocab_size]),
+            masked_lm_labels.reshape([-1]), reduction="mean",
+            ignore_index=-100)
+        nsp = F.cross_entropy(seq_relationship_score,
+                              next_sentence_labels.reshape([-1]))
+        return mlm + nsp
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, bert: BertModel, num_classes=2, dropout=None):
+        super().__init__()
+        self.bert = bert
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else bert.cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(bert.cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_base(**kw):
+    return BertModel(BertConfig.preset("bert-base", **kw))
+
+
+def bert_tiny(**kw):
+    return BertModel(BertConfig.preset("bert-tiny", **kw))

@@ -1,0 +1,72 @@
+"""ERNIE-3.0 style encoder — BASELINE config 5 (static-graph Executor
+inference path).
+
+Reference shape: ERNIE = BERT-style encoder with task-specific heads; the
+BASELINE config exercises the declarative Program/Executor path, so this
+module also provides `build_static_inference_program` which records the
+model into a static Program for `paddle_tpu.static.Executor` (whole-graph
+XLA compile — the AnalysisPredictor equivalent pipeline).
+"""
+from __future__ import annotations
+
+from .. import nn
+from .bert import BertConfig, BertModel
+
+
+class ErnieConfig(BertConfig):
+    PRESETS = {
+        "ernie-tiny": dict(num_hidden_layers=2, num_attention_heads=2,
+                           hidden_size=128, intermediate_size=512),
+        "ernie-3.0-medium": dict(num_hidden_layers=6, num_attention_heads=12,
+                                 hidden_size=768, intermediate_size=3072),
+        "ernie-3.0-base": dict(num_hidden_layers=12, num_attention_heads=12,
+                               hidden_size=768, intermediate_size=3072),
+        "ernie-3.0-xbase": dict(num_hidden_layers=20, num_attention_heads=16,
+                                hidden_size=1024, intermediate_size=4096),
+    }
+
+
+class ErnieModel(BertModel):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__(cfg)
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, ernie: ErnieModel, num_classes=2, dropout=None):
+        super().__init__()
+        self.ernie = ernie
+        self.dropout = nn.Dropout(dropout if dropout is not None
+                                  else ernie.cfg.hidden_dropout_prob)
+        self.classifier = nn.Linear(ernie.cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None):
+        _, pooled = self.ernie(input_ids, token_type_ids)
+        return self.classifier(self.dropout(pooled))
+
+
+def ernie_3p0_medium(**kw):
+    return ErnieModel(ErnieConfig.preset("ernie-3.0-medium", **kw))
+
+
+def ernie_tiny(**kw):
+    return ErnieModel(ErnieConfig.preset("ernie-tiny", **kw))
+
+
+def build_static_inference_program(model: nn.Layer, seq_len=128,
+                                   batch=None):
+    """Record `model` into a static Program for Executor inference
+    (BASELINE config 5). Returns (program, feed_names, fetch_var)."""
+    import paddle_tpu as paddle
+
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            input_ids = paddle.static.data(
+                "input_ids", [batch if batch else -1, seq_len], "int64")
+            model.eval()
+            out = model(input_ids)
+            fetch = out[1] if isinstance(out, tuple) else out
+        return prog, ["input_ids"], fetch
+    finally:
+        paddle.disable_static()

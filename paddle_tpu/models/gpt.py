@@ -1,0 +1,239 @@
+"""GPT model family — the flagship benchmark model.
+
+Reference: the GPT test fixture `python/paddle/fluid/tests/unittests/
+auto_parallel_gpt_model.py:625` (GPTModel/GPTForPretraining/
+GPTPretrainingCriterion) which is the model behind the north-star Fleet
+configs (BASELINE configs 3 & 4).
+
+TPU-first design decisions:
+  - attention runs through `scaled_dot_product_attention(is_causal=True)` →
+    Pallas flash kernel on TPU; no [T, T] mask materialization.
+  - hidden compute in bf16 (set dtype="bfloat16"), LN/softmax accumulate in
+    fp32 inside the kernels.
+  - TP/PP-ready: `mesh_axes` metadata on parameters lets the Fleet hybrid
+    engine shard QKV/FFN weights over the 'model'(='mp') axis and stack
+    blocks over 'pipe' (SURVEY §7 step 7).
+  - `use_recompute` wraps each block in `jax.checkpoint` (the reference's
+    fleet recompute, `fleet/recompute/recompute.py:69`).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+from .. import nn, ops
+from ..nn import functional as F
+from ..nn.initializer import Normal
+
+
+class GPTConfig:
+    PRESETS = {
+        "gpt2-tiny": dict(n_layer=2, n_head=4, d_model=128, seq_len=128),
+        "gpt2-small": dict(n_layer=12, n_head=12, d_model=768, seq_len=1024),
+        "gpt2-medium": dict(n_layer=24, n_head=16, d_model=1024, seq_len=1024),
+        "gpt2-large": dict(n_layer=36, n_head=20, d_model=1280, seq_len=1024),
+        "gpt3-1.3B": dict(n_layer=24, n_head=32, d_model=2048, seq_len=2048),
+        "gpt3-2.7B": dict(n_layer=32, n_head=32, d_model=2560, seq_len=2048),
+        "gpt3-6.7B": dict(n_layer=32, n_head=32, d_model=4096, seq_len=2048),
+    }
+
+    def __init__(self, vocab_size=50304, n_layer=12, n_head=12, d_model=768,
+                 seq_len=1024, d_ff=None, dropout=0.0, attn_dropout=0.0,
+                 dtype="float32", use_recompute=False, initializer_range=0.02):
+        self.vocab_size = vocab_size
+        self.n_layer = n_layer
+        self.n_head = n_head
+        self.d_model = d_model
+        self.seq_len = seq_len
+        self.d_ff = d_ff or 4 * d_model
+        self.dropout = dropout
+        self.attn_dropout = attn_dropout
+        self.dtype = dtype
+        self.use_recompute = use_recompute
+        self.initializer_range = initializer_range
+
+    @classmethod
+    def preset(cls, name, **overrides):
+        cfg = dict(cls.PRESETS[name])
+        cfg.update(overrides)
+        return cls(**cfg)
+
+    def num_params(self):
+        d, L, V = self.d_model, self.n_layer, self.vocab_size
+        return V * d + self.seq_len * d + L * (12 * d * d + 13 * d) + 2 * d
+
+    def flops_per_token(self):
+        """Training FLOPs/token ≈ 6N + attention term (scaling-book rule)."""
+        N = self.num_params() - self.vocab_size * self.d_model
+        return 6 * N + 12 * self.n_layer * self.d_model * self.seq_len
+
+
+class GPTAttention(nn.Layer):
+    """Causal self-attention; fused QKV projection (single MXU matmul)."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        d, h = cfg.d_model, cfg.n_head
+        self.n_head = h
+        self.head_dim = d // h
+        init = Normal(0.0, cfg.initializer_range)
+        out_init = Normal(0.0, cfg.initializer_range / math.sqrt(2 * cfg.n_layer))
+        self.qkv_proj = nn.Linear(d, 3 * d,
+                                  weight_attr=nn.ParamAttr(initializer=init))
+        self.out_proj = nn.Linear(d, d,
+                                  weight_attr=nn.ParamAttr(initializer=out_init))
+        self.dropout_p = cfg.attn_dropout
+        # TP metadata: qkv column-sharded, out row-sharded over 'mp'
+        self.qkv_proj.weight.sharding_spec = (None, "mp")
+        self.out_proj.weight.sharding_spec = ("mp", None)
+
+    def forward(self, x, cache=None):
+        B, T, D = x.shape
+        qkv = self.qkv_proj(x).reshape([B, T, 3, self.n_head, self.head_dim])
+        q, k, v = ops.unbind(qkv, axis=2)
+        if cache is not None:
+            k = ops.concat([cache[0], k], axis=1)
+            v = ops.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=False, dropout_p=self.dropout_p,
+                training=self.training)
+        else:
+            new_cache = None
+            out = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, dropout_p=self.dropout_p,
+                training=self.training)
+        out = self.out_proj(out.reshape([B, T, D]))
+        return out if new_cache is None else (out, new_cache)
+
+
+class GPTMLP(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = Normal(0.0, cfg.initializer_range)
+        out_init = Normal(0.0, cfg.initializer_range / math.sqrt(2 * cfg.n_layer))
+        self.fc1 = nn.Linear(cfg.d_model, cfg.d_ff,
+                             weight_attr=nn.ParamAttr(initializer=init))
+        self.fc2 = nn.Linear(cfg.d_ff, cfg.d_model,
+                             weight_attr=nn.ParamAttr(initializer=out_init))
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.fc1.weight.sharding_spec = (None, "mp")
+        self.fc2.weight.sharding_spec = ("mp", None)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x), approximate=True)))
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln1 = nn.LayerNorm(cfg.d_model)
+        self.attn = GPTAttention(cfg)
+        self.ln2 = nn.LayerNorm(cfg.d_model)
+        self.mlp = GPTMLP(cfg)
+        self.dropout = nn.Dropout(cfg.dropout)
+        self._recompute = cfg.use_recompute
+
+    def _forward(self, x):
+        x = x + self.dropout(self.attn(self.ln1(x)))
+        return x + self.mlp(self.ln2(x))
+
+    def forward(self, x, cache=None):
+        if cache is not None:
+            a, new_cache = self.attn(self.ln1(x), cache=cache)
+            x = x + self.dropout(a)
+            return x + self.mlp(self.ln2(x)), new_cache
+        if self._recompute and self.training:
+            from ..distributed.fleet.utils import recompute
+
+            return recompute(self._forward, x, layer=self)
+        return self._forward(x)
+
+
+class GPTEmbeddings(nn.Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        init = Normal(0.0, cfg.initializer_range)
+        self.word_embeddings = nn.Embedding(
+            cfg.vocab_size, cfg.d_model,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.position_embeddings = nn.Embedding(
+            cfg.seq_len, cfg.d_model,
+            weight_attr=nn.ParamAttr(initializer=init))
+        self.dropout = nn.Dropout(cfg.dropout)
+        self.word_embeddings.weight.sharding_spec = ("mp", None)
+
+    def forward(self, input_ids, position_ids=None):
+        T = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = ops.arange(0, T, dtype="int64").unsqueeze(0)
+        return self.dropout(self.word_embeddings(input_ids) +
+                            self.position_embeddings(position_ids))
+
+
+class GPTModel(nn.Layer):
+    """Reference auto_parallel_gpt_model.py GPTModel equivalent."""
+
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.embeddings = GPTEmbeddings(cfg)
+        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.n_layer)])
+        self.ln_f = nn.LayerNorm(cfg.d_model)
+        if cfg.dtype != "float32":
+            self.to(dtype=cfg.dtype)
+
+    def forward(self, input_ids, position_ids=None, caches=None):
+        x = self.embeddings(input_ids, position_ids)
+        if caches is not None:
+            new_caches = []
+            for blk, c in zip(self.blocks, caches):
+                x, nc = blk(x, cache=c)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForPretraining(nn.Layer):
+    """LM head tied to word embeddings (reference GPTForPretraining)."""
+
+    def __init__(self, model: GPTModel):
+        super().__init__()
+        self.gpt = model
+
+    def forward(self, input_ids, position_ids=None):
+        x = self.gpt(input_ids, position_ids)
+        w = self.gpt.embeddings.word_embeddings.weight
+        return ops.matmul(x, w, transpose_y=True)
+
+
+class GPTPretrainingCriterion(nn.Layer):
+    def __init__(self):
+        super().__init__()
+
+    def forward(self, logits, labels, loss_mask=None):
+        loss = F.cross_entropy(logits.reshape([-1, logits.shape[-1]]),
+                               labels.reshape([-1]), reduction="none")
+        if loss_mask is not None:
+            m = loss_mask.reshape([-1])
+            return (loss * m).sum() / ops.clip(m.sum(), min=1.0)
+        return loss.mean()
+
+
+def gpt_tiny(**kw):
+    return GPTForPretraining(GPTModel(GPTConfig.preset("gpt2-tiny", **kw)))
+
+
+def gpt2_small(**kw):
+    return GPTForPretraining(GPTModel(GPTConfig.preset("gpt2-small", **kw)))
+
+
+def gpt3_1p3b(**kw):
+    return GPTForPretraining(GPTModel(GPTConfig.preset("gpt3-1.3B", **kw)))
+
+
+def gpt3_6p7b(**kw):
+    return GPTForPretraining(GPTModel(GPTConfig.preset("gpt3-6.7B", **kw)))

@@ -1,0 +1,109 @@
+"""Gradient clipping (reference `python/paddle/fluid/clip.py`:
+ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm).
+
+Operates on (param, grad) lists functionally; under a jitted train step the
+global-norm reduction fuses into the optimizer update."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._clip(params_grads)
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(-max if min is None else min)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, forward(
+                lambda a: jnp.clip(a, self.min, self.max), (g,),
+                name="clip_by_value")))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        c = self.clip_norm
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, forward(
+                lambda a: a * jnp.minimum(1.0, c / jnp.maximum(
+                    jnp.sqrt(jnp.sum(jnp.square(a))), 1e-12)),
+                (g,), name="clip_by_norm")))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Reference fluid/clip.py ClipGradByGlobalNorm. In hybrid-parallel mode
+    the HybridParallelOptimizer wraps this with cross-group norm reduction
+    (fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:51);
+    under SPMD jit the psum over the mesh happens automatically when grads are
+    sharded."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        grads = [g for p, g in params_grads
+                 if g is not None and getattr(p, "need_clip", True)]
+        if not grads:
+            return params_grads
+        c = self.clip_norm
+
+        def gnorm(*gs):
+            return jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                                for g in gs))
+
+        norm = forward(gnorm, tuple(grads), name="global_norm")
+
+        def scale(g, n):
+            return (g.astype(jnp.float32) * jnp.minimum(
+                1.0, c / jnp.maximum(n, 1e-6))).astype(g.dtype)
+
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, forward(scale, (g, norm), name="clip_scale")))
+        return out
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    pg = [(p, p.grad) for p in parameters if p.grad is not None]
+    clipped = ClipGradByGlobalNorm(max_norm)._clip(pg)
+    for (p, _), (_, g) in zip(pg, clipped):
+        p.grad = g
+    return None
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    pg = [(p, p.grad) for p in parameters if p.grad is not None]
+    for (p, _), (_, g) in zip(pg, ClipGradByValue(clip_value)._clip(pg)):
+        p.grad = g

@@ -1,0 +1,9 @@
+"""`paddle.nn.functional` namespace (reference `python/paddle/nn/functional/`).
+
+Thin re-export of the functional op library — activations, conv/pool/norm,
+losses, attention. One namespace, all XLA-lowered."""
+from ...ops.activation import *  # noqa: F401,F403
+from ...ops.nn_ops import *  # noqa: F401,F403
+from ...ops.manipulation import pad  # noqa: F401
+from ...ops.creation import one_hot  # noqa: F401
+from ...ops.math import sum as _sum  # noqa: F401

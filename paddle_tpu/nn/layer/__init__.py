@@ -1,0 +1,3 @@
+from . import (activation, common, container, conv, layers, loss, norm,  # noqa: F401
+               pooling, rnn, transformer)
+from .layers import Layer  # noqa: F401

@@ -1,0 +1,116 @@
+"""Conv layers (reference `python/paddle/nn/layer/conv.py`).
+
+Weight layout matches the reference: [out_c, in_c/groups, *kernel] for conv,
+[in_c, out_c/groups, *kernel] for transpose. XLA maps these onto the MXU as
+implicit GEMMs — no cuDNN algo search, no workspace management."""
+from __future__ import annotations
+
+import numpy as np
+
+from ...ops import nn_ops as F
+from ..initializer import KaimingUniform, Uniform
+from .layers import Layer
+
+__all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
+           "Conv3DTranspose"]
+
+
+class _ConvNd(Layer):
+    def __init__(self, n, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW",
+                 transpose=False, output_padding=0):
+        super().__init__()
+        self._n = n
+        ks = kernel_size if isinstance(kernel_size, (list, tuple)) \
+            else [kernel_size] * n
+        self._stride = stride
+        self._padding = padding
+        self._dilation = dilation
+        self._groups = groups
+        self._data_format = data_format
+        self._transpose = transpose
+        self._output_padding = output_padding
+        if transpose:
+            w_shape = [in_channels, out_channels // groups, *ks]
+        else:
+            w_shape = [out_channels, in_channels // groups, *ks]
+        fan_in = in_channels * int(np.prod(ks)) // groups
+        self.weight = self.create_parameter(
+            w_shape, attr=weight_attr,
+            default_initializer=KaimingUniform(fan_in=fan_in))
+        bound = 1.0 / np.sqrt(fan_in)
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, x):
+        if self._transpose:
+            fn = {1: F.conv1d_transpose, 2: F.conv2d_transpose,
+                  3: F.conv3d_transpose}[self._n]
+            return fn(x, self.weight, self.bias, stride=self._stride,
+                      padding=self._padding,
+                      output_padding=self._output_padding,
+                      groups=self._groups, dilation=self._dilation,
+                      data_format=self._data_format)
+        fn = {1: F.conv1d, 2: F.conv2d, 3: F.conv3d}[self._n]
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups, data_format=self._data_format)
+
+
+class Conv1D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(1, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+
+class Conv2D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(2, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+
+class Conv3D(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, padding_mode, weight_attr,
+                         bias_attr, data_format)
+
+
+class Conv1DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCL"):
+        super().__init__(1, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+
+class Conv2DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCHW"):
+        super().__init__(2, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)
+
+
+class Conv3DTranspose(_ConvNd):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, output_padding=0, groups=1, dilation=1,
+                 weight_attr=None, bias_attr=None, data_format="NCDHW"):
+        super().__init__(3, in_channels, out_channels, kernel_size, stride,
+                         padding, dilation, groups, "zeros", weight_attr,
+                         bias_attr, data_format, transpose=True,
+                         output_padding=output_padding)

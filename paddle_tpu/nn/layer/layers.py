@@ -1,0 +1,298 @@
+"""nn.Layer — module base class.
+
+Reference: `python/paddle/nn/layer/layers.py` (class Layer): parameter/buffer/
+sublayer registries, hooks, train/eval, state_dict. Unchanged in spirit — this
+layer of the stack is framework-agnostic Python; what differs on TPU is below
+it (ops dispatch to XLA, parameters carry sharding annotations for pjit).
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+from ...core import dtype as dtypes
+from ...core.tensor import Parameter, Tensor
+from ..initializer import Constant, ParamAttr, XavierUniform
+
+
+class HookRemoveHelper:
+    def __init__(self, hooks, idx):
+        self._hooks = hooks
+        self._idx = idx
+
+    def remove(self):
+        self._hooks.pop(self._idx, None)
+
+
+class Layer:
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = dtype
+        self._parameters = OrderedDict()
+        self._buffers = OrderedDict()
+        self._sub_layers = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._forward_post_hooks = OrderedDict()
+        self._hook_id = 0
+        self._name_scope = name_scope or self.__class__.__name__.lower()
+
+    # -- construction ---------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None):
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = dtype or self._dtype or dtypes.get_default_dtype()
+        init = attr.initializer or default_initializer or (
+            Constant(0.0) if is_bias else XavierUniform())
+        data = init(tuple(int(s) for s in shape), dtype)
+        p = Parameter(data, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = getattr(attr, "need_clip", True)
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        t = Tensor(np.zeros([0], dtypes.convert_dtype(dtype)))
+        t.name = name
+        return t
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def add_parameter(self, name, parameter):
+        self._parameters[str(name)] = parameter
+        return parameter
+
+    def register_buffer(self, name, tensor, persistable=True):
+        if tensor is not None and not isinstance(tensor, Tensor):
+            tensor = Tensor(tensor)
+        if tensor is not None:
+            tensor.persistable = persistable
+        self._buffers[str(name)] = tensor
+        return tensor
+
+    # -- attribute routing (layers.py __setattr__) ----------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter) and params is not None:
+            params[name] = value
+            for d in (layers, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer) and layers is not None:
+            layers[name] = value
+            for d in (params, buffers):
+                if d is not None:
+                    d.pop(name, None)
+            self.__dict__.pop(name, None)
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        elif params is not None and name in params and value is None:
+            params.pop(name)
+            object.__setattr__(self, name, None)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    # -- traversal ------------------------------------------------------------
+    def children(self):
+        yield from self._sub_layers.values()
+
+    def named_children(self):
+        yield from self._sub_layers.items()
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        layers_set = layers_set if layers_set is not None else set()
+        if include_self and id(self) not in layers_set:
+            layers_set.add(id(self))
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None or id(sub) in layers_set:
+                continue
+            layers_set.add(id(sub))
+            p = f"{prefix}.{name}" if prefix else name
+            yield p, sub
+            yield from sub.named_sublayers(prefix=p, include_self=False,
+                                           layers_set=layers_set)
+
+    def parameters(self, include_sublayers=True):
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        seen = set()
+        stack = [(prefix, self)]
+        layers = [(prefix, self)]
+        if include_sublayers:
+            layers += [(f"{prefix}.{n}" if prefix else n, l)
+                       for n, l in self.named_sublayers()]
+        for lp, layer in layers:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{lp}.{name}" if lp else name), p
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        layers = [(prefix, self)]
+        if include_sublayers:
+            layers += [(f"{prefix}.{n}" if prefix else n, l)
+                       for n, l in self.named_sublayers()]
+        for lp, layer in layers:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{lp}.{name}" if lp else name), b
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- modes ----------------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # -- hooks ----------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        self._hook_id += 1
+        self._forward_pre_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, self._hook_id)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_id += 1
+        self._forward_post_hooks[self._hook_id] = hook
+        return HookRemoveHelper(self._forward_post_hooks, self._hook_id)
+
+    # -- call -----------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            out = hook(self, inputs)
+            if out is not None:
+                inputs = out if isinstance(out, tuple) else (out,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, inputs, outputs)
+            if res is not None:
+                outputs = res
+        return outputs
+
+    # -- state ----------------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix.rstrip("."),
+                                             include_sublayers=include_sublayers):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix.rstrip("."),
+                                          include_sublayers=include_sublayers):
+            if b is not None and b.persistable:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+                t.set_value(arr)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    set_dict = set_state_dict
+    load_dict = set_state_dict
+
+    # -- dtype / device movement ---------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        import jax
+
+        from ...core.place import Place, jax_device
+        for t in list(self.state_dict().values()):
+            if dtype is not None and t.dtype.is_floating_point():
+                t._data = t._data.astype(dtypes.convert_dtype(dtype))
+            if device is not None:
+                p = device if isinstance(device, Place) else None
+                if p is None:
+                    d = device if isinstance(device, str) else "tpu"
+                    p = Place("cpu", 0) if d == "cpu" else Place("tpu", 0)
+                t._data = jax.device_put(t._data, jax_device(p))
+        if dtype is not None:
+            self._dtype = dtypes.to_paddle_dtype(dtype).name
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def half(self):
+        return self.to(dtype="float16")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_gradient()
+
+    def full_name(self):
+        return self._name_scope
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [extra] if extra else []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            lines.append(f"({name}): " + "\n  ".join(sub_repr))
+        body = "\n  ".join(lines)
+        return f"{type(self).__name__}({body and chr(10) + '  ' + body + chr(10)})"

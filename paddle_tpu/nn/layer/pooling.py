@@ -1,0 +1,92 @@
+"""Pooling layers (reference `python/paddle/nn/layer/pooling.py`)."""
+from __future__ import annotations
+
+from ...ops import nn_ops as F
+from .layers import Layer
+
+__all__ = ["MaxPool1D", "MaxPool2D", "MaxPool3D", "AvgPool1D", "AvgPool2D",
+           "AvgPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool2D"]
+
+
+class _Pool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 **kwargs):
+        super().__init__()
+        self.kernel_size = kernel_size
+        self.stride = stride
+        self.padding = padding
+        self.ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return type(self)._fn(x, self.kernel_size, self.stride, self.padding,
+                              ceil_mode=self.ceil_mode)
+
+
+class MaxPool1D(_Pool):
+    _fn = staticmethod(lambda x, k, s, p, ceil_mode=False: F.max_pool1d(
+        x, k, s, p, ceil_mode=ceil_mode))
+
+
+class MaxPool2D(_Pool):
+    _fn = staticmethod(lambda x, k, s, p, ceil_mode=False: F.max_pool2d(
+        x, k, s, p, ceil_mode=ceil_mode))
+
+
+class MaxPool3D(_Pool):
+    _fn = staticmethod(lambda x, k, s, p, ceil_mode=False: F.max_pool3d(
+        x, k, s, p, ceil_mode=ceil_mode))
+
+
+class AvgPool1D(_Pool):
+    _fn = staticmethod(lambda x, k, s, p, ceil_mode=False: F.avg_pool1d(
+        x, k, s, p, ceil_mode=ceil_mode))
+
+
+class AvgPool2D(_Pool):
+    _fn = staticmethod(lambda x, k, s, p, ceil_mode=False: F.avg_pool2d(
+        x, k, s, p, ceil_mode=ceil_mode))
+
+
+class AvgPool3D(_Pool):
+    _fn = staticmethod(lambda x, k, s, p, ceil_mode=False: F.avg_pool3d(
+        x, k, s, p, ceil_mode=ceil_mode))
+
+
+class AdaptiveAvgPool1D(Layer):
+    def __init__(self, output_size, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool1d(x, self.output_size)
+
+
+class AdaptiveAvgPool2D(Layer):
+    def __init__(self, output_size, data_format="NCHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.adaptive_avg_pool2d(x, self.output_size, self.data_format)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_avg_pool3d(x, self.output_size)
+
+
+class AdaptiveMaxPool2D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+
+    def forward(self, x):
+        return F.adaptive_max_pool2d(x, self.output_size)

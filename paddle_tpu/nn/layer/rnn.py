@@ -1,0 +1,334 @@
+"""Recurrent layers: SimpleRNN / LSTM / GRU + cells.
+
+Reference: `python/paddle/nn/layer/rnn.py` (RNNBase → cudnn rnn_op or a
+Python while-loop). TPU re-design: the time loop is a `jax.lax.scan`, which
+XLA compiles into a single fused loop on-device — the idiomatic replacement
+for cuDNN's fused RNN kernels. Weight layout matches the reference
+(weight_ih_l{k}: [gates*H, I], weight_hh_l{k}: [gates*H, H]).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...core.dispatch import forward as _fwd
+from ...core.tensor import Tensor
+from ..initializer import Uniform
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "SimpleRNN", "LSTM", "GRU"]
+
+
+def _cell_step(mode, x, h, w_ih, w_hh, b_ih, b_hh):
+    if mode == "LSTM":
+        hx, cx = h
+        gates = x @ w_ih.T + hx @ w_hh.T
+        if b_ih is not None:
+            gates = gates + b_ih + b_hh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+        g = jnp.tanh(g)
+        c = f * cx + i * g
+        hy = o * jnp.tanh(c)
+        return (hy, c), hy
+    if mode == "GRU":
+        gi = x @ w_ih.T
+        gh = h @ w_hh.T
+        if b_ih is not None:
+            gi = gi + b_ih
+            gh = gh + b_hh
+        ir, iz, inn = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(inn + r * hn)
+        hy = (1 - z) * n + z * h
+        return hy, hy
+    act = jnp.tanh if mode == "RNN_TANH" else jax.nn.relu
+    pre = x @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        pre = pre + b_ih + b_hh
+    hy = act(pre)
+    return hy, hy
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32",
+                           init_value=0.0, batch_dim_idx=0):
+        B = batch_ref.shape[batch_dim_idx]
+        from ... import ops
+
+        return ops.full([B, self.hidden_size], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+        mode = self.mode
+
+        def f(x, h, wi, wh, bi, bh):
+            new, out = _cell_step(mode, x, h, wi, wh, bi, bh)
+            return out, new
+
+        out, new = _fwd(f, (inputs, states, self.weight_ih, self.weight_hh,
+                            self.bias_ih, self.bias_hh), name="rnn_cell")
+        return out, new
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([4 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([4 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs, dtype=inputs.dtype)
+            states = (h, h)
+
+        def f(x, hx, cx, wi, wh, bi, bh):
+            (hy, cy), _ = _cell_step("LSTM", x, (hx, cx), wi, wh, bi, bh)
+            return hy, cy
+
+        hy, cy = _fwd(f, (inputs, states[0], states[1], self.weight_ih,
+                          self.weight_hh, self.bias_ih, self.bias_hh),
+                      name="lstm_cell")
+        return hy, (hy, cy)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size],
+                                               weight_ih_attr,
+                                               default_initializer=init)
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size],
+                                               weight_hh_attr,
+                                               default_initializer=init)
+        self.bias_ih = self.create_parameter([3 * hidden_size], bias_ih_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+        self.bias_hh = self.create_parameter([3 * hidden_size], bias_hh_attr,
+                                             is_bias=True,
+                                             default_initializer=init)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, dtype=inputs.dtype)
+
+        def f(x, h, wi, wh, bi, bh):
+            hy, _ = _cell_step("GRU", x, h, wi, wh, bi, bh)
+            return hy
+
+        hy = _fwd(f, (inputs, states, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh), name="gru_cell")
+        return hy, hy
+
+
+class RNN(Layer):
+    """Generic RNN wrapper running a cell over time (reference rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        outputs = []
+        T = inputs.shape[0 if self.time_major else 1]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        for t in steps:
+            x = inputs[t] if self.time_major else inputs[:, t]
+            out, states = self.cell(x, states)
+            outputs.append(out)
+        if self.is_reverse:
+            outputs = outputs[::-1]
+        from ... import ops
+
+        out = ops.stack(outputs, axis=0 if self.time_major else 1)
+        return out, states
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        self.num_directions = num_dirs
+        g = {"LSTM": 4, "GRU": 3}.get(mode, 1)
+        std = 1.0 / math.sqrt(hidden_size)
+        init = Uniform(-std, std)
+        for l in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if l == 0 else hidden_size * num_dirs
+                sfx = f"_l{l}" + ("_reverse" if d else "")
+                self.add_parameter(
+                    "weight_ih" + sfx,
+                    self.create_parameter([g * hidden_size, in_sz],
+                                          weight_ih_attr,
+                                          default_initializer=init))
+                self.add_parameter(
+                    "weight_hh" + sfx,
+                    self.create_parameter([g * hidden_size, hidden_size],
+                                          weight_hh_attr,
+                                          default_initializer=init))
+                self.add_parameter(
+                    "bias_ih" + sfx,
+                    self.create_parameter([g * hidden_size], bias_ih_attr,
+                                          is_bias=True,
+                                          default_initializer=init))
+                self.add_parameter(
+                    "bias_hh" + sfx,
+                    self.create_parameter([g * hidden_size], bias_hh_attr,
+                                          is_bias=True,
+                                          default_initializer=init))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = self.mode
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        is_lstm = mode == "LSTM"
+        time_major = self.time_major
+        params = []
+        for l in range(L):
+            for d in range(D):
+                sfx = f"_l{l}" + ("_reverse" if d else "")
+                params += [getattr(self, "weight_ih" + sfx),
+                           getattr(self, "weight_hh" + sfx),
+                           getattr(self, "bias_ih" + sfx),
+                           getattr(self, "bias_hh" + sfx)]
+
+        def f(x, h0, c0, *ws):
+            xt = x if time_major else jnp.swapaxes(x, 0, 1)  # [T, B, I]
+            layer_in = xt
+            h_outs, c_outs = [], []
+            for l in range(L):
+                dir_outs = []
+                for d in range(D):
+                    wi, wh, bi, bh = ws[(l * D + d) * 4:(l * D + d) * 4 + 4]
+                    h_init = h0[l * D + d]
+                    state0 = (h_init, c0[l * D + d]) if is_lstm else h_init
+
+                    def step(carry, x_t, wi=wi, wh=wh, bi=bi, bh=bh):
+                        new, out = _cell_step(mode, x_t, carry, wi, wh, bi, bh)
+                        return new, out
+
+                    seq = jnp.flip(layer_in, 0) if d == 1 else layer_in
+                    final, outs = jax.lax.scan(step, state0, seq)
+                    if d == 1:
+                        outs = jnp.flip(outs, 0)
+                    dir_outs.append(outs)
+                    if is_lstm:
+                        h_outs.append(final[0])
+                        c_outs.append(final[1])
+                    else:
+                        h_outs.append(final)
+                layer_in = jnp.concatenate(dir_outs, axis=-1) if D == 2 \
+                    else dir_outs[0]
+            out = layer_in if time_major else jnp.swapaxes(layer_in, 0, 1)
+            h_stack = jnp.stack(h_outs)
+            if is_lstm:
+                return out, h_stack, jnp.stack(c_outs)
+            return out, h_stack
+
+        B = inputs.shape[1 if time_major else 0]
+        from ... import ops
+
+        if initial_states is None:
+            zeros = ops.zeros([L * D, B, H], inputs.dtype)
+            h0, c0 = zeros, zeros
+        elif is_lstm:
+            h0, c0 = initial_states
+        else:
+            h0, c0 = initial_states, ops.zeros([L * D, B, H], inputs.dtype)
+
+        outs = _fwd(f, (inputs, h0, c0, *params), name=mode.lower())
+        if is_lstm:
+            out, h, c = outs
+            return out, (h, c)
+        out, h = outs
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", *args, **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, *args, **kwargs)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 *args, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, *args, **kwargs)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 *args, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, *args, **kwargs)

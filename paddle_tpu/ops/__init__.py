@@ -1,0 +1,22 @@
+"""Functional op library (the PHI-equivalent layer).
+
+Single flat namespace like the reference's `paddle.*` tensor API
+(`python/paddle/tensor/__init__.py` re-exports). Importing this module also
+monkey-patches Tensor methods (reference: monkey_patch_varbase /
+`python/paddle/fluid/dygraph/math_op_patch.py`).
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .activation import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+from .nn_ops import *  # noqa: F401,F403
+
+from . import creation, math, manipulation, logic, linalg, activation, \
+    random_ops, nn_ops, pallas_ops  # noqa: F401
+
+from .methods import _patch_tensor_methods
+
+_patch_tensor_methods()

@@ -1,0 +1,183 @@
+"""Activation functions (functional).
+
+Parity surface: `python/paddle/nn/functional/activation.py`; reference kernels
+`phi/kernels/{cpu,gpu}/activation_kernel.*`. All are single fused XLA
+elementwise ops — on TPU, XLA fuses them into neighboring matmuls, which is
+what the reference's hand-written fused epilogues did manually.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "sigmoid", "silu", "swish", "softmax",
+    "softmax_", "log_softmax", "tanh", "tanh_", "leaky_relu", "elu", "selu",
+    "celu", "prelu", "softplus", "softsign", "mish", "hardshrink",
+    "hardsigmoid", "hardswish", "hardtanh", "softshrink", "tanhshrink",
+    "thresholded_relu", "log_sigmoid", "maxout", "glu", "rrelu",
+    "swiglu",
+]
+
+
+def _u(name, jfn):
+    def op(x, name=None):
+        return forward(jfn, (x,), name=_n)
+    _n = name
+    op.__name__ = name
+    return op
+
+
+relu = _u("relu", jax.nn.relu)
+relu6 = _u("relu6", jax.nn.relu6)
+sigmoid = _u("sigmoid", jax.nn.sigmoid)
+silu = _u("silu", jax.nn.silu)
+tanh = _u("tanh", jnp.tanh)
+softsign = _u("softsign", jax.nn.soft_sign)
+log_sigmoid = _u("log_sigmoid", jax.nn.log_sigmoid)
+mish = _u("mish", jax.nn.mish)
+
+
+def relu_(x, name=None):
+    return x._rebind(relu(x))
+
+
+def tanh_(x, name=None):
+    return x._rebind(tanh(x))
+
+
+def gelu(x, approximate=False, name=None):
+    return forward(lambda a: jax.nn.gelu(a, approximate=approximate), (x,),
+                   name="gelu")
+
+
+def swish(x, name=None):
+    return forward(jax.nn.silu, (x,), name="swish")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ..core import dtype as dtypes
+            a = a.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return forward(f, (x,), name="softmax")
+
+
+def softmax_(x, axis=-1, dtype=None, name=None):
+    return x._rebind(softmax(x, axis, dtype))
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ..core import dtype as dtypes
+            a = a.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return forward(f, (x,), name="log_softmax")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return forward(lambda a: jax.nn.leaky_relu(a, negative_slope), (x,),
+                   name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return forward(lambda a: jax.nn.elu(a, alpha), (x,), name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return forward(lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)),
+                   (x,), name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return forward(lambda a: jax.nn.celu(a, alpha), (x,), name="celu")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            ww = w.reshape(())
+        else:
+            shape = [1] * a.ndim
+            ch = 1 if data_format == "NCHW" else a.ndim - 1
+            shape[ch] = w.size
+            ww = w.reshape(shape)
+        return jnp.where(a > 0, a, ww * a)
+    return forward(f, (x, weight), name="prelu")
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return forward(
+        lambda a: jnp.where(a * beta > threshold, a,
+                            jnp.log1p(jnp.exp(beta * a)) / beta),
+        (x,), name="softplus")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return forward(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0), (x,),
+                   name="hardshrink")
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return forward(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), (x,),
+                   name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return forward(lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, (x,),
+                   name="hardswish")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return forward(lambda a: jnp.clip(a, min, max), (x,), name="hardtanh")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return forward(
+        lambda a: jnp.where(a > threshold, a - threshold,
+                            jnp.where(a < -threshold, a + threshold, 0.0)),
+        (x,), name="softshrink")
+
+
+def tanhshrink(x, name=None):
+    return forward(lambda a: a - jnp.tanh(a), (x,), name="tanhshrink")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return forward(lambda a: jnp.where(a > threshold, a, 0.0), (x,),
+                   name="thresholded_relu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (groups, c // groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return forward(f, (x,), name="maxout")
+
+
+def glu(x, axis=-1, name=None):
+    return forward(lambda a: jax.nn.glu(a, axis=axis), (x,), name="glu")
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        return forward(lambda a: jax.nn.silu(a[..., : a.shape[-1] // 2]) *
+                       a[..., a.shape[-1] // 2:], (x,), name="swiglu")
+    return forward(lambda a, b: jax.nn.silu(a) * b, (x, y), name="swiglu")
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=True, name=None):
+    if not training:
+        return leaky_relu(x, (lower + upper) / 2)
+    from ..core import random as prandom
+    return forward(
+        lambda k, a: jnp.where(
+            a >= 0, a,
+            a * jax.random.uniform(k, a.shape, a.dtype, lower, upper)),
+        (prandom.split_key(), x), name="rrelu")

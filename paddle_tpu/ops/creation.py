@@ -1,0 +1,190 @@
+"""Tensor creation ops.
+
+Parity surface: `python/paddle/tensor/creation.py` in the reference. On TPU
+these lower to XLA constants/iota; placement follows the current Place
+(`paddle.set_device`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import random as prandom
+from ..core.dispatch import forward, unwrap
+from ..core.place import jax_device
+from ..core.tensor import Tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "zeros_like", "ones_like",
+    "full_like", "empty", "empty_like", "arange", "linspace", "logspace",
+    "eye", "tril", "triu", "diag", "diagflat", "meshgrid", "assign",
+    "clone", "one_hot", "tril_indices", "triu_indices", "complex",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in shape.numpy().tolist())
+    return tuple(int(unwrap(s)) if not isinstance(s, (int, np.integer)) else int(s)
+                 for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    return Tensor(data, dtype=dtype, place=place, stop_gradient=stop_gradient)
+
+
+def _device_const(arr):
+    try:
+        return jax.device_put(arr, jax_device())
+    except Exception:
+        return arr
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(_device_const(jnp.zeros(_shape(shape), dtypes.convert_dtype(dtype))))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(_device_const(jnp.ones(_shape(shape), dtypes.convert_dtype(dtype))))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    return Tensor(_device_const(
+        jnp.full(_shape(shape), fill_value, dtypes.convert_dtype(dtype))))
+
+
+def zeros_like(x, dtype=None, name=None):
+    d = None if dtype is None else dtypes.convert_dtype(dtype)
+    return forward(lambda a: jnp.zeros_like(a, dtype=d), (x,), name="zeros_like",
+                   nondiff=True)
+
+
+def ones_like(x, dtype=None, name=None):
+    d = None if dtype is None else dtypes.convert_dtype(dtype)
+    return forward(lambda a: jnp.ones_like(a, dtype=d), (x,), name="ones_like",
+                   nondiff=True)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = None if dtype is None else dtypes.convert_dtype(dtype)
+    return forward(lambda a: jnp.full_like(a, fill_value, dtype=d), (x,),
+                   name="full_like", nondiff=True)
+
+
+def empty(shape, dtype=None, name=None):
+    # XLA has no uninitialized alloc; zeros is the honest TPU equivalent.
+    return zeros(shape, dtype)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    if end is None:
+        start, end = 0, start
+    start = start.item() if isinstance(start, Tensor) else start
+    end = end.item() if isinstance(end, Tensor) else end
+    step = step.item() if isinstance(step, Tensor) else step
+    if dtype is None:
+        dtype = (np.int64 if all(isinstance(v, (int, np.integer))
+                                 for v in (start, end, step))
+                 else dtypes.default_dtype().np_dtype)
+    return Tensor(_device_const(jnp.arange(start, end, step,
+                                           dtypes.convert_dtype(dtype))))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    start = start.item() if isinstance(start, Tensor) else start
+    stop = stop.item() if isinstance(stop, Tensor) else stop
+    num = int(num.item() if isinstance(num, Tensor) else num)
+    return Tensor(_device_const(
+        jnp.linspace(start, stop, num, dtype=dtypes.convert_dtype(dtype))))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(_device_const(jnp.logspace(
+        float(start), float(stop), int(num), base=float(base),
+        dtype=dtypes.convert_dtype(dtype))))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(_device_const(jnp.eye(
+        int(num_rows), None if num_columns is None else int(num_columns),
+        dtype=dtypes.convert_dtype(dtype))))
+
+
+def tril(x, diagonal=0, name=None):
+    return forward(lambda a: jnp.tril(a, k=diagonal), (x,), name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return forward(lambda a: jnp.triu(a, k=diagonal), (x,), name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.eye(out.shape[0], dtype=bool)
+                mask = jnp.roll(mask, offset, axis=1) if offset else mask
+                out = jnp.where(mask, out, padding_value)
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return forward(f, (x,), name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return forward(lambda a: jnp.diagflat(a, k=offset), (x,), name="diagflat")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = forward(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), args,
+                   name="meshgrid")
+    return list(outs)
+
+
+def assign(x, output=None):
+    if not isinstance(x, Tensor):
+        x = Tensor(np.asarray(x))
+    out = forward(lambda a: a * 1 if jnp.issubdtype(a.dtype, jnp.inexact)
+                  else jnp.array(a, copy=True), (x,), name="assign")
+    if output is not None:
+        output._rebind(out)
+        return output
+    return out
+
+
+def clone(x, name=None):
+    return assign(x)
+
+
+def one_hot(x, num_classes, name=None):
+    return forward(lambda a: jax.nn.one_hot(a, num_classes,
+                                            dtype=dtypes.default_dtype().np_dtype),
+                   (x,), name="one_hot", nondiff=True)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(np.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(np.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
+
+
+def complex(real, imag, name=None):
+    return forward(jax.lax.complex, (real, imag), name="complex")

@@ -1,0 +1,185 @@
+"""Linear algebra ops (reference `python/paddle/tensor/linalg.py`,
+kernels `phi/kernels/{cpu,gpu}/{cholesky,qr,svd,...}_kernel`).
+
+Decompositions run through jnp.linalg (XLA custom calls on TPU; some fall
+back to CPU lowerings inside XLA where the TPU has no native impl — same
+situation as the reference's cuSOLVER dependency)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+
+__all__ = [
+    "cholesky", "cholesky_solve", "qr", "svd", "pinv", "det", "slogdet",
+    "norm", "cond", "matrix_power", "matrix_rank", "solve",
+    "triangular_solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh",
+    "lu", "multi_dot", "corrcoef", "cov", "householder_product", "vander",
+    "p_norm",
+]
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2).conj() if upper else L
+    return forward(f, (x,), name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+    return forward(f, (x, y), name="cholesky_solve")
+
+
+def qr(x, mode="reduced", name=None):
+    out = forward(lambda a: tuple(jnp.linalg.qr(a, mode=mode))
+                  if mode != "r" else (jnp.linalg.qr(a, mode="r"),),
+                  (x,), name="qr")
+    return out if isinstance(out, tuple) and len(out) > 1 else out[0]
+
+
+def svd(x, full_matrices=False, name=None):
+    return forward(lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)),
+                   (x,), name="svd")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return forward(lambda a: jnp.linalg.pinv(a, rtol=rcond, hermitian=hermitian),
+                   (x,), name="pinv")
+
+
+def det(x, name=None):
+    return forward(jnp.linalg.det, (x,), name="det")
+
+
+def slogdet(x, name=None):
+    def f(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return jnp.stack([sign, logdet])
+    return forward(f, (x,), name="slogdet")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p in (None, "fro") and axis is None:
+            return jnp.sqrt(jnp.sum(jnp.square(a)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p in (None, "fro"):
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            return jnp.linalg.norm(a, ord="nuc", axis=ax, keepdims=keepdim)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=ax,
+                                 keepdims=keepdim), 1.0 / p)
+    return forward(f, (x,), name="norm")
+
+
+def p_norm(x, p=2, axis=None, keepdim=False, name=None):
+    return norm(x, p, axis, keepdim)
+
+
+def cond(x, p=None, name=None):
+    return forward(lambda a: jnp.linalg.cond(a, p=p), (x,), name="cond")
+
+
+def matrix_power(x, n, name=None):
+    return forward(lambda a: jnp.linalg.matrix_power(a, int(n)), (x,),
+                   name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return forward(lambda a: jnp.linalg.matrix_rank(a, rtol=tol), (x,),
+                   name="matrix_rank", nondiff=True)
+
+
+def solve(x, y, name=None):
+    return forward(jnp.linalg.solve, (x, y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return forward(f, (x, y), name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    return forward(lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+                   (x, y), name="lstsq")
+
+
+def eig(x, name=None):
+    # XLA TPU has no nonsymmetric eig; lower via CPU callback semantics of
+    # jnp.linalg.eig (matches reference's cuSOLVER-on-CPU fallback cases).
+    return forward(lambda a: tuple(jnp.linalg.eig(a)), (x,), name="eig",
+                   nondiff=True)
+
+
+def eigh(x, UPLO="L", name=None):
+    return forward(lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), (x,),
+                   name="eigh")
+
+
+def eigvals(x, name=None):
+    return forward(jnp.linalg.eigvals, (x,), name="eigvals", nondiff=True)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return forward(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), (x,),
+                   name="eigvalsh")
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32)
+    out = forward(f, (x,), name="lu")
+    if get_infos:
+        from .creation import zeros
+        return out[0], out[1], zeros([1], "int32")
+    return out
+
+
+def multi_dot(x, name=None):
+    return forward(lambda *xs: jnp.linalg.multi_dot(xs), tuple(x),
+                   name="multi_dot")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return forward(lambda a: jnp.corrcoef(a, rowvar=rowvar), (x,),
+                   name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return forward(lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0),
+                   (x,), name="cov")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        def body(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0, a[..., :, i])
+            v = v.at[i].set(1.0)
+            H = eye - t[i] * jnp.outer(v, v)
+            return Q @ H
+        Q = eye
+        for i in range(n):
+            Q = body(i, Q)
+        return Q[..., :, :n]
+    return forward(f, (x, tau), name="householder_product")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return forward(lambda a: jnp.vander(a, N=n, increasing=increasing), (x,),
+                   name="vander")

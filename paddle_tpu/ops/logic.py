@@ -1,0 +1,59 @@
+"""Comparison / logical ops (reference `python/paddle/tensor/logic.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+from .math import _binary
+
+__all__ = [
+    "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+    "less_equal", "logical_and", "logical_or", "logical_xor", "isclose",
+    "allclose", "equal_all", "is_empty", "is_tensor",
+]
+
+
+def _make(name, jfn):
+    def op(x, y, name=None):
+        return _binary(jfn, x, y, name=_n)
+    _n = name
+    op.__name__ = name
+    return op
+
+
+equal = _make("equal", jnp.equal)
+not_equal = _make("not_equal", jnp.not_equal)
+greater_than = _make("greater_than", jnp.greater)
+greater_equal = _make("greater_equal", jnp.greater_equal)
+less_than = _make("less_than", jnp.less)
+less_equal = _make("less_equal", jnp.less_equal)
+logical_and = _make("logical_and", jnp.logical_and)
+logical_or = _make("logical_or", jnp.logical_or)
+logical_xor = _make("logical_xor", jnp.logical_xor)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return forward(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                            equal_nan=equal_nan),
+                   (x, y), name="isclose", nondiff=True)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return forward(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                             equal_nan=equal_nan),
+                   (x, y), name="allclose", nondiff=True)
+
+
+def equal_all(x, y, name=None):
+    return forward(lambda a, b: jnp.array_equal(a, b), (x, y), name="equal_all",
+                   nondiff=True)
+
+
+def is_empty(x, name=None):
+    return Tensor(np.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

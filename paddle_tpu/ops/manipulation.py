@@ -1,0 +1,630 @@
+"""Shape / indexing / rearrangement ops.
+
+Parity surface: `python/paddle/tensor/manipulation.py` + `search.py` in the
+reference. XLA favors static shapes: everything here keeps shapes static
+except the explicitly dynamic ops (masked_select, nonzero, unique), which are
+eager-only — same restriction the reference's dy2static places on them.
+"""
+from __future__ import annotations
+
+import builtins
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import forward, unwrap
+from ..core.tensor import Tensor
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "transpose", "concat", "stack", "split", "chunk", "tile",
+    "expand", "expand_as", "broadcast_to", "broadcast_tensors", "gather",
+    "gather_nd", "scatter", "scatter_", "scatter_nd", "scatter_nd_add",
+    "index_select", "index_sample", "masked_select", "masked_fill", "where",
+    "nonzero", "roll", "flip", "rot90", "slice", "strided_slice", "pad",
+    "unbind", "unstack", "repeat_interleave", "unique", "unique_consecutive",
+    "topk", "sort", "argsort", "searchsorted", "bucketize",
+    "take_along_axis", "put_along_axis", "index_add", "index_put", "flatten_",
+    "getitem", "setitem", "shard_index", "crop", "fill_diagonal", "as_strided",
+    "view", "view_as", "atleast_1d", "atleast_2d", "atleast_3d", "select_scatter",
+    "moveaxis", "swapaxes", "as_complex", "as_real", "tensordot", "take",
+    "tolist", "numel", "shape", "rank",
+]
+
+
+def _tup(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in v.numpy().tolist())
+    if isinstance(v, (list, tuple)):
+        return tuple(int(unwrap(x)) if isinstance(x, Tensor) else int(x) for x in v)
+    return (int(v),)
+
+
+def reshape(x, shape, name=None):
+    s = _tup(shape)
+    return forward(lambda a: jnp.reshape(a, s), (x,), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._rebind(reshape(x, shape))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        st = start_axis % nd if nd else 0
+        sp = stop_axis % nd if nd else 0
+        new_shape = a.shape[:st] + (-1,) + a.shape[sp + 1:]
+        return jnp.reshape(a, new_shape)
+    return forward(f, (x,), name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._rebind(flatten(x, start_axis, stop_axis))
+
+
+def squeeze(x, axis=None, name=None):
+    if axis is None:
+        ax = None
+    else:
+        ax = _tup(axis) if isinstance(axis, (builtins.list, tuple, Tensor)) \
+            else (int(axis),)
+        shp = x._data.shape if isinstance(x, Tensor) else x.shape
+        ax = tuple(a % len(shp) for a in ax)
+        ax = tuple(a for a in ax if shp[a] == 1)
+        if not ax:
+            return forward(lambda a: a, (x,), name="squeeze")
+    return forward(lambda a: jnp.squeeze(a, axis=ax), (x,), name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._rebind(squeeze(x, axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _tup(axis)
+    return forward(lambda a: jnp.expand_dims(a, ax), (x,), name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._rebind(unsqueeze(x, axis))
+
+
+def transpose(x, perm, name=None):
+    p = _tup(perm)
+    return forward(lambda a: jnp.transpose(a, p), (x,), name="transpose")
+
+
+def moveaxis(x, source, destination, name=None):
+    return forward(lambda a: jnp.moveaxis(a, _tup(source), _tup(destination)),
+                   (x,), name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return forward(lambda a: jnp.swapaxes(a, int(axis1), int(axis2)), (x,),
+                   name="swapaxes")
+
+
+def concat(x, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return forward(lambda *xs: jnp.concatenate(xs, axis=axis), tuple(x),
+                   name="concat")
+
+
+def stack(x, axis=0, name=None):
+    return forward(lambda *xs: jnp.stack(xs, axis=int(axis)), tuple(x),
+                   name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    dim = x._data.shape[axis]
+    if isinstance(num_or_sections, int):
+        sizes = [dim // num_or_sections] * num_or_sections
+    else:
+        sizes = [int(s) for s in num_or_sections]
+        if builtins.any(s == -1 for s in sizes):
+            rest = dim - builtins.sum(s for s in sizes if s != -1)
+            sizes = [rest if s == -1 else s for s in sizes]
+    offs = np.cumsum([0] + sizes).tolist()
+    def f(a):
+        return tuple(jax.lax.slice_in_dim(a, offs[i], offs[i + 1], axis=axis)
+                     for i in range(len(sizes)))
+    return list(forward(f, (x,), name="split"))
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    r = _tup(repeat_times)
+    return forward(lambda a: jnp.tile(a, r), (x,), name="tile")
+
+
+def expand(x, shape, name=None):
+    s = _tup(shape)
+    def f(a):
+        tgt = builtins.list(s)
+        # -1 means keep original dim
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return forward(f, (x,), name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    s = _tup(shape)
+    return forward(lambda a: jnp.broadcast_to(a, s), (x,), name="broadcast_to")
+
+
+def broadcast_tensors(inputs, name=None):
+    return list(forward(lambda *xs: jnp.broadcast_arrays(*xs), tuple(inputs),
+                        name="broadcast_tensors"))
+
+
+def gather(x, index, axis=0, name=None):
+    axis = int(unwrap(axis)) if isinstance(axis, Tensor) else int(axis)
+    return forward(lambda a, i: jnp.take(a, i.reshape(-1), axis=axis), (x, index),
+                   name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        k = idx.shape[-1]
+        return a[tuple(jnp.moveaxis(idx, -1, 0))] if k == a.ndim else \
+            a[tuple(jnp.moveaxis(idx, -1, 0))]
+    return forward(f, (x, index), name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        return a.at[i].add(u)
+    return forward(f, (x, index, updates), name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True, name=None):
+    return x._rebind(scatter(x, index, updates, overwrite))
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _tup(shape)
+    def f(i, u):
+        z = jnp.zeros(s, u.dtype)
+        return z.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return forward(f, (index, updates), name="scatter_nd")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    return forward(
+        lambda a, i, u: a.at[tuple(jnp.moveaxis(i, -1, 0))].add(u),
+        (x, index, updates), name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return forward(lambda a, i: jnp.take(a, i.reshape(-1), axis=int(axis)),
+                   (x, index), name="index_select")
+
+
+def index_sample(x, index, name=None):
+    return forward(lambda a, i: jnp.take_along_axis(a, i, axis=1), (x, index),
+                   name="index_sample")
+
+
+def index_add(x, index, axis, value, name=None):
+    ax = int(axis)
+    def g(a, i, v):
+        sl = [builtins.slice(None)] * a.ndim
+        sl[ax] = i.reshape(-1)
+        return a.at[tuple(sl)].add(v)
+    return forward(g, (x, index, value), name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        if accumulate:
+            return a.at[tuple(idx)].add(v)
+        return a.at[tuple(idx)].set(v)
+    return forward(f, (x, value, *indices), name="index_put")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return forward(lambda a, i: jnp.take_along_axis(a, i, axis=int(axis)),
+                   (arr, indices), name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape)
+        dims = [jnp.arange(n).reshape([-1 if d == k else 1 for k in range(a.ndim)])
+                for d, n in enumerate(i.shape)]
+        dims[int(axis) % a.ndim] = i
+        if reduce == "add":
+            return a.at[tuple(dims)].add(v)
+        if reduce in ("mul", "multiply"):
+            return a.at[tuple(dims)].multiply(v)
+        return a.at[tuple(dims)].set(v)
+    if not isinstance(values, (Tensor, jax.Array, np.ndarray)):
+        values = jnp.asarray(values)
+    return forward(f, (arr, indices, values), name="put_along_axis")
+
+
+def take(x, index, mode="raise", name=None):
+    return forward(lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1)),
+                   (x, index), name="take")
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: eager-only (reference kernel masked_select_kernel)
+    return Tensor(np.asarray(unwrap(x))[np.asarray(unwrap(mask)).astype(bool)])
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value.item() if isinstance(value, Tensor) and value.size == 1 else value
+    if isinstance(v, (int, float)):
+        return forward(lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a),
+                       (x, mask), name="masked_fill")
+    return forward(lambda a, m, vv: jnp.where(m, vv.astype(a.dtype), a),
+                   (x, mask, v), name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    from .math import _is_scalar
+    xs = () if _is_scalar(x) else (x,)
+    ys = () if _is_scalar(y) else (y,)
+    if xs and ys:
+        return forward(lambda c, a, b: jnp.where(c, a, b), (condition, x, y),
+                       name="where")
+    if xs:
+        return forward(lambda c, a: jnp.where(c, a, y), (condition, x), name="where")
+    if ys:
+        return forward(lambda c, b: jnp.where(c, x, b), (condition, y), name="where")
+    return forward(lambda c: jnp.where(c, x, y), (condition,), name="where")
+
+
+def nonzero(x, as_tuple=False, name=None):
+    idx = np.nonzero(np.asarray(unwrap(x)))
+    if as_tuple:
+        return tuple(Tensor(i.astype(np.int64)) for i in idx)
+    return Tensor(np.stack(idx, axis=-1).astype(np.int64))
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _tup(shifts) if isinstance(shifts, (list, tuple, Tensor)) else int(shifts)
+    ax = None if axis is None else (_tup(axis) if isinstance(axis, (list, tuple)) else int(axis))
+    return forward(lambda a: jnp.roll(a, sh, axis=ax), (x,), name="roll")
+
+
+def flip(x, axis, name=None):
+    ax = _tup(axis) if isinstance(axis, (list, tuple)) else int(axis)
+    return forward(lambda a: jnp.flip(a, axis=ax), (x,), name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return forward(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), (x,), name="rot90")
+
+
+def slice(input, axes, starts, ends, name=None):
+    axes, starts, ends = _tup(axes), _tup(starts), _tup(ends)
+    def f(a):
+        out = a
+        for ax, st, en in zip(axes, starts, ends):
+            n = a.shape[ax]
+            st2 = builtins.max(st + n, 0) if st < 0 else builtins.min(st, n)
+            en2 = builtins.max(en + n, 0) if en < 0 else builtins.min(en, n)
+            out = jax.lax.slice_in_dim(out, st2, en2, axis=ax)
+        return out
+    return forward(f, (input,), name="slice")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    axes, starts, ends, strides = map(_tup, (axes, starts, ends, strides))
+    def f(a):
+        sl = [builtins.slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            sl[ax] = builtins.slice(st, en, sd)
+        return a[tuple(sl)]
+    return forward(f, (x,), name="strided_slice")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _tup(shape)
+    o = _tup(offsets) if offsets is not None else (0,) * len(s)
+    def f(a):
+        sl = tuple(builtins.slice(o[i], o[i] + (s[i] if s[i] != -1 else a.shape[i] - o[i]))
+                   for i in range(a.ndim))
+        return a[sl]
+    return forward(f, (x,), name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    p = _tup(pad)
+    def f(a):
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # paddle convention: pad applies to last len(p)//2 dims (reversed
+            # pairs like torch) for NCHW/NCL formats
+            k = len(p) // 2
+            width = [(0, 0)] * (nd - k)
+            if data_format.endswith("C") and nd >= 3:  # NLC/NHWC: pad middle dims
+                width = [(0, 0)] + [(p[2 * i], p[2 * i + 1]) for i in range(k)] + [(0, 0)]
+                width += [(0, 0)] * (nd - len(width))
+            else:
+                width += [(p[2 * i], p[2 * i + 1]) for i in range(k)]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, width, mode=jmode, constant_values=value)
+        return jnp.pad(a, width, mode=jmode)
+    return forward(f, (x,), name="pad")
+
+
+def unbind(input, axis=0, name=None):
+    n = input._data.shape[axis]
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=axis)
+                     for s in jnp.split(a, n, axis=axis))
+    return list(forward(f, (input,), name="unbind"))
+
+
+def unstack(x, axis=0, num=None, name=None):
+    return unbind(x, axis)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return forward(lambda a, r: jnp.repeat(a, r, axis=axis,
+                                               total_repeat_length=int(np.asarray(unwrap(repeats)).sum())),
+                       (x, repeats), name="repeat_interleave")
+    return forward(lambda a: jnp.repeat(a, int(repeats), axis=axis), (x,),
+                   name="repeat_interleave")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    # dynamic shape → eager-only, like reference unique_kernel
+    arr = np.asarray(unwrap(x))
+    out = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(out, tuple):
+        return Tensor(out)
+    return tuple(Tensor(o.astype(np.int64) if i else o) for i, o in enumerate(out))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    arr = np.asarray(unwrap(x)).reshape(-1) if axis is None else np.asarray(unwrap(x))
+    keep = np.ones(arr.shape[0], bool)
+    keep[1:] = np.any(arr[1:] != arr[:-1], axis=tuple(range(1, arr.ndim))) \
+        if arr.ndim > 1 else arr[1:] != arr[:-1]
+    vals = arr[keep]
+    outs = [Tensor(vals)]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        outs.append(Tensor(inv.astype(np.int64)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        cnt = np.diff(np.append(idx, arr.shape[0]))
+        outs.append(Tensor(cnt.astype(np.int64)))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    k = int(unwrap(k)) if isinstance(k, Tensor) else int(k)
+    def f(a):
+        ax = axis % a.ndim
+        src = a if largest else -a
+        if ax != a.ndim - 1:
+            src = jnp.moveaxis(src, ax, -1)
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        if ax != a.ndim - 1:
+            vals = jnp.moveaxis(vals, -1, ax)
+            idx = jnp.moveaxis(idx, -1, ax)
+        return vals, idx.astype(jnp.int64)
+    return forward(f, (x,), name="topk")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis)
+        return jnp.flip(out, axis=axis) if descending else out
+    return forward(f, (x,), name="sort")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(a):
+        out = jnp.argsort(a, axis=axis)
+        out = jnp.flip(out, axis=axis) if descending else out
+        return out.astype(jnp.int64)
+    return forward(f, (x,), name="argsort", nondiff=True)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(d)
+        return jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(
+            s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+        ).reshape(v.shape).astype(d)
+    return forward(f, (sorted_sequence, values), name="searchsorted", nondiff=True)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """Vocab-shard remap (reference `fluid/operators/shard_index_op`)."""
+    size = (index_num + nshards - 1) // nshards
+    def f(a):
+        shard = a // size
+        local = a % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return forward(f, (input,), name="shard_index", nondiff=True)
+
+
+def fill_diagonal(x, value, offset=0, wrap=False, name=None):
+    def f(a):
+        n = builtins.min(a.shape[-2], a.shape[-1])
+        i = jnp.arange(n - builtins.abs(offset))
+        r = i + (-offset if offset < 0 else 0)
+        c = i + (offset if offset > 0 else 0)
+        return a.at[..., r, c].set(value)
+    return forward(f, (x,), name="fill_diagonal")
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        sl = [builtins.slice(None)] * a.ndim
+        sl[axis] = index
+        return a.at[tuple(sl)].set(v)
+    return forward(f, (x, values), name="select_scatter")
+
+
+def as_complex(x, name=None):
+    return forward(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), (x,),
+                   name="as_complex")
+
+
+def as_real(x, name=None):
+    return forward(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                   (x,), name="as_real")
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    s = _tup(shape)
+    st = _tup(stride)
+    def f(a):
+        flat = a.reshape(-1)
+        idx = np.add.outer if False else None
+        grids = jnp.meshgrid(*[jnp.arange(n) * k for n, k in zip(s, st)],
+                             indexing="ij")
+        lin = offset + builtins.sum(grids)
+        return flat[lin.reshape(-1)].reshape(s)
+    return forward(f, (x,), name="as_strided")
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    from .math import cast
+    return cast(x, shape_or_dtype)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [forward(jnp.atleast_1d, (t,), name="atleast_1d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [forward(jnp.atleast_2d, (t,), name="atleast_2d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [forward(jnp.atleast_3d, (t,), name="atleast_3d") for t in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes if isinstance(axes, int) else tuple(map(_tup, axes))
+    return forward(lambda a, b: jnp.tensordot(a, b, axes=ax), (x, y),
+                   name="tensordot")
+
+
+def tolist(x):
+    return x.tolist()
+
+
+def numel(x, name=None):
+    return Tensor(np.asarray(x.size, dtype=np.int64))
+
+
+def shape(x):
+    return Tensor(np.asarray(x._data.shape, dtype=np.int32))
+
+
+def rank(x):
+    return Tensor(np.asarray(x._data.ndim, dtype=np.int32))
+
+
+# -- python-level indexing (Tensor.__getitem__ / __setitem__) -----------------
+def _split_index(idx):
+    """Separate Tensor/array parts of an index from its static skeleton."""
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    spec, dyn = [], []
+    for it in idx:
+        if isinstance(it, Tensor) or isinstance(it, jax.Array) or \
+           isinstance(it, np.ndarray):
+            spec.append(("dyn", len(dyn)))
+            dyn.append(it)
+        elif isinstance(it, builtins.list):
+            spec.append(("dyn", len(dyn)))
+            dyn.append(np.asarray(it))
+        else:
+            spec.append(("static", it))
+    return tuple(spec), dyn
+
+
+def _rebuild_index(spec, dyn_arrays):
+    out = []
+    for kind, v in spec:
+        out.append(dyn_arrays[v] if kind == "dyn" else v)
+    return tuple(out)
+
+
+def getitem(x, idx):
+    spec, dyn = _split_index(idx)
+    # boolean-mask indexing produces dynamic shapes → eager numpy path
+    if builtins.any(np.asarray(unwrap(d)).dtype == np.bool_ for d in dyn):
+        arr = np.asarray(unwrap(x))
+        np_idx = _rebuild_index(spec, [np.asarray(unwrap(d)) for d in dyn])
+        return Tensor(arr[np_idx if len(np_idx) > 1 else np_idx[0]])
+    if not dyn:
+        s = spec
+        def f(a):
+            i = tuple(v for _, v in s)
+            return a[i if len(i) > 1 else i[0]]
+        return forward(f, (x,), name="getitem")
+    def f(a, *darrs):
+        i = _rebuild_index(spec, [d.astype(jnp.int32) if jnp.issubdtype(d.dtype, jnp.integer) else d for d in darrs])
+        return a[i if len(i) > 1 else i[0]]
+    return forward(f, (x, *dyn), name="getitem")
+
+
+def setitem(x, idx, value):
+    spec, dyn = _split_index(idx)
+    scalar_value = not isinstance(value, (Tensor, jax.Array, np.ndarray))
+    ins = (x, *dyn) if scalar_value else (x, *dyn, value)
+    def f(a, *rest):
+        darrs = rest[: len(dyn)]
+        v = value if scalar_value else rest[len(dyn)]
+        if not scalar_value:
+            v = v.astype(a.dtype)
+        i = _rebuild_index(spec, builtins.list(darrs))
+        return a.at[i if len(i) > 1 else i[0]].set(v)
+    return forward(f, ins, name="setitem")

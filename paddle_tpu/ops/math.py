@@ -1,0 +1,402 @@
+"""Math ops: elementwise, reductions, matmul.
+
+Parity surface: `python/paddle/tensor/math.py` + `.../stat.py` in the
+reference; kernels are XLA-lowered jnp functions (the reference's
+`phi/kernels/{cpu,gpu}/elementwise_*`, `reduce_*`, `matmul_kernel` et al.).
+All functions route through `core.dispatch.forward` so AMP, autograd and the
+static recorder see them uniformly.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+
+__all__ = []
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+def _as_input(x):
+    """Tensor/array passthrough; lists/np scalars to arrays."""
+    if isinstance(x, Tensor):
+        return x
+    return jnp.asarray(x)
+
+
+def _scalar_rhs(a, *, fn, s):
+    return fn(a, s)
+
+
+def _scalar_lhs(b, *, fn, s):
+    return fn(s, b)
+
+
+def _is_scalar(v):
+    return isinstance(v, (int, float, bool, np.number))
+
+
+def _binary(jfn, x, y, name):
+    if _is_scalar(y) and isinstance(x, (Tensor, jax.Array)):
+        return forward(_scalar_rhs, (x,), {"fn": jfn, "s": y}, name=name)
+    if _is_scalar(x):
+        return forward(_scalar_lhs, (y,), {"fn": jfn, "s": x}, name=name)
+    return forward(jfn, (_as_input(x), _as_input(y)), name=name)
+
+
+def _make_binary(name, jfn):
+    def op(x, y, name=None):
+        return _binary(jfn, x, y, name=_name)
+    _name = name
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+def _make_unary(name, jfn, nondiff=False):
+    def op(x, name=None):
+        return forward(jfn, (_as_input(x),), name=_name, nondiff=nondiff)
+    _name = name
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+# -- elementwise binary -------------------------------------------------------
+add = _make_binary("add", jnp.add)
+subtract = _make_binary("subtract", jnp.subtract)
+multiply = _make_binary("multiply", jnp.multiply)
+divide = _make_binary("divide", jnp.divide)
+floor_divide = _make_binary("floor_divide", jnp.floor_divide)
+mod = _make_binary("mod", jnp.mod)
+remainder = _make_binary("remainder", jnp.remainder)
+floor_mod = mod
+pow = _make_binary("pow", jnp.power)
+maximum = _make_binary("maximum", jnp.maximum)
+minimum = _make_binary("minimum", jnp.minimum)
+fmax = _make_binary("fmax", jnp.fmax)
+fmin = _make_binary("fmin", jnp.fmin)
+atan2 = _make_binary("atan2", jnp.arctan2)
+logaddexp = _make_binary("logaddexp", jnp.logaddexp)
+hypot = _make_binary("hypot", jnp.hypot)
+copysign = _make_binary("copysign", jnp.copysign)
+heaviside = _make_binary("heaviside", jnp.heaviside)
+gcd = _make_binary("gcd", jnp.gcd)
+lcm = _make_binary("lcm", jnp.lcm)
+ldexp = _make_binary("ldexp", jnp.ldexp)
+nextafter = _make_binary("nextafter", jnp.nextafter)
+bitwise_and = _make_binary("bitwise_and", jnp.bitwise_and)
+bitwise_or = _make_binary("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _make_binary("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _make_binary("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _make_binary("bitwise_right_shift", jnp.right_shift)
+inner = _make_binary("inner", jnp.inner)
+outer = _make_binary("outer", jnp.outer)
+kron = _make_binary("kron", jnp.kron)
+cross = _make_binary("cross", jnp.cross)
+dot = _make_binary("dot", lambda a, b: (a * b).sum(-1) if a.ndim > 1 else jnp.dot(a, b))
+
+# -- elementwise unary --------------------------------------------------------
+exp = _make_unary("exp", jnp.exp)
+expm1 = _make_unary("expm1", jnp.expm1)
+log = _make_unary("log", jnp.log)
+log2 = _make_unary("log2", jnp.log2)
+log10 = _make_unary("log10", jnp.log10)
+log1p = _make_unary("log1p", jnp.log1p)
+sqrt = _make_unary("sqrt", jnp.sqrt)
+rsqrt = _make_unary("rsqrt", jax.lax.rsqrt)
+abs = _make_unary("abs", jnp.abs)
+sign = _make_unary("sign", jnp.sign)
+neg = _make_unary("neg", jnp.negative)
+negative = neg
+floor = _make_unary("floor", jnp.floor)
+ceil = _make_unary("ceil", jnp.ceil)
+round = _make_unary("round", jnp.round)
+trunc = _make_unary("trunc", jnp.trunc)
+frac = _make_unary("frac", lambda a: a - jnp.trunc(a))
+sin = _make_unary("sin", jnp.sin)
+cos = _make_unary("cos", jnp.cos)
+tan = _make_unary("tan", jnp.tan)
+asin = _make_unary("asin", jnp.arcsin)
+acos = _make_unary("acos", jnp.arccos)
+atan = _make_unary("atan", jnp.arctan)
+sinh = _make_unary("sinh", jnp.sinh)
+cosh = _make_unary("cosh", jnp.cosh)
+tanh = _make_unary("tanh", jnp.tanh)
+asinh = _make_unary("asinh", jnp.arcsinh)
+acosh = _make_unary("acosh", jnp.arccosh)
+atanh = _make_unary("atanh", jnp.arctanh)
+erf = _make_unary("erf", jax.scipy.special.erf)
+erfinv = _make_unary("erfinv", jax.scipy.special.erfinv)
+reciprocal = _make_unary("reciprocal", lambda a: 1.0 / a)
+square = _make_unary("square", jnp.square)
+digamma = _make_unary("digamma", jax.scipy.special.digamma)
+lgamma = _make_unary("lgamma", jax.scipy.special.gammaln)
+i0 = _make_unary("i0", jax.scipy.special.i0)
+i1 = _make_unary("i1", jax.scipy.special.i1)
+angle = _make_unary("angle", jnp.angle)
+conj = _make_unary("conj", jnp.conj)
+real = _make_unary("real", jnp.real)
+imag = _make_unary("imag", jnp.imag)
+isnan = _make_unary("isnan", jnp.isnan, nondiff=True)
+isinf = _make_unary("isinf", jnp.isinf, nondiff=True)
+isfinite = _make_unary("isfinite", jnp.isfinite, nondiff=True)
+logical_not = _make_unary("logical_not", jnp.logical_not, nondiff=True)
+bitwise_not = _make_unary("bitwise_not", jnp.bitwise_not, nondiff=True)
+
+
+@_export
+def clip(x, min=None, max=None, name=None):
+    lo = min.item() if isinstance(min, Tensor) else min
+    hi = max.item() if isinstance(max, Tensor) else max
+    return forward(lambda a: jnp.clip(a, lo, hi), (x,), name="clip")
+
+
+@_export
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = float(scale), float(bias)
+    def f(a):
+        out = a * s + b if bias_after_scale else (a + b) * s
+        return out
+    out = forward(f, (x,), name="scale")
+    if act:
+        from . import activation
+        out = getattr(activation, act)(out)
+    return out
+
+
+@_export
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return forward(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                            neginf=neginf), (x,), name="nan_to_num")
+
+
+@_export
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, (int, float)):
+        return forward(lambda a, b: a + weight * (b - a), (x, y), name="lerp")
+    return forward(lambda a, b, w: a + w * (b - a), (x, y, weight), name="lerp")
+
+
+@_export
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return forward(lambda a: scale_b * jnp.tanh(scale_a * a), (x,), name="stanh")
+
+
+@_export
+def multiplex(inputs, index, name=None):
+    return forward(
+        lambda idx, *xs: jnp.stack(xs, 0)[idx.reshape(-1), jnp.arange(xs[0].shape[0])],
+        (index, *inputs), name="multiplex")
+
+
+# -- reductions ---------------------------------------------------------------
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    if isinstance(axis, Tensor):
+        return tuple(int(v) for v in axis.numpy().tolist())
+    return int(axis)
+
+
+def _make_reduce(name, jfn, nondiff=False):
+    def op(x, axis=None, keepdim=False, name=None):
+        ax = _axis(axis)
+        return forward(lambda a: jfn(a, axis=ax, keepdims=keepdim), (x,),
+                       name=_name, nondiff=nondiff)
+    _name = name
+    op.__name__ = name
+    __all__.append(name)
+    return op
+
+
+mean = _make_reduce("mean", jnp.mean)
+amax = _make_reduce("amax", jnp.max)
+amin = _make_reduce("amin", jnp.min)
+prod = _make_reduce("prod", jnp.prod)
+nansum = _make_reduce("nansum", jnp.nansum)
+nanmean = _make_reduce("nanmean", jnp.nanmean)
+all = _make_reduce("all", jnp.all, nondiff=True)
+any = _make_reduce("any", jnp.any, nondiff=True)
+logsumexp = _make_reduce("logsumexp", jax.scipy.special.logsumexp)
+max = _make_reduce("max", jnp.max)
+min = _make_reduce("min", jnp.min)
+
+
+@_export
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    d = None if dtype is None else dtypes.convert_dtype(dtype)
+    return forward(lambda a: jnp.sum(a, axis=ax, dtype=d, keepdims=keepdim),
+                   (x,), name="sum")
+
+
+@_export
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return forward(lambda a: jnp.count_nonzero(a, axis=ax, keepdims=keepdim),
+                   (x,), name="count_nonzero", nondiff=True)
+
+
+@_export
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return forward(lambda a: jnp.std(a, axis=ax, ddof=1 if unbiased else 0,
+                                     keepdims=keepdim), (x,), name="std")
+
+
+@_export
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    ax = _axis(axis)
+    return forward(lambda a: jnp.var(a, axis=ax, ddof=1 if unbiased else 0,
+                                     keepdims=keepdim), (x,), name="var")
+
+
+@_export
+def median(x, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return forward(lambda a: jnp.median(a, axis=ax, keepdims=keepdim), (x,),
+                   name="median")
+
+
+@_export
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    ax = _axis(axis)
+    return forward(lambda a: jnp.quantile(a, jnp.asarray(q), axis=ax,
+                                          keepdims=keepdim), (x,), name="quantile")
+
+
+@_export
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = _axis(axis)
+    d = dtypes.convert_dtype(dtype)
+    return forward(lambda a: jnp.argmax(a, axis=ax, keepdims=keepdim).astype(d),
+                   (x,), name="argmax", nondiff=True)
+
+
+@_export
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    ax = _axis(axis)
+    d = dtypes.convert_dtype(dtype)
+    return forward(lambda a: jnp.argmin(a, axis=ax, keepdims=keepdim).astype(d),
+                   (x,), name="argmin", nondiff=True)
+
+
+@_export
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = None if dtype is None else dtypes.convert_dtype(dtype)
+    if axis is None:
+        return forward(lambda a: jnp.cumsum(a.reshape(-1), dtype=d), (x,),
+                       name="cumsum")
+    return forward(lambda a: jnp.cumsum(a, axis=int(axis), dtype=d), (x,),
+                   name="cumsum")
+
+
+@_export
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = None if dtype is None else dtypes.convert_dtype(dtype)
+    return forward(lambda a: jnp.cumprod(a, axis=dim, dtype=d), (x,),
+                   name="cumprod")
+
+
+@_export
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = -1 if axis is None else int(axis)
+        vals = jax.lax.cummax(a if axis is not None else a.reshape(-1), axis=ax if axis is not None else 0)
+        return vals
+    return forward(f, (x,), name="cummax")
+
+
+@_export
+def diff(x, n=1, axis=-1, name=None):
+    return forward(lambda a: jnp.diff(a, n=n, axis=axis), (x,), name="diff")
+
+
+@_export
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return forward(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                       axis2=axis2), (x,), name="trace")
+
+
+# -- matmul family ------------------------------------------------------------
+@_export
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """`paddle.matmul` (reference `python/paddle/tensor/linalg.py:232`,
+    kernel `phi/kernels/gpu/matmul_kernel.cu`) — lowers to a single XLA dot
+    that XLA tiles onto the MXU."""
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return forward(f, (_as_input(x), _as_input(y)), name="matmul")
+
+
+@_export
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+@_export
+def bmm(x, y, name=None):
+    return forward(jnp.matmul, (x, y), name="bmm")
+
+
+@_export
+def mv(x, vec, name=None):
+    return forward(jnp.matmul, (x, vec), name="mv")
+
+
+@_export
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return forward(lambda i, a, b: beta * i + alpha * (a @ b), (input, x, y),
+                   name="addmm")
+
+
+@_export
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return forward(lambda *xs: jnp.einsum(equation, *xs), operands, name="einsum")
+
+
+@_export
+def t(x, name=None):
+    return forward(lambda a: a.T if a.ndim >= 2 else a, (x,), name="t")
+
+
+@_export
+def inverse(x, name=None):
+    return forward(jnp.linalg.inv, (x,), name="inverse")
+
+
+# -- misc ---------------------------------------------------------------------
+@_export
+def cast(x, dtype):
+    d = dtypes.convert_dtype(dtype)
+    return forward(lambda a: a.astype(d), (x,), name="cast")
+
+
+@_export
+def increment(x, value=1.0, name=None):
+    return x._rebind(forward(lambda a: a + value, (x,), name="increment"))
+
+
+@_export
+def accuracy(input, label, k=1, name=None):
+    def f(pred, lab):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        correct = (topk == lab.reshape(-1, 1)).any(axis=-1)
+        return correct.mean(dtype=jnp.float32)
+    return forward(f, (input, label), name="accuracy", nondiff=True)

@@ -1,0 +1,100 @@
+"""Attach op methods + operator dunders to Tensor.
+
+Equivalent of the reference's monkey_patch_varbase/monkey_patch_math
+(`python/paddle/fluid/dygraph/math_op_patch.py`,
+`python/paddle/fluid/dygraph/varbase_patch_methods.py`).
+"""
+from __future__ import annotations
+
+from ..core.tensor import Tensor
+
+
+def _patch_tensor_methods():
+    from . import (activation, creation, linalg, logic, manipulation, math,
+                   nn_ops, random_ops)
+
+    method_sources = [math, manipulation, logic, linalg, activation, creation,
+                      random_ops]
+    # names attached as Tensor methods (x.method(...) → ops.method(x, ...))
+    method_names = {
+        # math
+        "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+        "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+        "exp", "expm1", "log", "log2", "log10", "log1p", "sqrt", "rsqrt",
+        "abs", "sign", "floor", "ceil", "round", "trunc", "frac", "sin",
+        "cos", "tan", "asin", "acos", "atan", "sinh", "cosh", "tanh",
+        "asinh", "acosh", "atanh", "erf", "erfinv", "reciprocal", "square",
+        "digamma", "lgamma", "angle", "conj", "real", "imag", "isnan",
+        "isinf", "isfinite", "clip", "scale", "nan_to_num", "lerp",
+        "mean", "sum", "prod", "max", "min", "amax", "amin", "std", "var",
+        "median", "quantile", "argmax", "argmin", "cumsum", "cumprod",
+        "diff", "trace", "logsumexp", "all", "any", "count_nonzero",
+        "matmul", "mm", "bmm", "mv", "dot", "inner", "outer", "kron",
+        "cross", "einsum", "inverse", "cast", "nansum", "nanmean",
+        "neg", "logical_not", "bitwise_not", "bitwise_and", "bitwise_or",
+        "bitwise_xor", "addmm", "lcm", "gcd",
+        # manipulation
+        "reshape", "reshape_", "flatten", "flatten_", "squeeze", "squeeze_",
+        "unsqueeze", "unsqueeze_", "transpose", "concat", "split", "chunk",
+        "tile", "expand", "expand_as", "broadcast_to", "gather", "gather_nd",
+        "scatter", "scatter_", "scatter_nd_add", "index_select",
+        "index_sample", "index_add", "index_put", "masked_select",
+        "masked_fill", "where", "nonzero", "roll", "flip", "rot90", "pad",
+        "unbind", "repeat_interleave", "unique", "topk", "sort", "argsort",
+        "take_along_axis", "put_along_axis", "take", "tolist",
+        "moveaxis", "swapaxes", "as_complex", "as_real", "tensordot",
+        "view", "view_as", "fill_diagonal", "strided_slice",
+        # logic
+        "equal", "not_equal", "greater_than", "greater_equal", "less_than",
+        "less_equal", "logical_and", "logical_or", "logical_xor", "isclose",
+        "allclose", "equal_all",
+        # linalg
+        "cholesky", "qr", "svd", "pinv", "det", "slogdet", "norm", "cond",
+        "matrix_power", "solve", "lstsq", "eig", "eigvals",
+        "t", "p_norm",
+        # random inplace
+        "uniform_", "normal_", "exponential_", "bernoulli", "multinomial",
+        # activations commonly used as methods
+        "softmax", "sigmoid",
+    }
+    for name in method_names:
+        fn = None
+        for src in method_sources:
+            fn = getattr(src, name, None)
+            if fn is not None:
+                break
+        if fn is None:
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # -- operator dunders -----------------------------------------------------
+    Tensor.__add__ = lambda s, o: math.add(s, o)
+    Tensor.__radd__ = lambda s, o: math.add(o, s)
+    Tensor.__sub__ = lambda s, o: math.subtract(s, o)
+    Tensor.__rsub__ = lambda s, o: math.subtract(o, s)
+    Tensor.__mul__ = lambda s, o: math.multiply(s, o)
+    Tensor.__rmul__ = lambda s, o: math.multiply(o, s)
+    Tensor.__truediv__ = lambda s, o: math.divide(s, o)
+    Tensor.__rtruediv__ = lambda s, o: math.divide(o, s)
+    Tensor.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    Tensor.__rfloordiv__ = lambda s, o: math.floor_divide(o, s)
+    Tensor.__mod__ = lambda s, o: math.mod(s, o)
+    Tensor.__rmod__ = lambda s, o: math.mod(o, s)
+    Tensor.__pow__ = lambda s, o: math.pow(s, o)
+    Tensor.__rpow__ = lambda s, o: math.pow(o, s)
+    Tensor.__matmul__ = lambda s, o: math.matmul(s, o)
+    Tensor.__rmatmul__ = lambda s, o: math.matmul(o, s)
+    Tensor.__neg__ = lambda s: math.neg(s)
+    Tensor.__abs__ = lambda s: math.abs(s)
+    Tensor.__invert__ = lambda s: math.bitwise_not(s)
+    Tensor.__and__ = lambda s, o: math.bitwise_and(s, o)
+    Tensor.__or__ = lambda s, o: math.bitwise_or(s, o)
+    Tensor.__xor__ = lambda s, o: math.bitwise_xor(s, o)
+    Tensor.__lt__ = lambda s, o: logic.less_than(s, o)
+    Tensor.__le__ = lambda s, o: logic.less_equal(s, o)
+    Tensor.__gt__ = lambda s, o: logic.greater_than(s, o)
+    Tensor.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    Tensor.__eq__ = lambda s, o: logic.equal(s, o)
+    Tensor.__ne__ = lambda s, o: logic.not_equal(s, o)
+    Tensor.__hash__ = lambda s: id(s)

@@ -1,0 +1,158 @@
+"""Pallas TPU kernels for the hot fused ops.
+
+These replace the reference's hand-written CUDA fusion layer:
+  - flash attention  ← `phi/kernels/gpu/flash_attn_kernel.cu` (dynloaded
+    libflashattn) and `fluid/operators/fused/fused_attention_op.cu`
+  - fused softmax-mask ← `phi/kernels/fusion/fused_softmax_mask_kernel`
+
+Kernel design follows the TPU playbook (/opt/skills/guides/pallas_guide.md):
+fp32 accumulators in VMEM, MXU matmuls via jnp.dot with
+preferred_element_type=f32, online-softmax streaming over K/V blocks so the
+full [T, T] score matrix never materializes in HBM.
+
+Every public entry point falls back to a pure-XLA implementation when the
+platform is not TPU or shapes don't tile (CPU tests, odd seq lens), so
+numerics are always available — the same role the reference's CPU reference
+kernels play for its CUDA ops.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only importable when libtpu present; guard for CPU CI
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",) and pltpu is not None
+    except Exception:  # pragma: no cover
+        return False
+
+
+# =========================== flash attention =================================
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
+                  block_k, seq_len):
+    head_dim = q_ref.shape[-1]
+    q = q_ref[:].astype(jnp.float32) * scale
+    q_blk = pl.program_id(1)
+
+    m0 = jnp.full((block_q, 1), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
+
+    if causal:
+        hi = jax.lax.div(q_blk * block_q + block_q + block_k - 1, block_k)
+        hi = jnp.minimum(hi, seq_len // block_k)
+    else:
+        hi = seq_len // block_k
+
+    def body(i, carry):
+        m, l, acc = carry
+        k = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
+        if causal:
+            qpos = q_blk * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = i * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1, keepdims=True)
+        acc_new = acc * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
+    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
+                                             "block_k"))
+def _flash_attention_tpu(q, k, v, causal=False, scale=None, block_q=256,
+                         block_k=256):
+    """q,k,v: [B, T, N, H] (reference flash_attn layout). Pallas grid:
+    (batch*heads, T/block_q); K/V streamed in block_k chunks."""
+    B, T, N, H = q.shape
+    scale = float(scale) if scale is not None else H ** -0.5
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+
+    def reshape_in(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * N, x.shape[1], H)
+
+    qf, kf, vf = reshape_in(q), reshape_in(k), reshape_in(v)
+    grid = (B * N, T // block_q)
+    out = pl.pallas_call(
+        functools.partial(_flash_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=T),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, H), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((None, T, H), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((None, T, H), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, H), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * N, T, H), q.dtype),
+    )(qf, kf, vf)
+    return out.reshape(B, N, T, H).transpose(0, 2, 1, 3)
+
+
+def _attention_xla(q, k, v, mask=None, causal=False, scale=None):
+    """Reference semantics of fmha_ref.h, fused by XLA."""
+    H = q.shape[-1]
+    scale = scale if scale is not None else H ** -0.5
+    logits = jnp.einsum("bqnh,bknh->bnqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        T, S = logits.shape[-2], logits.shape[-1]
+        cm = jnp.tril(jnp.ones((T, S), bool))
+        logits = jnp.where(cm, logits, -jnp.inf)
+    if mask is not None:
+        if mask.dtype == jnp.bool_:
+            logits = jnp.where(mask, logits, -jnp.inf)
+        else:
+            logits = logits + mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnqk,bknh->bqnh", probs, v)
+
+
+def flash_attention(q, k, v, mask=None, causal=False, scale=None):
+    """[B, T, N, H] attention; Pallas on TPU when tileable, XLA otherwise."""
+    B, T, N, H = q.shape
+    use_pallas = (
+        _on_tpu()
+        and mask is None
+        and k.shape[1] == T
+        and T % 128 == 0
+        and H in (64, 96, 128, 256)
+        and q.dtype in (jnp.float32, jnp.bfloat16)
+    )
+    if use_pallas:
+        blk = 256 if T % 256 == 0 else 128
+        return _flash_attention_tpu(q, k, v, causal=causal, scale=scale,
+                                    block_q=blk, block_k=blk)
+    return _attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)
+
+
+# =========================== fused softmax mask ==============================
+
+def fused_softmax_mask(x, mask):
+    """softmax(x + mask) fused (reference fused_softmax_mask_kernel.h)."""
+    return jax.nn.softmax(x + mask, axis=-1)
+
+
+def fused_softmax_mask_upper_triangle(x):
+    """Causal softmax (reference fused_softmax_mask_upper_triangle_op.cu)."""
+    T, S = x.shape[-2], x.shape[-1]
+    cm = jnp.tril(jnp.ones((T, S), bool))
+    return jax.nn.softmax(jnp.where(cm, x.astype(jnp.float32), -jnp.inf),
+                          axis=-1).astype(x.dtype)

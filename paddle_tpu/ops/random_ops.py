@@ -1,0 +1,137 @@
+"""Random sampling ops.
+
+Parity surface: `python/paddle/tensor/random.py` in the reference. All draws
+split the global functional Generator key (`core.random`), so random ops are
+reproducible under `paddle_tpu.seed` and jit-traceable when the generator
+state is threaded through a compiled step (see `jit.TrainStep`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import dtype as dtypes
+from ..core import random as prandom
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+from .creation import _shape, _device_const
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "uniform_",
+    "normal", "normal_", "standard_normal", "randperm", "multinomial",
+    "bernoulli", "poisson", "exponential_", "gumbel_softmax",
+]
+
+
+def _key_input():
+    return prandom.split_key()
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    s = _shape(shape)
+    d = dtypes.convert_dtype(dtype)
+    lo, hi = float(min), float(max)
+    return forward(
+        lambda k: jax.random.uniform(k, s, dtype=d, minval=lo, maxval=hi),
+        (_key_input(),), name="uniform", nondiff=True)
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    s = _shape(shape)
+    d = dtypes.convert_dtype(dtype)
+    return forward(lambda k: jax.random.normal(k, s, dtype=d), (_key_input(),),
+                   name="randn", nondiff=True)
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean if isinstance(mean, Tensor) else jnp.asarray(mean)
+        sd = std if isinstance(std, Tensor) else jnp.asarray(std)
+        return forward(
+            lambda k, mm, ss: mm + ss * jax.random.normal(
+                k, jnp.broadcast_shapes(mm.shape, ss.shape), dtype=jnp.result_type(mm)),
+            (_key_input(), m, sd), name="normal", nondiff=True)
+    s = _shape(shape)
+    d = dtypes.default_dtype().np_dtype
+    return forward(
+        lambda k: mean + std * jax.random.normal(k, s, dtype=d),
+        (_key_input(),), name="normal", nondiff=True)
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    s = _shape(shape)
+    d = dtypes.convert_dtype(dtype)
+    return forward(lambda k: jax.random.randint(k, s, int(low), int(high), dtype=d),
+                   (_key_input(),), name="randint", nondiff=True)
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    dtype = dtype or x.dtype
+    return randint(low, high, x.shape, dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    return forward(lambda k: jax.random.permutation(k, int(n)).astype(d),
+                   (_key_input(),), name="randperm", nondiff=True)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    def f(k, p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if replacement:
+            return jax.random.categorical(k, logits, axis=-1,
+                                          shape=(*p.shape[:-1], num_samples)
+                                          ).astype(jnp.int64)
+        # without replacement: gumbel top-k trick
+        g = jax.random.gumbel(k, p.shape)
+        return jax.lax.top_k(logits + g, num_samples)[1].astype(jnp.int64)
+    return forward(f, (_key_input(), x), name="multinomial", nondiff=True)
+
+
+def bernoulli(x, name=None):
+    return forward(lambda k, p: jax.random.bernoulli(k, p).astype(p.dtype),
+                   (_key_input(), x), name="bernoulli", nondiff=True)
+
+
+def poisson(x, name=None):
+    return forward(lambda k, lam: jax.random.poisson(k, lam).astype(lam.dtype),
+                   (_key_input(), x), name="poisson", nondiff=True)
+
+
+def uniform_(x, min=-1.0, max=1.0, name=None):
+    return x._rebind(uniform(x.shape, x.dtype, min, max))
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    return x._rebind(normal(mean, std, x.shape))
+
+
+def exponential_(x, lam=1.0, name=None):
+    out = forward(lambda k: jax.random.exponential(
+        k, tuple(x.shape), dtype=x._data.dtype) / lam, (_key_input(),),
+        name="exponential", nondiff=True)
+    return x._rebind(out)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    def f(k, logits):
+        g = jax.random.gumbel(k, logits.shape, dtype=logits.dtype)
+        y = jax.nn.softmax((logits + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            hard_y = jnp.zeros_like(y)
+            hard_y = jnp.put_along_axis(hard_y, idx, 1.0, axis=axis, inplace=False)
+            y = hard_y + y - jax.lax.stop_gradient(y)
+        return y
+    return forward(f, (_key_input(), x), name="gumbel_softmax")

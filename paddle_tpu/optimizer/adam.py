@@ -1,0 +1,247 @@
+"""Adam-family optimizers.
+
+Reference: `python/paddle/optimizer/{adam,adamw,adamax,adagrad,rmsprop,
+lamb}.py`; kernels `phi/kernels/gpu/adam_kernel.cu`, `adamw_kernel`,
+`lamb_kernel`. Master-weight (fp32 copy for bf16 params) follows the
+reference's multi_precision path — essential on TPU where params train in
+bf16."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["Adam", "AdamW", "Adamax", "Adagrad", "RMSProp", "Lamb"]
+
+
+class Adam(Optimizer):
+    _STATIC_ACCS = ["moment1", "moment2"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._multi_precision = multi_precision
+
+    def _create_accumulators(self, p):
+        self._acc("moment1", p, dtype=jnp.float32)
+        self._acc("moment2", p, dtype=jnp.float32)
+        if self._multi_precision and p._data.dtype != jnp.float32:
+            mw = self._acc("master_weight", p, dtype=jnp.float32)
+            mw._data = p._data.astype(jnp.float32)
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = self._opt_step
+        self._create_accumulators(p)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        use_master = self._multi_precision and p._data.dtype != jnp.float32
+        mw = self._acc("master_weight", p, dtype=jnp.float32) if use_master \
+            else None
+
+        def f(w, gg, mm, vv, *master):
+            gf = gg.astype(jnp.float32)
+            mm = b1 * mm + (1 - b1) * gf
+            vv = b2 * vv + (1 - b2) * jnp.square(gf)
+            mhat = mm / (1 - b1 ** t)
+            vhat = vv / (1 - b2 ** t)
+            base = master[0] if master else w.astype(jnp.float32)
+            new = base - lr * mhat / (jnp.sqrt(vhat) + eps)
+            outs = (new.astype(w.dtype), mm, vv)
+            if master:
+                outs += (new,)
+            return outs
+
+        ins = (p, g, m, v) + ((mw,) if use_master else ())
+        outs = forward(f, ins, name="adam", nondiff=True)
+        p._data = outs[0]._data
+        m._data = outs[1]._data
+        v._data = outs[2]._data
+        if use_master:
+            mw._data = outs[3]._data
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (reference `python/paddle/optimizer/adamw.py`)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._wd_coeff = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        wd = self._wd_coeff
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        t = self._opt_step
+        self._create_accumulators(p)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        use_master = self._multi_precision and p._data.dtype != jnp.float32
+        mw = self._acc("master_weight", p, dtype=jnp.float32) if use_master \
+            else None
+
+        def f(w, gg, mm, vv, *master):
+            gf = gg.astype(jnp.float32)
+            base = master[0] if master else w.astype(jnp.float32)
+            base = base * (1 - lr * wd)
+            mm = b1 * mm + (1 - b1) * gf
+            vv = b2 * vv + (1 - b2) * jnp.square(gf)
+            mhat = mm / (1 - b1 ** t)
+            vhat = vv / (1 - b2 ** t)
+            new = base - lr * mhat / (jnp.sqrt(vhat) + eps)
+            outs = (new.astype(w.dtype), mm, vv)
+            if master:
+                outs += (new,)
+            return outs
+
+        ins = (p, g, m, v) + ((mw,) if use_master else ())
+        outs = forward(f, ins, name="adamw", nondiff=True)
+        p._data = outs[0]._data
+        m._data = outs[1]._data
+        v._data = outs[2]._data
+        if use_master:
+            mw._data = outs[3]._data
+
+
+class Adamax(Optimizer):
+    _STATIC_ACCS = ["moment", "inf_norm"]
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        t = self._opt_step
+        m = self._acc("moment", p, dtype=jnp.float32)
+        u = self._acc("inf_norm", p, dtype=jnp.float32)
+
+        def f(w, gg, mm, uu):
+            gf = gg.astype(jnp.float32)
+            mm = b1 * mm + (1 - b1) * gf
+            uu = jnp.maximum(b2 * uu, jnp.abs(gf))
+            new = w.astype(jnp.float32) - lr / (1 - b1 ** t) * mm / (uu + eps)
+            return new.astype(w.dtype), mm, uu
+
+        outs = forward(f, (p, g, m, u), name="adamax", nondiff=True)
+        p._data, m._data, u._data = outs[0]._data, outs[1]._data, outs[2]._data
+
+
+class Adagrad(Optimizer):
+    _STATIC_ACCS = ["moment"]
+
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        eps = self._eps
+        acc = self._acc("moment", p, init=self._init_acc, dtype=jnp.float32)
+
+        def f(w, gg, aa):
+            gf = gg.astype(jnp.float32)
+            aa = aa + jnp.square(gf)
+            new = w.astype(jnp.float32) - lr * gf / (jnp.sqrt(aa) + eps)
+            return new.astype(w.dtype), aa
+
+        outs = forward(f, (p, g, acc), name="adagrad", nondiff=True)
+        p._data, acc._data = outs[0]._data, outs[1]._data
+
+
+class RMSProp(Optimizer):
+    _STATIC_ACCS = ["mean_square", "mean_grad", "velocity"]
+
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._eps = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        rho, eps, mom = self._rho, self._eps, self._momentum
+        ms = self._acc("mean_square", p, dtype=jnp.float32)
+        mg = self._acc("mean_grad", p, dtype=jnp.float32)
+        vel = self._acc("velocity", p, dtype=jnp.float32)
+        centered = self._centered
+
+        def f(w, gg, mss, mgg, vv):
+            gf = gg.astype(jnp.float32)
+            mss = rho * mss + (1 - rho) * jnp.square(gf)
+            if centered:
+                mgg = rho * mgg + (1 - rho) * gf
+                denom = mss - jnp.square(mgg)
+            else:
+                denom = mss
+            vv = mom * vv + lr * gf / jnp.sqrt(denom + eps)
+            new = w.astype(jnp.float32) - vv
+            return new.astype(w.dtype), mss, mgg, vv
+
+        outs = forward(f, (p, g, ms, mg, vel), name="rmsprop", nondiff=True)
+        p._data, ms._data = outs[0]._data, outs[1]._data
+        mg._data, vel._data = outs[2]._data, outs[3]._data
+
+
+class Lamb(Optimizer):
+    """Reference `python/paddle/optimizer/lamb.py` + lamb_kernel.cu; layerwise
+    trust ratio on top of Adam — the LAMB used by BERT large-batch pretrain."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
+            else self._wd
+        t = self._opt_step
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+
+        def f(w, gg, mm, vv):
+            gf = gg.astype(jnp.float32)
+            wf = w.astype(jnp.float32)
+            mm = b1 * mm + (1 - b1) * gf
+            vv = b2 * vv + (1 - b2) * jnp.square(gf)
+            mhat = mm / (1 - b1 ** t)
+            vhat = vv / (1 - b2 ** t)
+            r = mhat / (jnp.sqrt(vhat) + eps) + wd * wf
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
+            r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+            trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+            new = wf - lr * trust * r
+            return new.astype(w.dtype), mm, vv
+
+        outs = forward(f, (p, g, m, v), name="lamb", nondiff=True)
+        p._data, m._data, v._data = outs[0]._data, outs[1]._data, outs[2]._data

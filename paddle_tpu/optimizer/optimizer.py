@@ -1,0 +1,221 @@
+"""Optimizer base + SGD family.
+
+Reference: `python/paddle/optimizer/optimizer.py` (Optimizer base),
+`sgd.py`, `momentum.py`. Kernels (`phi/kernels/gpu/sgd_kernel.cu`,
+`momentum_kernel`) become pure jnp update functions; under a jitted train
+step XLA fuses all parameter updates into a handful of kernels (the
+reference needed multi_tensor/fused_* ops for that — on TPU it's free).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+from ..core.tensor import Parameter, Tensor
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        if parameters is None:
+            from ..core import dispatch
+
+            if dispatch.static_recorder is None:
+                raise ValueError(
+                    "parameters is required in dygraph mode (pass "
+                    "model.parameters()); static mode uses minimize().")
+            parameters = []
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        if isinstance(weight_decay, float):
+            from .regularizer import L2Decay
+
+            self._weight_decay = L2Decay(weight_decay)
+        else:
+            self._weight_decay = weight_decay
+        self._accumulators: dict[str, dict[int, Tensor]] = {}
+        self._opt_step = 0
+
+    # -- lr -------------------------------------------------------------------
+    def get_lr(self):
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return self._learning_rate
+
+    def set_lr(self, value):
+        self._learning_rate = value
+
+    def _lr_for(self, p):
+        return self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) \
+            if isinstance(p, Parameter) else self.get_lr()
+
+    # -- accumulators (reference Optimizer._add_accumulator) ------------------
+    def _acc(self, name, p, init=0.0, dtype=None):
+        store = self._accumulators.setdefault(name, {})
+        key = id(p)
+        if key not in store:
+            store[key] = Tensor(jnp.full(p._data.shape, init,
+                                         dtype or p._data.dtype))
+        return store[key]
+
+    # -- step -----------------------------------------------------------------
+    def _params_grads(self):
+        pg = []
+        for p in self._parameter_list:
+            if p is None or p.stop_gradient or p.grad is None:
+                continue
+            pg.append((p, p.grad))
+        return pg
+
+    def step(self):
+        pg = self._params_grads()
+        if self._weight_decay is not None:
+            pg = [(p, self._weight_decay(p, g)) for p, g in pg]
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        self._opt_step += 1
+        for p, g in pg:
+            self._apply_one(p, g)
+
+    def _apply_one(self, p, g):
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        from ..core import dispatch
+
+        if dispatch.static_recorder is not None:
+            # declarative mode: record backward+update into the Program
+            return dispatch.static_recorder.minimize(self, loss)
+        loss.backward()
+        self.step()
+        return None, self._params_grads()
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            if p is not None:
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- state ----------------------------------------------------------------
+    def state_dict(self):
+        sd = {}
+        for name, store in self._accumulators.items():
+            for p in self._parameter_list:
+                if p is not None and id(p) in store:
+                    sd[f"{name}/{p.name or id(p)}"] = store[id(p)]
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["_opt_step"] = self._opt_step
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._opt_step = int(state_dict.get("_opt_step", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for name, store in self._accumulators.items():
+            for p in self._parameter_list:
+                key = f"{name}/{p.name or id(p)}"
+                if p is not None and key in state_dict:
+                    v = state_dict[key]
+                    store[id(p)] = v if isinstance(v, Tensor) else Tensor(v)
+
+    # -- static (declarative) mode hooks --------------------------------------
+    _STATIC_ACCS: list[str] = []
+
+    def _static_acc_names(self):
+        return type(self)._STATIC_ACCS
+
+    def _static_apply(self, oi, step_arr, pairs, state):
+        """Apply updates inside an Executor trace (static/executor.py).
+
+        pairs: [(Variable, traced param Tensor with .grad set)]. Accumulators
+        are seeded from / written back to `state` (the Scope-backed dict), so
+        the whole optimizer step compiles into the program's XLA executable —
+        the reference needed per-op optimizer kernels + a program rewrite pass
+        (fleet/meta_optimizers) for the same effect.
+        """
+        prev_step = self._opt_step
+        self._opt_step = step_arr
+        try:
+            pg = [(pt, pt.grad) for _, pt in pairs if pt.grad is not None]
+            if self._weight_decay is not None:
+                pg = [(p, self._weight_decay(p, g)) for p, g in pg]
+            if self._grad_clip is not None:
+                pg = self._grad_clip(pg)
+            grads = {id(p): g for p, g in pg}
+            for pv, pt in pairs:
+                g = grads.get(id(pt))
+                if g is None:
+                    continue
+                for acc in self._static_acc_names():
+                    key = f"@opt{oi}@{acc}@{pv.name}"
+                    self._accumulators.setdefault(acc, {})[id(pt)] = \
+                        Tensor(state[key])
+                self._apply_one(pt, g)
+                for acc in self._static_acc_names():
+                    key = f"@opt{oi}@{acc}@{pv.name}"
+                    state[key] = self._accumulators[acc][id(pt)]._data
+        finally:
+            self._opt_step = prev_step
+
+    def _ensure_accumulators(self):
+        """Materialize all state now (used by ZeRO sharding wrappers)."""
+        for p in self._parameter_list:
+            if p is not None and not p.stop_gradient:
+                self._create_accumulators(p)
+
+    def _create_accumulators(self, p):
+        pass
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        new_p = forward(lambda w, gg: w - lr * gg.astype(w.dtype), (p, g),
+                        name="sgd", nondiff=True)
+        p._data = new_p._data
+
+
+class Momentum(Optimizer):
+    _STATIC_ACCS = ["velocity"]
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _create_accumulators(self, p):
+        self._acc("velocity", p)
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        mu = self._momentum
+        vel = self._acc("velocity", p)
+
+        def f(w, gg, v):
+            gg = gg.astype(w.dtype)
+            v_new = mu * v + gg
+            if self._nesterov:
+                w_new = w - lr * (gg + mu * v_new)
+            else:
+                w_new = w - lr * v_new
+            return w_new, v_new
+
+        new_p, new_v = forward(f, (p, g, vel), name="momentum", nondiff=True)
+        p._data = new_p._data
+        vel._data = new_v._data

@@ -1,0 +1,37 @@
+"""Regularizers (reference `python/paddle/regularizer.py`). Applied as
+grad += coeff * f(param) before the update, matching append_regularization_ops
+semantics (param-level regularizer overrides optimizer-level)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class _Decay:
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        reg = param.regularizer if getattr(param, "regularizer", None) is not None \
+            else self
+        if reg is not self:
+            return reg(param, grad) if not isinstance(reg, _Decay) \
+                else reg._apply(param, grad)
+        return self._apply(param, grad)
+
+
+class L2Decay(_Decay):
+    def _apply(self, param, grad):
+        c = self._coeff
+        return forward(lambda g, w: g + c * w.astype(g.dtype), (grad, param),
+                       name="l2decay", nondiff=True)
+
+
+class L1Decay(_Decay):
+    def _apply(self, param, grad):
+        c = self._coeff
+        return forward(lambda g, w: g + c * jnp.sign(w).astype(g.dtype),
+                       (grad, param), name="l1decay", nondiff=True)

@@ -1,0 +1,179 @@
+"""Profiler.
+
+Reference: `python/paddle/profiler/profiler.py:344` (Profiler with scheduler
+states, chrome-trace export) over the C++ unified profiler
+(`fluid/platform/profiler/profiler.h:47`: HostTracer + CudaTracer/CUPTI +
+CustomTracer).
+
+TPU re-design: the device tracer is libtpu's, surfaced through
+`jax.profiler` (XPlane). `Profiler` keeps the reference's state machine
+(CLOSED/READY/RECORD/RECORD_AND_RETURN) and emits a TensorBoard-compatible
+trace directory; `RecordEvent` maps to `jax.profiler.TraceAnnotation`
+(host events nested into the device timeline, same UX as the reference's
+RecordEvent → chrome trace).
+"""
+from __future__ import annotations
+
+import enum
+import os
+import time
+
+import jax
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+
+
+class ProfilerTarget(enum.Enum):
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM_DEVICE = 3
+
+
+class ProfilerState(enum.Enum):
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Reference profiler.py:79 scheduler factory."""
+
+    def sched(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = (step - skip_first) % max(closed + ready + record, 1)
+        if repeat and (step - skip_first) // max(closed + ready + record, 1) \
+                >= repeat:
+            return ProfilerState.CLOSED
+        if s < closed:
+            return ProfilerState.CLOSED
+        if s < closed + ready:
+            return ProfilerState.READY
+        if s == closed + ready + record - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return sched
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._export_dir = dir_name
+
+    return handler
+
+
+class RecordEvent:
+    """Host-side event annotation (reference event_tracing.h RecordEvent)."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ctx = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+    def begin(self):
+        self._ctx = jax.profiler.TraceAnnotation(self.name)
+        self._ctx.__enter__()
+
+    def end(self):
+        if self._ctx is not None:
+            self._ctx.__exit__(None, None, None)
+            self._ctx = None
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 timer_only=False, record_shapes=False, profile_memory=False):
+        self._scheduler = scheduler if callable(scheduler) else (
+            make_scheduler(record=scheduler[1] - scheduler[0],
+                           skip_first=scheduler[0])
+            if isinstance(scheduler, (tuple, list)) else (lambda s:
+                                                          ProfilerState.RECORD))
+        self._on_trace_ready = on_trace_ready
+        self._timer_only = timer_only
+        self._export_dir = None
+        self._step = 0
+        self._running = False
+        self._step_times = []
+        self._last_t = None
+
+    def start(self):
+        self._state = self._scheduler(self._step)
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN) \
+                and not self._timer_only:
+            self._begin_trace()
+        self._last_t = time.perf_counter()
+
+    def _begin_trace(self):
+        if not self._running:
+            d = self._export_dir or os.environ.get(
+                "PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+            os.makedirs(d, exist_ok=True)
+            try:
+                jax.profiler.start_trace(d)
+                self._running = True
+            except RuntimeError:
+                pass
+
+    def _end_trace(self):
+        if self._running:
+            jax.profiler.stop_trace()
+            self._running = False
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last_t is not None:
+            self._step_times.append(now - self._last_t)
+        self._last_t = now
+        self._step += 1
+        prev = getattr(self, "_state", ProfilerState.CLOSED)
+        self._state = self._scheduler(self._step)
+        if self._state in (ProfilerState.RECORD,
+                           ProfilerState.RECORD_AND_RETURN):
+            if not self._timer_only:
+                self._begin_trace()
+        elif prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN):
+            if not self._timer_only:
+                self._end_trace()
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def stop(self):
+        self._end_trace()
+        if self._on_trace_ready:
+            self._on_trace_ready(self)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        if not self._step_times:
+            return "no steps recorded"
+        import numpy as np
+
+        ts = np.asarray(self._step_times) * 1e3
+        return (f"steps={len(ts)} avg={ts.mean():.3f}ms p50="
+                f"{np.percentile(ts, 50):.3f}ms p99="
+                f"{np.percentile(ts, 99):.3f}ms")
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError(
+        "use TensorBoard / xprof on the exported trace directory")

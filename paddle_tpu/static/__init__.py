@@ -1,0 +1,52 @@
+"""paddle_tpu.static — declarative (static-graph) mode.
+
+Reference: `python/paddle/static/` over fluid Program/Executor. See
+program.py / executor.py docstrings for the TPU re-design (whole-program XLA
+compilation replaces InterpreterCore)."""
+from .executor import Executor  # noqa: F401
+from .program import (Program, Variable, data, default_main_program,  # noqa: F401
+                      default_startup_program, global_scope, name_scope,
+                      program_guard, scope_guard, Scope)
+from . import nn  # noqa: F401
+
+
+class InputSpec:
+    """`paddle.static.InputSpec` (python/paddle/static/input.py)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = list(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype.name, name or tensor.name)
+
+
+def cpu_places(device_count=None):
+    from ..core.place import CPUPlace
+
+    return [CPUPlace()]
+
+
+def cuda_places(device_ids=None):
+    from ..core.place import TPUPlace, device_count as _dc
+
+    ids = device_ids if device_ids is not None else range(_dc())
+    return [TPUPlace(i) for i in ids]
+
+
+tpu_places = cuda_places
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """`paddle.static.append_backward` — records the backward request; the
+    Executor materializes gradients via the tape at compile time."""
+    prog = loss.program or default_main_program()
+    params = parameter_list or [v for v, _ in prog.params
+                                if not v.stop_gradient]
+    prog.backward_req = (loss, params)
+    return [(p, None) for p in params]

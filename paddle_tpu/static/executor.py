@@ -1,0 +1,188 @@
+"""Static-graph Executor.
+
+Reference call stack CS-3: `Executor.run` (python/paddle/fluid/executor.py:
+1298) → `_ExecutorCache` (:750) → StandaloneExecutor/InterpreterCore
+(`framework/new_executor/interpretercore.cc:1052` ExecuteInstructionList).
+
+TPU re-design: `Executor.run` replays the Program's op record through the
+dygraph dispatch layer *under `jax.jit`*, producing ONE whole-program XLA
+executable per (program, feed-signature, fetch-set) — cached like
+_ExecutorCache. Gradients for `Optimizer.minimize` come from the same tape
+engine the dygraph mode uses (running inside the trace), and parameter /
+optimizer-state updates are returned functionally and written back to the
+Scope. DependencyBuilder/StreamAnalyzer/GC have no equivalent to port: XLA's
+scheduler owns all of it.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd, dispatch
+from ..core.tensor import Parameter, Tensor
+from . import program as prog_mod
+from .program import Program, Variable, global_scope
+
+__all__ = ["Executor"]
+
+
+def _resolve_fetch(program, fetch_list):
+    out = []
+    for f in fetch_list or []:
+        if isinstance(f, Variable):
+            out.append(f)
+        elif isinstance(f, str):
+            out.append(program.vars[f])
+        else:
+            raise TypeError(f"bad fetch entry {f!r}")
+    return out
+
+
+class _CompiledStep:
+    def __init__(self, program: Program, feed_names, fetch_vars, scope):
+        self.program = program
+        self.feed_names = list(feed_names)
+        self.fetch_vars = fetch_vars
+        self.scope = scope
+        self.param_vars = [v for v, _ in program.params]
+        self.has_opt = bool(program.minimize_reqs)
+        # optimizer state lives in the scope under reserved names
+        self.opt_state_names: list[str] = []
+        if self.has_opt:
+            self._init_opt_state()
+        self._jitted = jax.jit(self._step)
+
+    # ---------------------------------------------------------------- state
+    def _init_opt_state(self):
+        for oi, (opt, loss_var) in enumerate(self.program.minimize_reqs):
+            tname = f"@opt{oi}@step"
+            if tname not in self.scope.vars:
+                self.scope.set(tname, jnp.zeros((), jnp.float32))
+            self.opt_state_names.append(tname)
+            for pv in self.param_vars:
+                if pv.stop_gradient:
+                    continue
+                for acc in opt._static_acc_names():
+                    name = f"@opt{oi}@{acc}@{pv.name}"
+                    if name not in self.scope.vars:
+                        init = self.scope.vars.get(pv.name)
+                        shape = init.shape if init is not None \
+                            else tuple(1 if s == -1 else s for s in
+                                       pv._static_shape)
+                        self.scope.set(name, jnp.zeros(shape, jnp.float32))
+                    self.opt_state_names.append(name)
+
+    # ---------------------------------------------------------------- trace
+    def _replay(self, env):
+        """Execute op records through the dygraph dispatch (tape active)."""
+        def resolve(ref):
+            if isinstance(ref, Variable):
+                return env[ref.vid]
+            return ref
+
+        for op in self.program.ops:
+            ins = tuple(resolve(r) for r in op.inputs)
+            out = dispatch.forward(op.fn, ins, dict(op.attrs), name=op.name)
+            outs = out if isinstance(out, tuple) else (out,)
+            for v, o in zip(op.outputs, outs):
+                env[v.vid] = o
+
+    def _step(self, feed_arrays, param_arrays, opt_arrays):
+        # bind params as trainable leaf tensors
+        env = {}
+        param_tensors = {}
+        for pv, arr in zip(self.param_vars, param_arrays):
+            t = Tensor(arr, stop_gradient=pv.stop_gradient)
+            env[pv.vid] = t
+            param_tensors[pv.name] = t
+        for name, arr in zip(self.feed_names, feed_arrays):
+            env[self.program.feed_vars[name].vid] = Tensor(arr)
+
+        train = self.has_opt
+        with autograd._scoped(train):
+            self._replay(env)
+
+        new_opt = dict(zip(self.opt_state_names, opt_arrays))
+        if train:
+            for oi, (opt, loss_var) in enumerate(self.program.minimize_reqs):
+                loss_t = env[loss_var.vid]
+                loss_t.backward()
+                step_arr = new_opt[f"@opt{oi}@step"] + 1.0
+                new_opt[f"@opt{oi}@step"] = step_arr
+                trainables = [pv for pv in self.param_vars
+                              if not pv.stop_gradient]
+                opt._static_apply(
+                    oi, step_arr,
+                    [(pv, param_tensors[pv.name]) for pv in trainables],
+                    new_opt)
+
+        fetches = tuple(env[v.vid]._data for v in self.fetch_vars)
+        new_params = tuple(param_tensors[pv.name]._data
+                           for pv in self.param_vars)
+        new_opt_tuple = tuple(new_opt[n] for n in self.opt_state_names)
+        return fetches, new_params, new_opt_tuple
+
+    # ----------------------------------------------------------------- run
+    def run(self, feed):
+        feed_arrays = tuple(np.asarray(feed[n]) for n in self.feed_names)
+        param_arrays = tuple(self.scope.vars[pv.name]
+                             for pv in self.param_vars)
+        opt_arrays = tuple(self.scope.vars[n] for n in self.opt_state_names)
+        fetches, new_params, new_opt = self._jitted(feed_arrays, param_arrays,
+                                                    opt_arrays)
+        for pv, arr in zip(self.param_vars, new_params):
+            self.scope.set(pv.name, arr)
+        for n, arr in zip(self.opt_state_names, new_opt):
+            self.scope.set(n, arr)
+        return [np.asarray(f) for f in fetches]
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+        self._cache: dict = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, scope=None,
+            return_numpy=True):
+        program = program or prog_mod.default_main_program()
+        feed = feed or {}
+        scope = scope or global_scope()
+
+        # startup program: (re)initialize parameters into the scope
+        if program is prog_mod.default_startup_program() or (
+                not program.ops and program.params and not fetch_list):
+            for pv, init in prog_mod.default_main_program().params:
+                if scope.find_var(pv.name) is None:
+                    scope.set(pv.name, init)
+            for pv, init in program.params:
+                scope.set(pv.name, init)
+            return []
+
+        # lazy param init for the main program
+        for pv, init in program.params:
+            if scope.find_var(pv.name) is None:
+                scope.set(pv.name, init)
+
+        fetch_vars = _resolve_fetch(program, fetch_list)
+        sig = (id(program), program._version, len(program.ops),
+               tuple(sorted((n, tuple(np.asarray(a).shape),
+                             str(np.asarray(a).dtype))
+                            for n, a in feed.items())),
+               tuple(v.vid for v in fetch_vars))
+        step = self._cache.get(sig)
+        if step is None:
+            # replay happens in dygraph dispatch: temporarily uninstall the
+            # recorder while tracing
+            step = _CompiledStep(program, feed.keys(), fetch_vars, scope)
+            self._cache[sig] = step
+
+        prev = dispatch.static_recorder
+        dispatch.static_recorder = None
+        try:
+            return step.run(feed)
+        finally:
+            dispatch.static_recorder = prev
+
+    def close(self):
+        self._cache.clear()

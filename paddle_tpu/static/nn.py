@@ -1,0 +1,72 @@
+"""`paddle.static.nn` — static-only layer helpers (reference
+`python/paddle/static/nn/common.py`: fc, embedding, batch_norm...). These
+create parameters directly in the default main program."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..core import dtype as dtypes
+from ..nn.initializer import Constant, ParamAttr, XavierUniform
+from . import program as prog_mod
+from .program import Variable
+
+
+def _make_param(shape, dtype, attr=None, is_bias=False, name_hint="w"):
+    attr = ParamAttr._to_attr(attr)
+    init = attr.initializer or (Constant(0.0) if is_bias else XavierUniform())
+    arr = init(tuple(shape), dtype)
+    prog = prog_mod.default_main_program()
+    v = Variable(list(shape), dtypes.convert_dtype(dtype),
+                 name=attr.name or f"{name_hint}_{len(prog.params)}",
+                 is_param=True, trainable=attr.trainable)
+    prog._add_var(v)
+    prog.params.append((v, arr))
+    return v
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    from .. import ops
+
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    w = _make_param([in_dim, size], "float32", weight_attr, name_hint="fc_w")
+    flat = ops.flatten(x, num_flatten_dims, -1) if x.ndim > num_flatten_dims + 1 \
+        else x
+    out = ops.matmul(flat, w)
+    if bias_attr is not False:
+        b = _make_param([size], "float32", bias_attr, is_bias=True,
+                        name_hint="fc_b")
+        out = ops.add(out, b)
+    if activation:
+        from ..ops import activation as act_mod
+
+        out = getattr(act_mod, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    from ..ops import nn_ops
+
+    w = _make_param(list(size), dtype, param_attr, name_hint="emb_w")
+    return nn_ops.embedding(input, w, padding_idx=padding_idx)
+
+
+def batch_norm(input, epsilon=1e-5, momentum=0.9, param_attr=None,
+               bias_attr=None, data_layout="NCHW", is_test=False, name=None):
+    from ..ops import nn_ops
+
+    C = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    scale = _make_param([C], "float32", param_attr or ParamAttr(
+        initializer=Constant(1.0)), name_hint="bn_scale")
+    bias = _make_param([C], "float32", bias_attr, is_bias=True,
+                       name_hint="bn_bias")
+    mean = _make_param([C], "float32", ParamAttr(initializer=Constant(0.0),
+                                                 trainable=False),
+                       name_hint="bn_mean")
+    var = _make_param([C], "float32", ParamAttr(initializer=Constant(1.0),
+                                                trainable=False),
+                      name_hint="bn_var")
+    return nn_ops.batch_norm(input, mean, var, scale, bias,
+                             training=not is_test, momentum=momentum,
+                             epsilon=epsilon, data_format=data_layout)

@@ -1,0 +1,340 @@
+"""Static (declarative) mode: Program / Variable / recording.
+
+Reference: `python/paddle/fluid/framework.py` Program/Block/Variable over
+protobuf ProgramDesc, executed by InterpreterCore
+(`framework/new_executor/interpretercore.cc`).
+
+TPU re-design: a Program is a linear record of functional ops (the same jnp
+kernels the dygraph mode dispatches) captured through
+`core.dispatch.static_recorder`. There is no OpDesc/proto, no kernel
+selection pass, no data-transfer insertion, no stream analysis — the
+Executor replays the record once under `jax.jit` and XLA performs scheduling,
+fusion, memory planning and (on TPU pods) collective lowering. That replay
+IS the InterpreterCore equivalent; BuildOpFuncList collapses into a Python
+loop, and the whole-Program XLA executable is the static-mode win the
+reference could not get per-op.
+
+Record-time shape metadata uses `jax.eval_shape` (the InferMeta equivalent);
+dims declared None/-1 in `static.data` are specialized at first run per feed
+shape (the executor caches one XLA executable per observed signature, like
+the reference's `_ExecutorCache`, executor.py:750).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+
+from ..core import dispatch
+from ..core import dtype as dtypes
+from ..core.tensor import Parameter, Tensor
+
+__all__ = ["Program", "Variable", "program_guard", "default_main_program",
+           "default_startup_program", "data", "name_scope"]
+
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (framework.py Variable equivalent)."""
+
+    _counter = 0
+
+    def __init__(self, shape, dtype, name=None, is_param=False,
+                 trainable=True, program=None):
+        super().__init__(None)
+        Variable._counter += 1
+        self.vid = Variable._counter
+        self.name = name or f"var_{self.vid}"
+        self._static_shape = [(-1 if s in (None, -1) else int(s))
+                              for s in shape]
+        self._np_dtype = dtypes.convert_dtype(dtype)
+        self.is_param = is_param
+        self.stop_gradient = not trainable if is_param else True
+        self.persistable = is_param
+        self.program = program
+
+    # shape/dtype come from metadata, not a payload
+    @property
+    def shape(self):
+        return list(self._static_shape)
+
+    @property
+    def dtype(self):
+        return dtypes.to_paddle_dtype(self._np_dtype)
+
+    @property
+    def ndim(self):
+        return len(self._static_shape)
+
+    def aval(self, placeholder=2):
+        """ShapeDtypeStruct with dynamic dims specialized to `placeholder`
+        (record-time only; the executor traces with real shapes). The
+        recorder evaluates with two placeholder values and marks output
+        dims that vary as dynamic (-1) — concrete-value shape polymorphism,
+        the InferMeta equivalent for dynamic batch dims."""
+        return jax.ShapeDtypeStruct(
+            tuple(placeholder if s == -1 else s for s in self._static_shape),
+            self._np_dtype)
+
+    # record-time helpers: some op wrappers read x._data.shape
+    @property
+    def _data(self):
+        return self.aval()
+
+    @_data.setter
+    def _data(self, v):
+        pass
+
+    def numpy(self):
+        scope = global_scope()
+        if self.name in scope.vars:
+            return np.asarray(scope.vars[self.name])
+        raise RuntimeError(
+            f"Variable {self.name} has no value yet; run the program first.")
+
+    def __repr__(self):
+        return (f"Variable(name={self.name}, shape={self.shape}, "
+                f"dtype={self.dtype.name}, param={self.is_param})")
+
+
+class OpRecord:
+    __slots__ = ("fn", "name", "inputs", "attrs", "outputs")
+
+    def __init__(self, fn, name, inputs, attrs, outputs):
+        self.fn = fn
+        self.name = name
+        self.inputs = inputs  # list of Variable | concrete jax/np array
+        self.attrs = attrs
+        self.outputs = outputs  # list of Variable
+
+
+class Program:
+    """Reference framework.py Program (single-block form)."""
+
+    def __init__(self):
+        self.ops: list[OpRecord] = []
+        self.vars: dict[str, Variable] = {}
+        self.params: list[tuple[Variable, object]] = []  # (var, init array)
+        self.feed_vars: dict[str, Variable] = {}
+        self.minimize_reqs: list = []  # (optimizer, loss_var)
+        self.backward_req = None  # (loss_var, param_vars)
+        self.random_seed = None
+        self._version = 0
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        import copy
+
+        p = Program()
+        p.ops = list(self.ops)
+        p.vars = dict(self.vars)
+        p.params = list(self.params)
+        p.feed_vars = dict(self.feed_vars)
+        if not for_test:
+            p.minimize_reqs = list(self.minimize_reqs)
+            p.backward_req = self.backward_req
+        return p
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def all_parameters(self):
+        return [v for v, _ in self.params]
+
+    def _add_var(self, v):
+        self.vars[v.name] = v
+        v.program = self
+        self._version += 1
+        return v
+
+    def __repr__(self):
+        return (f"Program(ops={len(self.ops)}, params={len(self.params)}, "
+                f"feeds={list(self.feed_vars)})")
+
+
+_main_program = Program()
+_startup_program = Program()
+_static_mode = False
+
+
+def default_main_program():
+    return _main_program
+
+
+def default_startup_program():
+    return _startup_program
+
+
+def in_static_mode():
+    return _static_mode
+
+
+class program_guard:
+    def __init__(self, main_program, startup_program=None):
+        self.main = main_program
+        self.startup = startup_program
+
+    def __enter__(self):
+        global _main_program, _startup_program
+        self._prev = (_main_program, _startup_program)
+        _main_program = self.main
+        if self.startup is not None:
+            _startup_program = self.startup
+        return self
+
+    def __exit__(self, *exc):
+        global _main_program, _startup_program
+        _main_program, _startup_program = self._prev
+        return False
+
+
+class name_scope:
+    def __init__(self, prefix=None):
+        self.prefix = prefix
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+# -- the recorder hook (installed into core.dispatch) -------------------------
+
+def _recorder(fn, name, inputs, attrs):
+    prog = _main_program
+    in_refs = []
+    for x in inputs:
+        if isinstance(x, Variable):
+            in_refs.append(x)
+        elif isinstance(x, Parameter) and x._data is not None:
+            # dygraph-created Parameter used under static mode: promote to a
+            # program parameter once, keyed by object id
+            v = getattr(x, "_static_var", None)
+            if v is None:
+                v = Variable(list(x._data.shape), x._data.dtype,
+                             name=x.name, is_param=True,
+                             trainable=not x.stop_gradient)
+                object.__setattr__(x, "_static_var", v) if False else \
+                    setattr(x, "_static_var", v)
+                prog._add_var(v)
+                prog.params.append((v, x._data))
+            in_refs.append(v)
+        elif isinstance(x, Tensor):
+            in_refs.append(x._data)  # baked constant
+        else:
+            in_refs.append(x)
+
+    # InferMeta via eval_shape on record-time avals. Two placeholder values
+    # for dynamic (-1) dims: output dims that differ between the passes are
+    # themselves dynamic and recorded as -1, so downstream `.shape` reads
+    # stay batch-polymorphic (user code sees -1 and passes it to reshape).
+    def _eval(ph):
+        avals = [r.aval(ph) if isinstance(r, Variable) else r
+                 for r in in_refs]
+        return jax.eval_shape(functools.partial(fn, **attrs), *avals)
+
+    has_dynamic = any(isinstance(r, Variable) and -1 in r._static_shape
+                      for r in in_refs)
+    try:
+        out_a = _eval(2)
+        out_b = _eval(3) if has_dynamic else out_a
+    except Exception:
+        out_a = out_b = None
+
+    def mk_var(aval, aval_b):
+        if aval is None:
+            v = Variable([-1], np.float32)
+        else:
+            shape = [(-1 if sa != sb else sa)
+                     for sa, sb in zip(aval.shape, aval_b.shape)]
+            v = Variable(shape, aval.dtype)
+        prog._add_var(v)
+        return v
+
+    if out_a is None:
+        outs = [mk_var(None, None)]
+        multi = False
+    elif isinstance(out_a, (tuple, list)):
+        outs = [mk_var(a, b) for a, b in zip(out_a, out_b)]
+        multi = True
+    else:
+        outs = [mk_var(out_a, out_b)]
+        multi = False
+
+    prog.ops.append(OpRecord(fn, name, in_refs, attrs, outs))
+    return tuple(outs) if multi else outs[0]
+
+
+class _Recorder:
+    """Bound as dispatch.static_recorder; also carries optimizer hooks."""
+
+    def __call__(self, fn, name, inputs, attrs):
+        return _recorder(fn, name, inputs, attrs)
+
+    def minimize(self, optimizer, loss):
+        _main_program.minimize_reqs.append((optimizer, loss))
+        return None, []
+
+
+def _enable_static():
+    global _static_mode
+    _static_mode = True
+    dispatch.static_recorder = _Recorder()
+
+
+def _disable_static():
+    global _static_mode
+    _static_mode = False
+    dispatch.static_recorder = None
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    """`paddle.static.data` (python/paddle/static/input.py)."""
+    v = Variable(shape, dtype, name=name)
+    _main_program._add_var(v)
+    _main_program.feed_vars[name] = v
+    return v
+
+
+# -- scope --------------------------------------------------------------------
+
+class Scope:
+    """Name → value store (reference framework/scope.h via executor)."""
+
+    def __init__(self):
+        self.vars: dict[str, object] = {}
+
+    def var(self, name):
+        return self.vars.setdefault(name, None)
+
+    def find_var(self, name):
+        return self.vars.get(name)
+
+    def set(self, name, value):
+        self.vars[name] = value
+
+
+_global_scope = Scope()
+
+
+def global_scope():
+    return _global_scope
+
+
+def scope_guard(scope):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        global _global_scope
+        prev = _global_scope
+        _global_scope = scope
+        try:
+            yield
+        finally:
+            _global_scope = prev
+
+    return guard()

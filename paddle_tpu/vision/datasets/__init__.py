@@ -1,0 +1,112 @@
+"""Vision datasets (reference `python/paddle/vision/datasets/`).
+
+Real MNIST/CIFAR parsing when local files exist; `FakeData` provides the
+synthetic fallback used by benchmarks and CI (no network in this image).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Deterministic synthetic image dataset."""
+
+    def __init__(self, num_samples=1000, image_shape=(3, 32, 32),
+                 num_classes=10, transform=None, seed=0):
+        self.num_samples = num_samples
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.seed = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.default_rng(self.seed + idx)
+        img = rng.standard_normal(self.image_shape).astype(np.float32)
+        label = int(rng.integers(0, self.num_classes))
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(label)
+
+    def __len__(self):
+        return self.num_samples
+
+
+class MNIST(Dataset):
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend=None):
+        self.transform = transform
+        base = os.environ.get("MNIST_DATA_HOME", os.path.expanduser(
+            "~/.cache/paddle_tpu/mnist"))
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            base, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            base, f"{prefix}-labels-idx1-ubyte.gz")
+        if not os.path.exists(image_path):
+            raise FileNotFoundError(
+                f"MNIST files not found at {image_path}; no network in this "
+                "environment — place files locally or use FakeData.")
+        with gzip.open(image_path, "rb") as f:
+            data = np.frombuffer(f.read(), np.uint8, offset=16)
+        self.images = data.reshape(-1, 28, 28)
+        with gzip.open(label_path, "rb") as f:
+            self.labels = np.frombuffer(f.read(), np.uint8, offset=8)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, np.int64(self.labels[idx])
+
+    def __len__(self):
+        return len(self.images)
+
+
+FashionMNIST = MNIST
+
+
+class Cifar10(Dataset):
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        self.transform = transform
+        data_file = data_file or os.path.join(
+            os.environ.get("CIFAR_DATA_HOME", os.path.expanduser(
+                "~/.cache/paddle_tpu/cifar")), "cifar-10-python.tar.gz")
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"CIFAR archive not found at {data_file}; no network in this "
+                "environment — place the archive locally or use FakeData.")
+        batches = ([f"data_batch_{i}" for i in range(1, 6)]
+                   if mode == "train" else ["test_batch"])
+        xs, ys = [], []
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                name = os.path.basename(m.name)
+                if name in batches:
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    pass

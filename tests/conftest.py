@@ -1,0 +1,18 @@
+"""Test env: force a virtual 8-device CPU mesh BEFORE jax initializes.
+
+Mirrors the reference's fake_cpu_device.h pattern (SURVEY §4): distributed/
+sharding tests run against virtual devices, no TPU pod needed.
+
+Note: on hosts with the axon TPU tunnel, prefer launching as
+    PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q
+so the axon PJRT plugin is never registered (it is registered from
+sitecustomize at interpreter start, before this file runs, and its
+initialization contacts the TPU tunnel).
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "--xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()

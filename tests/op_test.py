@@ -1,0 +1,74 @@
+"""OpTest harness — numeric-check scaffolding for op tests.
+
+Re-implementation of the reference's single most important test harness
+(`python/paddle/fluid/tests/unittests/eager_op_test.py:325`): check_output
+compares an op against a NumPy reference; check_grad compares analytic
+gradients (tape backward) against central finite differences
+(`eager_op_test.py get_numeric_gradient:132`).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.core.tensor import Tensor
+
+
+def check_output(op_fn, np_fn, inputs, attrs=None, rtol=1e-5, atol=1e-6):
+    """Run op_fn(*tensors, **attrs) and compare to np_fn(*arrays, **attrs)."""
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(a) for a in inputs]
+    out = op_fn(*tensors, **attrs)
+    ref = np_fn(*[np.asarray(a) for a in inputs], **attrs)
+    outs = out if isinstance(out, (tuple, list)) else [out]
+    refs = ref if isinstance(ref, (tuple, list)) else [ref]
+    for o, r in zip(outs, refs):
+        np.testing.assert_allclose(o.numpy(), r, rtol=rtol, atol=atol)
+    return outs
+
+
+def numeric_grad(op_fn, inputs, wrt, attrs=None, out_grad=None, delta=1e-3):
+    """Central finite differences on float64 copies."""
+    attrs = attrs or {}
+    arrays = [np.asarray(a, dtype=np.float64) for a in inputs]
+
+    def f(xs):
+        ts = [paddle.to_tensor(x.astype(np.float32)) for x in xs]
+        with paddle.no_grad():
+            out = op_fn(*ts, **attrs)
+        o = out[0] if isinstance(out, (tuple, list)) else out
+        val = o.numpy().astype(np.float64)
+        if out_grad is not None:
+            return (val * out_grad).sum()
+        return val.sum()
+
+    x = arrays[wrt]
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gflat = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + delta
+        hi = f(arrays)
+        flat[i] = orig - delta
+        lo = f(arrays)
+        flat[i] = orig
+        gflat[i] = (hi - lo) / (2 * delta)
+    return g
+
+
+def check_grad(op_fn, inputs, wrt_list=None, attrs=None, rtol=1e-2, atol=1e-3,
+               delta=1e-3):
+    """Compare tape backward() grads with finite differences."""
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=False)
+               for a in inputs]
+    out = op_fn(*tensors, **attrs)
+    o = out[0] if isinstance(out, (tuple, list)) else out
+    o.sum().backward()
+    wrt_list = wrt_list if wrt_list is not None else range(len(inputs))
+    for w in wrt_list:
+        analytic = tensors[w].grad.numpy()
+        numeric = numeric_grad(op_fn, inputs, w, attrs, delta=delta)
+        np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
+                                   err_msg=f"grad mismatch wrt input {w}")

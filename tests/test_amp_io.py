@@ -1,0 +1,165 @@
+"""AMP / DataLoader / vision / metric / store tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestAmp:
+    def test_auto_cast_o1(self):
+        x = paddle.randn([4, 8])
+        w = paddle.randn([8, 8])
+        with paddle.amp.auto_cast(level="O1", dtype="bfloat16"):
+            y = paddle.matmul(x, w)  # white list → bf16
+            z = paddle.exp(x)  # black list → fp32
+        assert y.dtype == paddle.bfloat16
+        assert z.dtype == paddle.float32
+
+    def test_auto_cast_disabled(self):
+        x = paddle.randn([4, 8])
+        with paddle.amp.auto_cast(enable=False):
+            y = paddle.matmul(x, x.T)
+        assert y.dtype == paddle.float32
+
+    def test_grad_scaler_flow(self):
+        paddle.seed(0)
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        x = paddle.randn([2, 4])
+        with paddle.amp.auto_cast():
+            loss = model(x).mean()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        scaler.update()
+        assert np.isfinite(model.weight.numpy()).all()
+
+    def test_scaler_skips_on_inf(self):
+        w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+        opt = paddle.optimizer.SGD(1.0, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+        loss = w * np.inf
+        scaler.scale(loss).backward()
+        scaler.step(opt)
+        scaler.update()
+        np.testing.assert_allclose(w.numpy(), [1.0])  # update skipped
+        assert scaler._scale == 2.0  # halved
+
+    def test_decorate_o2(self):
+        model = nn.Linear(4, 4)
+        opt = paddle.optimizer.Adam(0.1, parameters=model.parameters())
+        model, opt = paddle.amp.decorate(model, opt, level="O2",
+                                         dtype="bfloat16")
+        assert model.weight.dtype == paddle.bfloat16
+        assert opt._multi_precision
+
+
+class TestDataLoader:
+    def test_batching(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 10
+
+            def __getitem__(self, i):
+                return np.full((3,), i, np.float32), np.int64(i % 2)
+
+        dl = DataLoader(DS(), batch_size=4, drop_last=False)
+        batches = list(dl)
+        assert len(batches) == 3
+        x, y = batches[0]
+        assert x.shape == [4, 3]
+        assert y.shape == [4]
+
+    def test_distributed_batch_sampler(self):
+        from paddle_tpu.io import DataLoader, DistributedBatchSampler, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        seen = []
+        for rank in range(4):
+            bs = DistributedBatchSampler(DS(), batch_size=2, num_replicas=4,
+                                         rank=rank)
+            for batch in bs:
+                seen.extend(batch)
+        assert sorted(seen) == list(range(16))
+
+    def test_iterable_dataset(self):
+        from paddle_tpu.io import DataLoader, IterableDataset
+
+        class IDS(IterableDataset):
+            def __iter__(self):
+                yield from (np.float32(i) for i in range(7))
+
+        dl = DataLoader(IDS(), batch_size=3, drop_last=True)
+        batches = list(dl)
+        assert len(batches) == 2
+
+
+class TestVision:
+    def test_transforms(self):
+        from paddle_tpu.vision import transforms as T
+
+        img = np.random.default_rng(0).integers(
+            0, 255, (32, 32, 3)).astype(np.uint8)
+        pipe = T.Compose([T.Resize(16), T.ToTensor(),
+                          T.Normalize([0.5] * 3, [0.5] * 3)])
+        out = pipe(img)
+        assert out.shape == [3, 16, 16]
+
+    def test_fake_dataset(self):
+        from paddle_tpu.vision.datasets import FakeData
+
+        ds = FakeData(num_samples=5, image_shape=(3, 8, 8))
+        img, lab = ds[0]
+        assert img.shape == (3, 8, 8)
+        assert len(ds) == 5
+
+
+class TestMetrics:
+    def test_accuracy(self):
+        m = paddle.metric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]],
+                                         np.float32))
+        lab = paddle.to_tensor(np.array([[1], [1]], np.int64))
+        correct = m.compute(pred, lab)
+        m.update(correct)
+        assert m.accumulate() == pytest.approx(0.5)
+
+    def test_auc(self):
+        m = paddle.metric.Auc()
+        m.update(np.array([0.9, 0.1, 0.8, 0.2]), np.array([1, 0, 1, 0]))
+        assert m.accumulate() == pytest.approx(1.0)
+
+
+class TestTCPStore:
+    def test_native_store(self):
+        from paddle_tpu.distributed.store import TCPStore
+
+        srv = TCPStore(is_master=True)
+        cli = TCPStore(port=srv.port)
+        cli.set("k", b"v1")
+        assert srv.get("k") == b"v1"
+        assert cli.add("ctr", 3) == 3
+        assert srv.add("ctr", 4) == 7
+        cli.wait(["k"])
+        assert srv.num_keys() >= 2
+
+
+class TestProfiler:
+    def test_profiler_timer(self):
+        prof = paddle.profiler.Profiler(timer_only=True)
+        prof.start()
+        for _ in range(3):
+            paddle.randn([4]).numpy()
+            prof.step()
+        prof.stop()
+        assert "steps=" in prof.summary()

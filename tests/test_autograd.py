@@ -1,0 +1,159 @@
+"""Autograd engine tests (reference: eager backward tests, CS-2 call stack)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(shape, sg=False):
+    rng = np.random.default_rng(abs(hash(shape)) % 2**31)
+    return paddle.to_tensor(rng.standard_normal(shape).astype(np.float32),
+                            stop_gradient=sg)
+
+
+class TestBackward:
+    def test_simple_chain(self):
+        x = _t((3, 4))
+        y = (x * 2 + 1).sum()
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full((3, 4), 2.0))
+
+    def test_grad_accumulation_multi_use(self):
+        x = _t((4,))
+        y = (x * x + x * 3).sum()  # dy/dx = 2x + 3
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 2 * x.numpy() + 3,
+                                   rtol=1e-6)
+
+    def test_repeated_backward_accumulates(self):
+        x = _t((3,))
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 5.0))
+
+    def test_stop_gradient(self):
+        x = _t((3,))
+        w = _t((3,), sg=True)
+        (x * w).sum().backward()
+        assert x.grad is not None
+        assert w.grad is None
+
+    def test_detach(self):
+        x = _t((3,))
+        y = x * 2
+        z = y.detach() * 3
+        z.sum().backward()
+        assert x.grad is None
+
+    def test_retain_graph(self):
+        x = _t((3,))
+        y = (x * x).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        np.testing.assert_allclose(x.grad.numpy(), 4 * x.numpy(), rtol=1e-6)
+
+    def test_double_backward_without_retain_raises(self):
+        x = _t((3,))
+        y = (x * x).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_no_grad_context(self):
+        x = _t((3,))
+        with paddle.no_grad():
+            y = x * 2
+        assert y._grad_node is None
+
+    def test_no_grad_decorator(self):
+        @paddle.no_grad()
+        def f(a):
+            return a * 2
+
+        assert f(_t((2,)))._grad_node is None
+
+    def test_backward_with_grad_tensor(self):
+        x = _t((3,))
+        y = x * 2
+        y.backward(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+    def test_multi_output_op(self):
+        x = _t((6,))
+        a, b = paddle.split(x, 2)
+        (a.sum() * 2 + b.sum() * 3).backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   [2, 2, 2, 3, 3, 3])
+
+    def test_unused_output(self):
+        x = _t((6,))
+        a, b = paddle.split(x, 2)
+        a.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1, 1, 1, 0, 0, 0])
+
+    def test_hook_on_leaf(self):
+        x = _t((3,))
+        seen = []
+
+        def hook(g):
+            seen.append(g.numpy().copy())
+            return g * 2
+
+        x.register_hook(hook)
+        (x * 3).sum().backward()
+        assert len(seen) == 1
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 6.0))
+
+    def test_paddle_grad_api(self):
+        x = _t((3,))
+        y = (x * x).sum()
+        (gx,) = paddle.grad(y, x)
+        np.testing.assert_allclose(gx.numpy(), 2 * x.numpy(), rtol=1e-6)
+        assert x.grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_allow_unused(self):
+        x, z = _t((3,)), _t((3,))
+        y = (x * 2).sum()
+        gx, gz = paddle.grad(y, [x, z], allow_unused=True)
+        assert gz is None
+        np.testing.assert_allclose(gx.numpy(), np.full(3, 2.0))
+
+    def test_getitem_grad(self):
+        x = _t((4, 4))
+        x[1:3, 0].sum().backward()
+        expect = np.zeros((4, 4), np.float32)
+        expect[1:3, 0] = 1
+        np.testing.assert_allclose(x.grad.numpy(), expect)
+
+    def test_branching_graph(self):
+        x = _t((3,))
+        a = x * 2
+        b = a + 1
+        c = a * 3
+        (b.sum() + c.sum()).backward()
+        np.testing.assert_allclose(x.grad.numpy(), np.full(3, 2 + 6.0))
+
+
+class TestGradScenarios:
+    def test_mlp_matches_jax(self):
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(7)
+        x_np = rng.standard_normal((5, 8)).astype(np.float32)
+        w1_np = rng.standard_normal((8, 16)).astype(np.float32)
+        w2_np = rng.standard_normal((16, 2)).astype(np.float32)
+
+        x = paddle.to_tensor(x_np, stop_gradient=False)
+        w1 = paddle.to_tensor(w1_np, stop_gradient=False)
+        w2 = paddle.to_tensor(w2_np, stop_gradient=False)
+        loss = paddle.nn.functional.relu(x @ w1).matmul(w2).square().mean()
+        loss.backward()
+
+        def jf(xx, a, b):
+            return jnp.square(jax.nn.relu(xx @ a) @ b).mean()
+
+        gx, g1, g2 = jax.grad(jf, argnums=(0, 1, 2))(x_np, w1_np, w2_np)
+        np.testing.assert_allclose(x.grad.numpy(), gx, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(w1.grad.numpy(), g1, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(w2.grad.numpy(), g2, rtol=1e-4, atol=1e-5)

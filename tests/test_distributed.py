@@ -1,0 +1,149 @@
+"""Distributed tests on the virtual 8-device CPU mesh (SURVEY §4 pattern:
+fake devices instead of a pod; correctness oracle = single-device loss)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.fleet.topology import CommunicateTopology
+
+
+class TestTopology:
+    def test_coord_math(self):
+        topo = CommunicateTopology(["data", "pipe", "sharding", "model"],
+                                   [2, 2, 1, 2])
+        assert topo.world_size() == 8
+        assert topo.get_rank(data=0, pipe=0, sharding=0, model=0) == 0
+        assert topo.get_rank(data=1, pipe=1, sharding=0, model=1) == 7
+        assert topo.get_coord(5) == (1, 0, 0, 1)
+        comm = topo.get_comm_list("model")
+        assert [0, 1] in comm and len(comm) == 4
+        assert topo.get_axis_list("data", 0) == [0, 1, 2, 3]
+
+    def test_hcg_groups(self):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 2,
+                                   "pp_degree": 2, "sharding_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        assert hcg.get_data_parallel_world_size() == 2
+        assert hcg.get_model_parallel_world_size() == 2
+        assert hcg.get_pipe_parallel_world_size() == 2
+        assert hcg.mesh.shape == {"dp": 2, "pp": 2, "sharding": 1, "mp": 2}
+
+
+class TestHybridEngine:
+    def _run(self, dp, mp, pp, sharding, steps=3):
+        from paddle_tpu.models import (GPTConfig, GPTForPretraining,
+                                       GPTModel, GPTPretrainingCriterion)
+
+        paddle.seed(123)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": mp,
+                                   "pp_degree": pp,
+                                   "sharding_degree": sharding}
+        strategy.pipeline_configs = {"accumulate_steps": max(2 * pp, 2)}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        cfg = GPTConfig.preset("gpt2-tiny", vocab_size=64, n_layer=2 * pp,
+                               seq_len=16, dropout=0.0, n_head=2,
+                               d_model=32)
+        model = GPTForPretraining(GPTModel(cfg))
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        engine = fleet.HybridParallelEngine(
+            model, opt, hcg, strategy,
+            criterion=GPTPretrainingCriterion())
+        rng = np.random.default_rng(0)
+        M = max(2 * pp, 2)
+        B = 2 * dp * sharding * M
+        toks = rng.integers(0, 64, (B, 16)).astype(np.int64)
+        labels = np.roll(toks, -1, 1)
+        losses = [float(engine.train_batch([toks, labels]))
+                  for _ in range(steps)]
+        return losses
+
+    def test_dp_only(self):
+        losses = self._run(dp=8, mp=1, pp=1, sharding=1)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_mp(self):
+        losses = self._run(dp=4, mp=2, pp=1, sharding=1)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_zero_sharding(self):
+        losses = self._run(dp=2, mp=1, pp=1, sharding=4)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_pipeline(self):
+        losses = self._run(dp=1, mp=2, pp=2, sharding=2)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_parallel_matches_single_device(self):
+        l1 = self._run(dp=1, mp=1, pp=1, sharding=1, steps=2)
+        l8 = self._run(dp=2, mp=2, pp=1, sharding=2, steps=2)
+        # same data, same seed → same loss trajectory (hybrid correctness
+        # oracle, reference test_dist_base.check_with_place pattern)
+        np.testing.assert_allclose(l1, l8, rtol=2e-2)
+
+
+class TestCollectives:
+    def test_eager_all_reduce_sharded(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from paddle_tpu.distributed import collective
+
+        g = collective.get_group(0)  # world group over 8 cpu devices
+        n = g.nranks
+        assert n == 8
+        mesh = collective.get_global_mesh()
+        arr = np.arange(n * 2, dtype=np.float32).reshape(n, 2)
+        x = paddle.to_tensor(arr)
+        x._data = jax.device_put(x._data, NamedSharding(mesh, P(g.axis)))
+        collective.all_reduce(x)
+        expect = np.tile(arr.reshape(n, 1, 2).sum(0), (n, 1))
+        np.testing.assert_allclose(np.asarray(x._data), expect.reshape(n, 2))
+
+    def test_group_creation(self):
+        from paddle_tpu.distributed import collective
+
+        g = collective.new_group([0, 1, 2, 3])
+        assert g.nranks == 4
+        assert g.get_group_rank(2) == 2
+        assert g.get_group_rank(7) == -1
+
+
+def test_dryrun_multichip_entry():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "graft_entry", os.path.join(os.path.dirname(__file__), "..",
+                                    "__graft_entry__.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    mod.dryrun_multichip(8)
+
+
+def test_recompute_matches_plain():
+    from paddle_tpu.distributed.fleet.utils import recompute
+
+    lin = paddle.nn.Linear(8, 8)
+    x = paddle.to_tensor(
+        np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32),
+        stop_gradient=False)
+    out1 = recompute(lin, x, layer=lin)
+    out1.sum().backward()
+    g_rc = lin.weight.grad.numpy().copy()
+    gx_rc = x.grad.numpy().copy()
+    lin.weight.clear_grad()
+    x.clear_grad()
+    out2 = lin(x)
+    out2.sum().backward()
+    np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(g_rc, lin.weight.grad.numpy(), rtol=1e-5)
+    np.testing.assert_allclose(gx_rc, x.grad.numpy(), rtol=1e-5)

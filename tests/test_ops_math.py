@@ -1,0 +1,155 @@
+"""Op tests: math/elementwise/reduction — OpTest pattern (SURVEY §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_grad, check_output
+
+RNG = np.random.default_rng(0)
+
+
+def _randf(*shape):
+    return RNG.standard_normal(shape).astype(np.float32)
+
+
+class TestElementwise:
+    def test_add(self):
+        check_output(paddle.add, np.add, [_randf(3, 4), _randf(3, 4)])
+        check_grad(paddle.add, [_randf(3, 4), _randf(3, 4)])
+
+    def test_add_broadcast(self):
+        check_output(paddle.add, np.add, [_randf(3, 4), _randf(4)])
+        check_grad(paddle.add, [_randf(3, 4), _randf(4)])
+
+    def test_subtract_multiply_divide(self):
+        a, b = _randf(2, 5), _randf(2, 5) + 2.0
+        check_output(paddle.subtract, np.subtract, [a, b])
+        check_output(paddle.multiply, np.multiply, [a, b])
+        check_output(paddle.divide, np.divide, [a, b])
+        check_grad(paddle.multiply, [a, b])
+        check_grad(paddle.divide, [a, b])
+
+    def test_scalar_ops(self):
+        x = paddle.to_tensor(_randf(3, 3))
+        np.testing.assert_allclose((x + 2).numpy(), x.numpy() + 2, rtol=1e-6)
+        np.testing.assert_allclose((2 * x).numpy(), 2 * x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((1 - x).numpy(), 1 - x.numpy(), rtol=1e-6)
+        np.testing.assert_allclose((x / 2).numpy(), x.numpy() / 2, rtol=1e-6)
+        assert (x + 2).dtype == paddle.float32
+
+    def test_unary(self):
+        x = np.abs(_randf(4, 4)) + 0.5
+        # XLA's vectorized transcendentals differ from libm by ~1e-4 rel
+        check_output(paddle.exp, np.exp, [x], rtol=3e-4)
+        check_output(paddle.log, np.log, [x], rtol=3e-4)
+        check_output(paddle.sqrt, np.sqrt, [x], rtol=1e-5)
+        check_output(paddle.tanh, np.tanh, [x], rtol=3e-4)
+        check_grad(paddle.exp, [x])
+        check_grad(paddle.log, [x])
+        check_grad(paddle.tanh, [x])
+
+    def test_pow(self):
+        x = np.abs(_randf(3, 3)) + 0.5
+        check_output(paddle.pow, np.power, [x, np.full_like(x, 2.0)])
+        y = paddle.to_tensor(x) ** 2
+        np.testing.assert_allclose(y.numpy(), x ** 2, rtol=1e-6)
+
+    def test_clip(self):
+        x = _randf(5, 5)
+        out = paddle.clip(paddle.to_tensor(x), -0.5, 0.5)
+        np.testing.assert_allclose(out.numpy(), np.clip(x, -0.5, 0.5))
+
+
+class TestReduce:
+    def test_sum(self):
+        x = _randf(3, 4, 5)
+        check_output(paddle.sum, lambda a: a.sum(), [x])
+        out = paddle.sum(paddle.to_tensor(x), axis=[1, 2])
+        np.testing.assert_allclose(out.numpy(), x.sum(axis=(1, 2)), rtol=1e-5)
+        check_grad(paddle.sum, [x])
+
+    def test_mean_keepdim(self):
+        x = _randf(3, 4)
+        out = paddle.mean(paddle.to_tensor(x), axis=1, keepdim=True)
+        np.testing.assert_allclose(out.numpy(), x.mean(1, keepdims=True),
+                                   rtol=1e-6)
+        check_grad(paddle.mean, [x])
+
+    def test_max_min_argmax(self):
+        x = _randf(4, 6)
+        assert float(paddle.max(paddle.to_tensor(x))) == pytest.approx(x.max())
+        np.testing.assert_array_equal(
+            paddle.argmax(paddle.to_tensor(x), axis=1).numpy(), x.argmax(1))
+
+    def test_std_var(self):
+        x = _randf(10, 3)
+        np.testing.assert_allclose(
+            paddle.std(paddle.to_tensor(x)).numpy(), x.std(ddof=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.var(paddle.to_tensor(x), unbiased=False).numpy(),
+            x.var(), rtol=1e-5)
+
+    def test_cumsum(self):
+        x = _randf(3, 4)
+        np.testing.assert_allclose(
+            paddle.cumsum(paddle.to_tensor(x), axis=1).numpy(),
+            np.cumsum(x, 1), rtol=1e-6)
+
+    def test_logsumexp(self):
+        x = _randf(3, 4)
+        from scipy.special import logsumexp as np_lse
+        np.testing.assert_allclose(
+            paddle.logsumexp(paddle.to_tensor(x), axis=1).numpy(),
+            np_lse(x, axis=1), rtol=1e-5)
+
+
+class TestMatmul:
+    def test_matmul(self):
+        a, b = _randf(3, 4), _randf(4, 5)
+        check_output(paddle.matmul, np.matmul, [a, b])
+        check_grad(paddle.matmul, [a, b])
+
+    def test_matmul_transpose(self):
+        a, b = _randf(4, 3), _randf(4, 5)
+        out = paddle.matmul(paddle.to_tensor(a), paddle.to_tensor(b),
+                            transpose_x=True)
+        np.testing.assert_allclose(out.numpy(), a.T @ b, rtol=1e-5)
+
+    def test_batched(self):
+        a, b = _randf(2, 3, 4), _randf(2, 4, 5)
+        check_output(paddle.bmm, np.matmul, [a, b])
+
+    def test_einsum(self):
+        a, b = _randf(3, 4), _randf(4, 5)
+        out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                            paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+class TestLogic:
+    def test_compare(self):
+        a, b = _randf(3, 3), _randf(3, 3)
+        ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+        np.testing.assert_array_equal((ta > tb).numpy(), a > b)
+        np.testing.assert_array_equal((ta == tb).numpy(), a == b)
+        np.testing.assert_array_equal(
+            paddle.logical_and(ta > 0, tb > 0).numpy(), (a > 0) & (b > 0))
+
+    def test_allclose_isclose(self):
+        a = _randf(3)
+        assert bool(paddle.allclose(paddle.to_tensor(a), paddle.to_tensor(a)))
+
+    def test_where(self):
+        c = _randf(3, 3) > 0
+        a, b = _randf(3, 3), _randf(3, 3)
+        out = paddle.where(paddle.to_tensor(c), paddle.to_tensor(a),
+                           paddle.to_tensor(b))
+        np.testing.assert_allclose(out.numpy(), np.where(c, a, b))
+
+
+class TestCast:
+    def test_cast(self):
+        x = paddle.to_tensor(_randf(3, 3))
+        assert x.astype("int32").dtype == paddle.int32
+        assert x.astype(paddle.bfloat16).dtype == paddle.bfloat16
+        assert x.astype("float64").numpy().dtype == np.float64

@@ -1,0 +1,124 @@
+"""Optimizer/LR/clip tests (reference: unittests test_adam_op etc.)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _problem():
+    paddle.seed(1)
+    w = paddle.to_tensor(np.array([[2.0, -3.0]], np.float32),
+                         stop_gradient=False)
+    x = paddle.to_tensor(np.random.default_rng(0)
+                         .standard_normal((64, 1)).astype(np.float32))
+    target = x @ paddle.to_tensor(np.array([[1.0, 1.0]], np.float32))
+    return w, x, target
+
+
+def _train(opt_cls, steps=60, **kw):
+    w, x, target = _problem()
+    opt = opt_cls(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((x @ w - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(((x @ w - target) ** 2).mean())
+
+
+@pytest.mark.parametrize("opt_cls,kw", [
+    (paddle.optimizer.SGD, {"learning_rate": 0.1}),
+    (paddle.optimizer.Momentum, {"learning_rate": 0.05, "momentum": 0.9}),
+    (paddle.optimizer.Adam, {"learning_rate": 0.1}),
+    (paddle.optimizer.AdamW, {"learning_rate": 0.1, "weight_decay": 0.0}),
+    (paddle.optimizer.Adagrad, {"learning_rate": 0.5}),
+    (paddle.optimizer.RMSProp, {"learning_rate": 0.05, "steps": 200}),
+    (paddle.optimizer.Adamax, {"learning_rate": 0.2, "steps": 200}),
+    (paddle.optimizer.Lamb, {"learning_rate": 0.05, "lamb_weight_decay": 0.0}),
+])
+def test_optimizers_converge(opt_cls, kw):
+    assert _train(opt_cls, **kw) < 0.05
+
+
+def test_adam_matches_reference_formula():
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    (w * 2).backward()  # grad = 2
+    opt.step()
+    # manual adam step 1
+    m = 0.1 * 2
+    v = 0.001 * 4
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expect = 1.0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), [expect], rtol=1e-5)
+
+
+def test_weight_decay_l2():
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w],
+                               weight_decay=0.5)
+    (w * 0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [1.0 - 0.1 * 0.5], rtol=1e-6)
+
+
+def test_grad_clip_global_norm():
+    w1 = paddle.to_tensor(np.array([3.0], np.float32), stop_gradient=False)
+    w2 = paddle.to_tensor(np.array([4.0], np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w1, w2],
+                               grad_clip=nn.ClipGradByGlobalNorm(1.0))
+    (w1 * 3 + w2 * 4).backward()  # grads 3, 4 → global norm 5
+    opt.step()
+    np.testing.assert_allclose(w1.numpy(), [3.0 - 3.0 / 5], rtol=1e-5)
+    np.testing.assert_allclose(w2.numpy(), [4.0 - 4.0 / 5], rtol=1e-5)
+
+
+def test_lr_schedulers():
+    lr = paddle.optimizer.lr.StepDecay(0.1, step_size=2, gamma=0.5)
+    vals = []
+    for _ in range(5):
+        vals.append(round(lr(), 5))
+        lr.step()
+    assert vals == [0.1, 0.1, 0.05, 0.05, 0.025]
+
+    warm = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=4, start_lr=0.0,
+                                            end_lr=0.1)
+    assert warm() < 0.1
+    for _ in range(5):
+        warm.step()
+    assert warm() == pytest.approx(0.1)
+
+    cos = paddle.optimizer.lr.CosineAnnealingDecay(0.1, T_max=10)
+    assert cos() == pytest.approx(0.1)
+
+
+def test_scheduler_with_optimizer():
+    sched = paddle.optimizer.lr.StepDecay(0.1, step_size=1, gamma=0.1)
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+    w.sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.9], rtol=1e-6)
+    sched.step()
+    opt.clear_grad()
+    w.sum().backward()
+    opt.step()
+    np.testing.assert_allclose(w.numpy(), [0.9 - 0.01], rtol=1e-5)
+
+
+def test_optimizer_state_dict():
+    w = paddle.to_tensor(np.array([1.0], np.float32), stop_gradient=False)
+    w.name = "w"
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    w.sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    assert any("moment1" in k for k in sd)
+
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    w.sum().backward()
+    opt2.step()  # create accumulators
+    opt2.set_state_dict(sd)
+    assert opt2._opt_step == 1

@@ -1,0 +1,80 @@
+"""Static (declarative) mode tests — Program/Executor (SURVEY CS-3)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def test_program_record_and_run(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [None, 4], "float32")
+        y = x * 2.0 + 1.0
+    exe = paddle.static.Executor()
+    xs = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+    out, = exe.run(prog, feed={"x": xs}, fetch_list=[y])
+    np.testing.assert_allclose(out, xs * 2 + 1, rtol=1e-6)
+
+
+def test_static_training_converges(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [None, 8], "float32")
+        t = paddle.static.data("t", [None, 1], "float32")
+        h = paddle.static.nn.fc(x, 16, activation="relu")
+        pred = paddle.static.nn.fc(h, 1)
+        loss = paddle.nn.functional.mse_loss(pred, t)
+        opt = paddle.optimizer.Adam(0.05)
+        opt.minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(paddle.static.default_startup_program())
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((32, 8)).astype(np.float32)
+    ts = (xs.sum(1, keepdims=True) * 0.3).astype(np.float32)
+    losses = [float(exe.run(prog, feed={"x": xs, "t": ts},
+                            fetch_list=[loss])[0]) for _ in range(25)]
+    assert losses[-1] < losses[0] * 0.2
+
+
+def test_feed_shape_specialization(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [None, 3], "float32")
+        y = paddle.sum(x, axis=1)
+    exe = paddle.static.Executor()
+    for bs in (2, 5):
+        xs = np.ones((bs, 3), np.float32)
+        out, = exe.run(prog, feed={"x": xs}, fetch_list=[y])
+        assert out.shape == (bs,)
+
+
+def test_program_clone_for_test(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [None, 2], "float32")
+        y = x.exp()
+        opt = paddle.optimizer.SGD(0.1)
+        opt.minimize(paddle.sum(y))
+    test_prog = prog.clone(for_test=True)
+    assert not test_prog.minimize_reqs
+    assert len(test_prog.ops) == len(prog.ops)
+
+
+def test_ernie_static_inference(static_mode):
+    paddle.disable_static()  # builder flips modes itself
+    from paddle_tpu.models import build_static_inference_program, ernie_tiny
+
+    model = ernie_tiny(vocab_size=128, max_position_embeddings=64)
+    prog, feeds, fetch = build_static_inference_program(model, seq_len=16)
+    exe = paddle.static.Executor()
+    ids = np.random.default_rng(0).integers(0, 128, (2, 16)).astype(np.int64)
+    out, = exe.run(prog, feed={"input_ids": ids}, fetch_list=[fetch])
+    assert out.shape == (2, 128)  # pooled hidden
+    paddle.enable_static()  # fixture symmetry
