@@ -65,6 +65,17 @@ from . import vision  # noqa: F401
 from . import distributed  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
+from . import distribution  # noqa: F401
+from . import sparse  # noqa: F401
+# `from .ops import *` already bound the ops.linalg submodule to the name
+# `linalg`; import the namespace module explicitly so `paddle.linalg` is the
+# full reference-parity namespace (importing the submodule rebinds the
+# parent attribute).
+import importlib as _importlib
+
+linalg = _importlib.import_module(".linalg", __name__)
+from . import fft  # noqa: F401,E402
+from . import signal  # noqa: F401,E402
 from .framework import save, load, in_dynamic_mode, enable_static, \
     disable_static  # noqa: F401
 from . import framework  # noqa: F401

@@ -47,22 +47,29 @@ def _is_float(a):
 
 
 def _cast_hook(op_name, arrays):
-    """Installed as dispatch.amp_cast_hook while auto_cast is active."""
+    """Installed as dispatch.amp_cast_hook while auto_cast is active.
+
+    Returns a dtype PLAN (list of target dtype or None per input) — no
+    casting here: the dispatcher materializes casts on the no-grad path and
+    traces them inside jax.vjp on the grad path."""
     if not _state.enabled:
-        return arrays
+        return None
     low = _state.dtype
+
+    def plan(target, pred):
+        return [target if pred(a) else None for a in arrays]
+
     if _state.level == "O2":
         if op_name in _state.black:
-            return [a.astype(jnp.float32) if _is_float(a) and
-                    a.dtype in (low, jnp.float16) else a for a in arrays]
-        return [a.astype(low) if _is_float(a) else a for a in arrays]
+            return plan(jnp.float32, lambda a: _is_float(a)
+                        and a.dtype in (low, jnp.float16))
+        return plan(low, lambda a: _is_float(a) and a.dtype != low)
     # O1
     if op_name in _state.white:
-        return [a.astype(low) if _is_float(a) else a for a in arrays]
+        return plan(low, lambda a: _is_float(a) and a.dtype != low)
     if op_name in _state.black:
-        return [a.astype(jnp.float32) if _is_float(a) and a.dtype == low
-                else a for a in arrays]
-    return arrays
+        return plan(jnp.float32, lambda a: _is_float(a) and a.dtype == low)
+    return None
 
 
 @contextlib.contextmanager

@@ -29,7 +29,9 @@ from .tensor import Tensor
 # Pluggable hooks -------------------------------------------------------------
 # static graph recorder: callable(fn, name, inputs, attrs) -> outputs or None
 static_recorder = None
-# AMP cast hook: callable(op_name, arrays) -> arrays
+# AMP cast plan hook: callable(op_name, arrays) -> list[dtype | None] per
+# input (None = leave as-is). Dtype-only so the grad path can defer the cast
+# into the traced function without materializing throwaway casted arrays.
 amp_cast_hook = None
 
 
@@ -82,14 +84,27 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
             return out
 
     arrays = [unwrap(x) for x in inputs]
-    if amp_cast_hook is not None:
-        arrays = amp_cast_hook(name, arrays)
 
     needs_grad = (
         not nondiff
         and ag.is_grad_enabled()
         and any(isinstance(t, Tensor) and not t.stop_gradient for t in inputs)
     )
+
+    # AMP cast. On the no-grad path, cast the arrays directly. On the grad
+    # path the cast must happen INSIDE the traced function so jax.vjp sees it
+    # and returns cotangents in the ORIGINAL input dtypes (otherwise a
+    # black-list fp32 upcast would feed a float32 cotangent to a producer
+    # GradNode whose output is bf16).
+    cast_dtypes = None
+    if amp_cast_hook is not None:
+        plan = amp_cast_hook(name, arrays)
+        if plan is not None and any(d is not None for d in plan):
+            if not needs_grad:
+                arrays = [a.astype(d) if d is not None else a
+                          for a, d in zip(arrays, plan)]
+            else:
+                cast_dtypes = tuple(plan)
 
     if not needs_grad:
         # Only jit module-level fns: closures are fresh objects per call and
@@ -104,6 +119,15 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
         return _wrap_out(out, None, isinstance(out, (tuple, list)))
 
     f = functools.partial(fn, **attrs)
+    if cast_dtypes is not None:
+        base_f, cd = f, cast_dtypes
+
+        def f(*xs):
+            xs = tuple(
+                x.astype(d) if d is not None else x for x, d in zip(xs, cd)
+            )
+            return base_f(*xs)
+
     out, vjp_fn = jax.vjp(f, *arrays)
     multi = isinstance(out, (tuple, list))
     outs_flat = list(out) if multi else [out]

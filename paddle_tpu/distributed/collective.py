@@ -119,9 +119,15 @@ def new_group(ranks=None, backend=None, timeout=None):
 def get_group(gid=0):
     if gid == 0:
         if 0 not in _groups:
-            # world group gets its own 1-D mesh over all devices
-            _groups[0] = Group(list(range(len(jax.devices()))), 0,
-                               axis_name="world")
+            # World group rides the CURRENT global mesh so NamedSharding over
+            # `group.axis` stays valid after fleet.init swaps in a hybrid
+            # mesh. Multi-axis mesh → the world "axis" is the tuple of all
+            # axes (P accepts it, and so do lax.psum & friends).
+            mesh = get_global_mesh()
+            axis = (mesh.axis_names[0] if len(mesh.axis_names) == 1
+                    else tuple(mesh.axis_names))
+            _groups[0] = Group(list(range(mesh.devices.size)), 0,
+                               axis_name=axis, mesh=mesh)
         return _groups[0]
     return _groups[gid]
 

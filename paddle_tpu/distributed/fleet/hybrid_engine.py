@@ -369,6 +369,12 @@ class HybridParallelEngine:
         tokens, labels = data[0], data[1]
         tokens = tokens._data if isinstance(tokens, Tensor) else jnp.asarray(tokens)
         labels = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
+        # Tensors from paddle.to_tensor are committed to one device; reshard
+        # them onto the mesh explicitly (jit refuses committed args whose
+        # sharding mismatches in_shardings).
+        b_sh = NamedSharding(self.mesh, self.batch_spec)
+        tokens = jax.device_put(tokens, b_sh)
+        labels = jax.device_put(labels, b_sh)
         accs = self.acc_arrays
         loss, self.param_arrays, self.acc_arrays, self._step_count = \
             self._step(self.param_arrays, accs, self._step_count, tokens,

@@ -1,0 +1,39 @@
+"""paddle_tpu.distribution — probability distributions.
+
+Capability parity with the reference's `python/paddle/distribution/`
+(`distribution.py`, `normal.py`, `uniform.py`, `beta.py`, `dirichlet.py`,
+`categorical.py`, `multinomial.py`, `laplace.py`, `lognormal.py`,
+`gumbel.py`, `independent.py`, `transformed_distribution.py`, `kl.py`,
+`transform.py`), re-designed for TPU: densities/entropies are pure jnp
+functions differentiable end-to-end via the eager engine, sampling draws
+from the functional PRNG (`core.random`), and everything is jit-traceable.
+"""
+from .distribution import Distribution, ExponentialFamily  # noqa: F401
+from .normal import Normal  # noqa: F401
+from .uniform import Uniform  # noqa: F401
+from .beta import Beta  # noqa: F401
+from .dirichlet import Dirichlet  # noqa: F401
+from .categorical import Categorical  # noqa: F401
+from .multinomial import Multinomial  # noqa: F401
+from .laplace import Laplace  # noqa: F401
+from .lognormal import LogNormal  # noqa: F401
+from .gumbel import Gumbel  # noqa: F401
+from .independent import Independent  # noqa: F401
+from .transformed_distribution import TransformedDistribution  # noqa: F401
+from .kl import kl_divergence, register_kl  # noqa: F401
+from .transform import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+)
+
+__all__ = [
+    "Distribution", "ExponentialFamily", "Normal", "Uniform", "Beta",
+    "Dirichlet", "Categorical", "Multinomial", "Laplace", "LogNormal",
+    "Gumbel", "Independent", "TransformedDistribution", "kl_divergence",
+    "register_kl", "Transform", "AbsTransform", "AffineTransform",
+    "ChainTransform", "ExpTransform", "IndependentTransform",
+    "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+    "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+    "TanhTransform",
+]

@@ -1,0 +1,52 @@
+"""Beta distribution (reference `distribution/beta.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _as_array, _op, _shp
+
+
+def _betaln(a, b):
+    g = jax.scipy.special.gammaln
+    return g(a) + g(b) - g(a + b)
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _as_array(alpha)
+        self.beta = _as_array(beta)
+        batch = jnp.broadcast_shapes(_shp(self.alpha), _shp(self.beta))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _op(lambda a, b: a / (a + b), self.alpha, self.beta,
+                   name="beta_mean")
+
+    @property
+    def variance(self):
+        return _op(lambda a, b: a * b / ((a + b) ** 2 * (a + b + 1.0)),
+                   self.alpha, self.beta, name="beta_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        key = self._key()
+        return _op(lambda a, b: jax.random.beta(key, a, b, full),
+                   self.alpha, self.beta, name="beta_rsample")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        return _op(
+            lambda v, a, b: (a - 1.0) * jnp.log(v)
+            + (b - 1.0) * jnp.log1p(-v) - _betaln(a, b),
+            _as_array(value), self.alpha, self.beta, name="beta_log_prob")
+
+    def entropy(self):
+        dg = jax.scipy.special.digamma
+        return _op(
+            lambda a, b: _betaln(a, b) - (a - 1.0) * dg(a)
+            - (b - 1.0) * dg(b) + (a + b - 2.0) * dg(a + b),
+            self.alpha, self.beta, name="beta_entropy")

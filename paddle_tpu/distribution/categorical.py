@@ -1,0 +1,74 @@
+"""Categorical & Multinomial-support helpers (reference
+`distribution/categorical.py`). The reference parameterizes by unnormalized
+`logits` (treated as relative weights)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_array, _op
+from ..core.tensor import Tensor
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        # reference semantics: `logits` are non-negative relative weights OR
+        # arbitrary real logits; probabilities are weights / sum.
+        self.logits = _as_array(logits)
+        super().__init__(batch_shape=self.logits.shape[:-1])
+        self._num_events = self.logits.shape[-1]
+
+    def _probs(self, w):
+        return w / w.sum(-1, keepdims=True)
+
+    @property
+    def probs_tensor(self):
+        return _op(self._probs, self.logits, name="categorical_probs")
+
+    def sample(self, shape=()):
+        shape = (shape,) if isinstance(shape, int) else tuple(shape)
+        key = self._key()
+        full = shape + self.batch_shape
+
+        def draw(w):
+            lp = jnp.log(self._probs(w))
+            return jax.random.categorical(key, lp, shape=full)
+
+        out = _op(draw, self.logits, name="categorical_sample")
+        return out.detach() if isinstance(out, Tensor) else out
+
+    @staticmethod
+    def _gather(p, idx):
+        """Select p[..., idx] with the reference's broadcast semantics: the
+        value's shape may extend the batch shape on the left."""
+        p = jnp.broadcast_to(p, idx.shape + p.shape[-1:])
+        return jnp.take_along_axis(p, idx[..., None], axis=-1).squeeze(-1)
+
+    def log_prob(self, value):
+        def lp(v, w):
+            return jnp.log(self._gather(self._probs(w), v.astype(jnp.int32)))
+
+        return _op(lp, _as_array(value), self.logits,
+                   name="categorical_log_prob")
+
+    def probs(self, value):
+        def pr(v, w):
+            return self._gather(self._probs(w), v.astype(jnp.int32))
+
+        return _op(pr, _as_array(value), self.logits, name="categorical_prob")
+
+    def entropy(self):
+        def ent(w):
+            p = self._probs(w)
+            logp = jnp.where(p > 0, jnp.log(p), 0.0)
+            return -(p * logp).sum(-1)
+
+        return _op(ent, self.logits, name="categorical_entropy")
+
+    def kl_divergence(self, other):
+        def kl(w1, w2):
+            p = self._probs(w1)
+            q = other._probs(w2)
+            return (p * (jnp.log(p) - jnp.log(q))).sum(-1)
+
+        return _op(kl, self.logits, other.logits, name="categorical_kl")

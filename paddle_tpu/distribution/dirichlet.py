@@ -1,0 +1,62 @@
+"""Dirichlet distribution (reference `distribution/dirichlet.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import ExponentialFamily, _as_array, _op
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _as_array(concentration)
+        if self.concentration.ndim < 1:
+            raise ValueError(
+                "concentration must be at least 1-dimensional")
+        super().__init__(batch_shape=self.concentration.shape[:-1],
+                         event_shape=self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return _op(lambda c: c / c.sum(-1, keepdims=True),
+                   self.concentration, name="dirichlet_mean")
+
+    @property
+    def variance(self):
+        def var(c):
+            a0 = c.sum(-1, keepdims=True)
+            return c * (a0 - c) / (a0 * a0 * (a0 + 1.0))
+
+        return _op(var, self.concentration, name="dirichlet_var")
+
+    def rsample(self, shape=()):
+        full = tuple(shape if not isinstance(shape, int) else (shape,)) \
+            + self.batch_shape
+        key = self._key()
+        return _op(lambda c: jax.random.dirichlet(key, c, full),
+                   self.concentration, name="dirichlet_rsample")
+
+    def sample(self, shape=()):
+        return self.rsample(shape).detach()
+
+    def log_prob(self, value):
+        g = jax.scipy.special.gammaln
+
+        def lp(v, c):
+            return ((c - 1.0) * jnp.log(v)).sum(-1) \
+                + g(c.sum(-1)) - g(c).sum(-1)
+
+        return _op(lp, _as_array(value), self.concentration,
+                   name="dirichlet_log_prob")
+
+    def entropy(self):
+        dg = jax.scipy.special.digamma
+        g = jax.scipy.special.gammaln
+
+        def ent(c):
+            k = c.shape[-1]
+            a0 = c.sum(-1)
+            lnB = g(c).sum(-1) - g(a0)
+            return lnB + (a0 - k) * dg(a0) - ((c - 1.0) * dg(c)).sum(-1)
+
+        return _op(ent, self.concentration, name="dirichlet_entropy")

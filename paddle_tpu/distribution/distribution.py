@@ -1,0 +1,136 @@
+"""Distribution base class (reference `distribution/distribution.py:47`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import forward, unwrap
+from ..core.tensor import Tensor
+from ..core import random as prandom
+
+
+def _as_array(x, dtype=None):
+    """Parameter normalization. Tensors are kept AS TENSORS so the autograd
+    edge from distribution outputs back to parameter leaves survives (the
+    dispatcher unwraps them at op time); plain python/numpy data becomes a
+    float jnp array."""
+    if isinstance(x, Tensor):
+        return x
+    a = jnp.asarray(unwrap(x))
+    if dtype is not None:
+        a = a.astype(dtype)
+    if jnp.issubdtype(a.dtype, jnp.integer):
+        a = a.astype(jnp.float32)
+    return a
+
+
+def _shp(x):
+    """Shape as a tuple for Tensor / array / scalar."""
+    return tuple(getattr(x, "shape", ()))
+
+
+def _op(fn, *args, name="dist_op"):
+    """Run `fn` over mixed Tensor/array args through the dispatcher so the
+    result participates in autograd (the reference's densities are built
+    from differentiable paddle ops; here the whole density is one op)."""
+    return forward(fn, args, name=name)
+
+
+def _sample_shape(sample_shape, batch_shape, event_shape):
+    if sample_shape is None:
+        sample_shape = ()
+    if isinstance(sample_shape, int):
+        sample_shape = (sample_shape,)
+    return tuple(sample_shape) + tuple(batch_shape) + tuple(event_shape)
+
+
+class Distribution:
+    """Base of all distributions (reference `distribution.py:47`)."""
+
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(
+            batch_shape if not isinstance(batch_shape, int) else (batch_shape,)
+        )
+        self._event_shape = tuple(
+            event_shape if not isinstance(event_shape, int) else (event_shape,)
+        )
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        """Non-reparameterized draw (stop-gradient)."""
+        t = self.rsample(shape)
+        return t.detach() if isinstance(t, Tensor) else t
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return _op(lambda lp: jnp.exp(lp), self.log_prob(value), name="exp")
+
+    def probs(self, value):  # reference alias
+        return self.prob(value)
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)
+
+    # helpers ----------------------------------------------------------------
+    def _key(self):
+        return prandom.split_key()
+
+    def _extend_shape(self, sample_shape):
+        return _sample_shape(sample_shape, self.batch_shape, self.event_shape)
+
+
+class ExponentialFamily(Distribution):
+    """Exponential-family base (reference `exponential_family.py`): provides
+    entropy via the Bregman/log-normalizer identity. Subclasses expose
+    `_natural_parameters` and `_log_normalizer`; on TPU the identity's
+    gradients come from jax.grad instead of the reference's dygraph tape."""
+
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_params):
+        raise NotImplementedError
+
+    def _mean_carrier_measure(self):
+        return 0.0
+
+    def entropy(self):
+        import jax
+
+        nparams = [ _as_array(p) for p in self._natural_parameters ]
+
+        def ent(*ps):
+            lg = self._log_normalizer(*ps)
+            grads = jax.grad(lambda *q: jnp.sum(self._log_normalizer(*q)),
+                             argnums=tuple(range(len(ps))))(*ps)
+            result = lg - self._mean_carrier_measure()
+            for p, g in zip(ps, grads):
+                result = result - p * g
+            return result
+
+        return _op(ent, *nparams, name="ef_entropy")

@@ -1,0 +1,61 @@
+"""Gumbel distribution (reference `distribution/gumbel.py` — built there as a
+TransformedDistribution of Uniform; here expressed directly, which is both
+simpler and cheaper on TPU)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_array, _op, _shp
+
+_EULER = 0.57721566490153286060
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        batch = jnp.broadcast_shapes(_shp(self.loc), _shp(self.scale))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: l + s * _EULER, self.loc, self.scale,
+                   name="gumbel_mean")
+
+    @property
+    def variance(self):
+        return _op(lambda l, s: (math.pi ** 2 / 6.0) * s * s
+                   + 0.0 * l, self.loc, self.scale, name="gumbel_var")
+
+    @property
+    def stddev(self):
+        return _op(lambda l, s: (math.pi / math.sqrt(6.0)) * s + 0.0 * l,
+                   self.loc, self.scale, name="gumbel_std")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        key = self._key()
+        return _op(
+            lambda l, s: l + s * jax.random.gumbel(key, full,
+                                                   jnp.result_type(l)),
+            self.loc, self.scale, name="gumbel_rsample")
+
+    def log_prob(self, value):
+        def lp(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        return _op(lp, _as_array(value), self.loc, self.scale,
+                   name="gumbel_log_prob")
+
+    def entropy(self):
+        return _op(lambda l, s: jnp.log(s) + 1.0 + _EULER + 0.0 * l,
+                   self.loc, self.scale, name="gumbel_entropy")
+
+    def cdf(self, value):
+        return _op(
+            lambda v, l, s: jnp.exp(-jnp.exp(-(v - l) / s)),
+            _as_array(value), self.loc, self.scale, name="gumbel_cdf")

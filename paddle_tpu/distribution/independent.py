@@ -1,0 +1,44 @@
+"""Independent distribution wrapper (reference `distribution/independent.py`):
+reinterprets trailing batch dims as event dims."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _op
+
+
+class Independent(Distribution):
+    def __init__(self, base, reinterpreted_batch_rank):
+        if reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError(
+                "reinterpreted_batch_rank exceeds base batch rank")
+        self._base = base
+        self._rank = int(reinterpreted_batch_rank)
+        shape = base.batch_shape + base.event_shape
+        cut = len(base.batch_shape) - self._rank
+        super().__init__(batch_shape=shape[:cut],
+                         event_shape=shape[cut:])
+
+    @property
+    def mean(self):
+        return self._base.mean
+
+    @property
+    def variance(self):
+        return self._base.variance
+
+    def sample(self, shape=()):
+        return self._base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self._base.rsample(shape)
+
+    def _sum_rightmost(self, t):
+        return _op(lambda x: x.sum(tuple(range(x.ndim - self._rank, x.ndim)))
+                   if self._rank else x, t, name="independent_sum")
+
+    def log_prob(self, value):
+        return self._sum_rightmost(self._base.log_prob(value))
+
+    def entropy(self):
+        return self._sum_rightmost(self._base.entropy())

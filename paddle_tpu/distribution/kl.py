@@ -1,0 +1,112 @@
+"""KL divergence registry (reference `distribution/kl.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _op
+from .beta import Beta, _betaln
+from .categorical import Categorical
+from .dirichlet import Dirichlet
+from .laplace import Laplace
+from .lognormal import LogNormal
+from .normal import Normal
+from .uniform import Uniform
+
+_KL_REGISTRY = {}
+
+
+def register_kl(p_cls, q_cls):
+    """Decorator registering a pairwise KL rule (reference kl.py:register_kl)."""
+
+    def decorator(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return decorator
+
+
+def _dispatch(p, q):
+    # most-derived match wins (reference's total_ordering dispatch)
+    matches = [
+        (pc, qc) for (pc, qc) in _KL_REGISTRY
+        if isinstance(p, pc) and isinstance(q, qc)
+    ]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL rule registered for ({type(p).__name__}, "
+            f"{type(q).__name__})")
+
+    def depth(pair):
+        pc, qc = pair
+        return (type(p).__mro__.index(pc) + type(q).__mro__.index(qc))
+
+    return _KL_REGISTRY[min(matches, key=depth)]
+
+
+def kl_divergence(p, q):
+    """`paddle.distribution.kl_divergence`."""
+    return _dispatch(p, q)(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return _op(
+        lambda l1, s1, l2, s2: jnp.log(s2 / s1)
+        + (s1 * s1 + (l1 - l2) ** 2) / (2.0 * s2 * s2) - 0.5,
+        p.loc, p.scale, q.loc, q.scale, name="kl_normal")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def kl(a1, b1, a2, b2):
+        ratio = (b1 - a1) / (b2 - a2)
+        inside = (a2 <= a1) & (b1 <= b2)
+        return jnp.where(inside, -jnp.log(ratio), jnp.inf)
+
+    return _op(kl, p.low, p.high, q.low, q.high, name="kl_uniform")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def kl(l1, s1, l2, s2):
+        d = jnp.abs(l1 - l2)
+        return (jnp.log(s2 / s1) + d / s2
+                + s1 / s2 * jnp.exp(-d / s1) - 1.0)
+
+    return _op(kl, p.loc, p.scale, q.loc, q.scale, name="kl_laplace")
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    dg = jax.scipy.special.digamma
+
+    def kl(a1, b1, a2, b2):
+        return (_betaln(a2, b2) - _betaln(a1, b1)
+                + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+
+    return _op(kl, p.alpha, p.beta, q.alpha, q.beta, name="kl_beta")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    dg = jax.scipy.special.digamma
+    g = jax.scipy.special.gammaln
+
+    def kl(c1, c2):
+        a0 = c1.sum(-1)
+        return (g(a0) - g(c1).sum(-1) - g(c2.sum(-1)) + g(c2).sum(-1)
+                + ((c1 - c2) * (dg(c1) - dg(a0)[..., None])).sum(-1))
+
+    return _op(kl, p.concentration, q.concentration, name="kl_dirichlet")

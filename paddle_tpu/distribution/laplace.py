@@ -1,0 +1,75 @@
+"""Laplace distribution (reference `distribution/laplace.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_array, _op, _shp
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        batch = jnp.broadcast_shapes(_shp(self.loc), _shp(self.scale))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: jnp.broadcast_to(l, jnp.broadcast_shapes(
+            l.shape, s.shape)), self.loc, self.scale, name="laplace_mean")
+
+    @property
+    def variance(self):
+        return _op(lambda l, s: jnp.broadcast_to(
+            2.0 * s * s, jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale, name="laplace_var")
+
+    @property
+    def stddev(self):
+        return _op(lambda l, s: jnp.broadcast_to(
+            math.sqrt(2.0) * s, jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale, name="laplace_std")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        key = self._key()
+        return _op(
+            lambda l, s: l + s * jax.random.laplace(
+                key, full, jnp.result_type(l)),
+            self.loc, self.scale, name="laplace_rsample")
+
+    def log_prob(self, value):
+        return _op(
+            lambda v, l, s: -jnp.abs(v - l) / s - jnp.log(2.0 * s),
+            _as_array(value), self.loc, self.scale, name="laplace_log_prob")
+
+    def entropy(self):
+        return _op(
+            lambda l, s: jnp.broadcast_to(1.0 + jnp.log(2.0 * s),
+                                          jnp.broadcast_shapes(l.shape,
+                                                               s.shape)),
+            self.loc, self.scale, name="laplace_entropy")
+
+    def cdf(self, value):
+        def c(v, l, s):
+            z = (v - l) / s
+            return 0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z))
+
+        return _op(c, _as_array(value), self.loc, self.scale,
+                   name="laplace_cdf")
+
+    def icdf(self, value):
+        def ic(v, l, s):
+            term = v - 0.5
+            return l - s * jnp.sign(term) * jnp.log1p(-2.0 * jnp.abs(term))
+
+        return _op(ic, _as_array(value), self.loc, self.scale,
+                   name="laplace_icdf")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)

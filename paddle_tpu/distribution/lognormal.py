@@ -1,0 +1,49 @@
+"""LogNormal distribution (reference `distribution/lognormal.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_array, _op, _shp
+from .normal import Normal, _HALF_LOG_2PI
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        self._base = Normal(loc, scale)
+        batch = jnp.broadcast_shapes(_shp(self.loc), _shp(self.scale))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: jnp.exp(l + s * s / 2.0),
+                   self.loc, self.scale, name="lognormal_mean")
+
+    @property
+    def variance(self):
+        return _op(
+            lambda l, s: jnp.expm1(s * s) * jnp.exp(2.0 * l + s * s),
+            self.loc, self.scale, name="lognormal_var")
+
+    def rsample(self, shape=()):
+        base = self._base.rsample(shape)
+        return _op(lambda x: jnp.exp(x), base, name="lognormal_rsample")
+
+    def log_prob(self, value):
+        return _op(
+            lambda v, l, s: -((jnp.log(v) - l) ** 2) / (2.0 * s * s)
+            - jnp.log(s * v) - _HALF_LOG_2PI,
+            _as_array(value), self.loc, self.scale, name="lognormal_log_prob")
+
+    def entropy(self):
+        return _op(
+            lambda l, s: 0.5 + _HALF_LOG_2PI + jnp.log(s) + l,
+            self.loc, self.scale, name="lognormal_entropy")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)

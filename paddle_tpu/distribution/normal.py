@@ -1,0 +1,72 @@
+"""Normal distribution (reference `distribution/normal.py`)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_array, _op, _shp
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+        batch = jnp.broadcast_shapes(_shp(self.loc), _shp(self.scale))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _op(lambda l, s: jnp.broadcast_to(l, jnp.broadcast_shapes(
+            l.shape, s.shape)), self.loc, self.scale, name="normal_mean")
+
+    @property
+    def variance(self):
+        return _op(lambda l, s: jnp.broadcast_to(s * s, jnp.broadcast_shapes(
+            l.shape, s.shape)), self.loc, self.scale, name="normal_var")
+
+    @property
+    def stddev(self):
+        return _op(lambda l, s: jnp.broadcast_to(s, jnp.broadcast_shapes(
+            l.shape, s.shape)), self.loc, self.scale, name="normal_std")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        key = self._key()
+        return _op(
+            lambda l, s: l + s * jax.random.normal(key, full,
+                                                   jnp.result_type(l)),
+            self.loc, self.scale, name="normal_rsample")
+
+    def log_prob(self, value):
+        return _op(
+            lambda v, l, s: -((v - l) ** 2) / (2.0 * s * s) - jnp.log(s)
+            - _HALF_LOG_2PI,
+            _as_array(value), self.loc, self.scale, name="normal_log_prob")
+
+    def entropy(self):
+        return _op(
+            lambda l, s: jnp.broadcast_to(
+                0.5 + _HALF_LOG_2PI + jnp.log(s),
+                jnp.broadcast_shapes(l.shape, s.shape)),
+            self.loc, self.scale, name="normal_entropy")
+
+    def cdf(self, value):
+        return _op(
+            lambda v, l, s: 0.5 * (1.0 + jax.scipy.special.erf(
+                (v - l) / (s * jnp.sqrt(2.0)))),
+            _as_array(value), self.loc, self.scale, name="normal_cdf")
+
+    def icdf(self, value):
+        return _op(
+            lambda v, l, s: l + s * jnp.sqrt(2.0)
+            * jax.scipy.special.erfinv(2.0 * v - 1.0),
+            _as_array(value), self.loc, self.scale, name="normal_icdf")
+
+    def kl_divergence(self, other):
+        from .kl import kl_divergence
+
+        return kl_divergence(self, other)

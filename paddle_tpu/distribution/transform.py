@@ -1,0 +1,338 @@
+"""Random-variable transforms (reference `distribution/transform.py`).
+
+Pure-jnp re-implementation: each transform exposes forward/inverse/
+log-det-Jacobian as jnp functions; Tensor in → Tensor out via the dispatcher
+so gradients flow."""
+from __future__ import annotations
+
+import enum
+import math
+import operator
+from functools import reduce
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import _as_array, _op
+
+__all__ = [
+    "Transform", "AbsTransform", "AffineTransform", "ChainTransform",
+    "ExpTransform", "IndependentTransform", "PowerTransform",
+    "ReshapeTransform", "SigmoidTransform", "SoftmaxTransform",
+    "StackTransform", "StickBreakingTransform", "TanhTransform",
+]
+
+
+class Type(enum.Enum):
+    BIJECTION = "bijection"
+    INJECTION = "injection"
+    SURJECTION = "surjection"
+    OTHER = "other"
+
+    @classmethod
+    def is_injective(cls, t):
+        return t in (cls.BIJECTION, cls.INJECTION)
+
+
+class Transform:
+    _type = Type.INJECTION
+
+    @classmethod
+    def _is_injective(cls):
+        return Type.is_injective(cls._type)
+
+    def __call__(self, x):
+        from .transformed_distribution import TransformedDistribution
+        from .distribution import Distribution
+
+        if isinstance(x, Distribution):
+            return TransformedDistribution(x, [self])
+        if isinstance(x, Transform):
+            return ChainTransform([self, x])
+        return self.forward(x)
+
+    def forward(self, x):
+        return _op(self._forward, _as_array(x), name="transform_fwd")
+
+    def inverse(self, y):
+        return _op(self._inverse, _as_array(y), name="transform_inv")
+
+    def forward_log_det_jacobian(self, x):
+        return _op(self._forward_log_det_jacobian, _as_array(x),
+                   name="transform_fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        return _op(self._inverse_log_det_jacobian, _as_array(y),
+                   name="transform_ildj")
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # jnp-level hooks (subclasses implement) --------------------------------
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        # derive from the inverse ldj only if the subclass actually defines
+        # one (otherwise the two defaults would recurse forever)
+        if (type(self)._inverse_log_det_jacobian
+                is Transform._inverse_log_det_jacobian):
+            raise NotImplementedError(
+                f"{type(self).__name__} defines no log-det-Jacobian")
+        return -self._inverse_log_det_jacobian(self._forward(x))
+
+    def _inverse_log_det_jacobian(self, y):
+        if (type(self)._forward_log_det_jacobian
+                is Transform._forward_log_det_jacobian):
+            raise NotImplementedError(
+                f"{type(self).__name__} defines no log-det-Jacobian")
+        return -self._forward_log_det_jacobian(self._inverse(y))
+
+    # event dims contributed by this transform (0 = elementwise)
+    _event_dim = 0
+
+
+class AbsTransform(Transform):
+    _type = Type.SURJECTION
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y  # principal branch, matching the reference
+
+
+class AffineTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, loc, scale):
+        self.loc = _as_array(loc)
+        self.scale = _as_array(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class ExpTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, power):
+        self.power = _as_array(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    _type = Type.BIJECTION
+
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    _type = Type.OTHER
+    _event_dim = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+
+class StickBreakingTransform(Transform):
+    _type = Type.BIJECTION
+    _event_dim = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] + 1 - jnp.cumsum(
+            jnp.ones_like(x, dtype=x.dtype), axis=-1)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zc = jnp.cumprod(1.0 - z, axis=-1)
+        pad = jnp.ones(x.shape[:-1] + (1,), x.dtype)
+        return jnp.concatenate([z, pad], -1) * jnp.concatenate(
+            [pad, zc], -1)
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], axis=-1)
+        offset = y.shape[-1] - jnp.cumsum(
+            jnp.ones_like(y[..., :-1], dtype=y.dtype), axis=-1)
+        z = y[..., :-1] / (1.0 - jnp.concatenate(
+            [jnp.zeros(y.shape[:-1] + (1,), y.dtype), ycum[..., :-1]], -1))
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        y = self._forward(x)
+        offset = x.shape[-1] + 1 - jnp.cumsum(
+            jnp.ones_like(x, dtype=x.dtype), axis=-1)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        return (jnp.log(z) + jnp.log1p(-z)
+                + jnp.log(y[..., :-1]) - jnp.log(z)).sum(-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ReshapeTransform(Transform):
+    _type = Type.BIJECTION
+
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+        if reduce(operator.mul, self.in_event_shape, 1) != reduce(
+                operator.mul, self.out_event_shape, 1):
+            raise ValueError("in/out event sizes must match")
+
+    def _forward(self, x):
+        n = len(self.in_event_shape)
+        batch = x.shape[: x.ndim - n]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        n = len(self.out_event_shape)
+        batch = y.shape[: y.ndim - n]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        n = len(self.in_event_shape)
+        return jnp.zeros(x.shape[: x.ndim - n], x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(self.in_event_shape)
+        return tuple(shape[: len(shape) - n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(self.out_event_shape)
+        return tuple(shape[: len(shape) - n]) + self.in_event_shape
+
+
+class IndependentTransform(Transform):
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        self._type = base._type
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ldj = self.base._forward_log_det_jacobian(x)
+        for _ in range(self.reinterpreted_batch_rank):
+            ldj = ldj.sum(-1)
+        return ldj
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._type = (Type.BIJECTION if all(
+            t._type == Type.BIJECTION for t in self.transforms)
+            else Type.OTHER)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        total = 0.0
+        for t in self.transforms:
+            total = total + t._forward_log_det_jacobian(x)
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class StackTransform(Transform):
+    """Apply a sequence of transforms along `axis` (reference
+    `transform.py:1052`)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fns, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [fn(p.squeeze(self.axis)) for fn, p in zip(fns, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map([t._forward for t in self.transforms], x)
+
+    def _inverse(self, y):
+        return self._map([t._inverse for t in self.transforms], y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map(
+            [t._forward_log_det_jacobian for t in self.transforms], x)

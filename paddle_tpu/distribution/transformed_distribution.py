@@ -1,0 +1,64 @@
+"""TransformedDistribution (reference
+`distribution/transformed_distribution.py`)."""
+from __future__ import annotations
+
+from .distribution import Distribution, _op
+from .transform import ChainTransform, Type
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base, transforms):
+        if not isinstance(transforms, (list, tuple)):
+            transforms = [transforms]
+        self._base = base
+        self._transforms = list(transforms)
+        chain = ChainTransform(self._transforms)
+        shape = base.batch_shape + base.event_shape
+        out_shape = chain.forward_shape(shape)
+        event_rank = max(len(base.event_shape),
+                         max((getattr(t, "_event_dim", 0)
+                              for t in self._transforms), default=0))
+        cut = len(out_shape) - event_rank
+        super().__init__(batch_shape=out_shape[:cut],
+                         event_shape=out_shape[cut:])
+
+    def sample(self, shape=()):
+        x = self._base.sample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self._base.rsample(shape)
+        for t in self._transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        import jax.numpy as jnp
+
+        from .distribution import _as_array
+
+        if any(not Type.is_injective(t._type) for t in self._transforms):
+            raise NotImplementedError(
+                "log_prob undefined for non-injective transforms")
+
+        def lp(v, *params):
+            # walk backwards through the chain accumulating -log|detJ|
+            total = 0.0
+            y = v
+            for t in reversed(self._transforms):
+                x = t._inverse(y)
+                ldj = t._forward_log_det_jacobian(x)
+                ed = getattr(t, "_event_dim", 0)
+                for _ in range(ed):
+                    ldj = ldj.sum(-1)
+                total = total - ldj
+                y = x
+            base_lp = self._base.log_prob(y)
+            base_arr = base_lp._data if hasattr(base_lp, "_data") else base_lp
+            return base_arr + total
+
+        # note: base.log_prob runs inside lp so residual grads flow through
+        # the dispatcher-traced closure
+        return _op(lp, _as_array(value), name="transformed_log_prob")

@@ -1,0 +1,50 @@
+"""Uniform distribution (reference `distribution/uniform.py`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .distribution import Distribution, _as_array, _op, _shp
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_array(low)
+        self.high = _as_array(high)
+        batch = jnp.broadcast_shapes(_shp(self.low), _shp(self.high))
+        super().__init__(batch_shape=batch)
+
+    @property
+    def mean(self):
+        return _op(lambda a, b: (a + b) / 2.0, self.low, self.high,
+                   name="uniform_mean")
+
+    @property
+    def variance(self):
+        return _op(lambda a, b: (b - a) ** 2 / 12.0, self.low, self.high,
+                   name="uniform_var")
+
+    def rsample(self, shape=()):
+        full = self._extend_shape(shape)
+        key = self._key()
+        return _op(
+            lambda a, b: a + (b - a) * jax.random.uniform(
+                key, full, jnp.result_type(a)),
+            self.low, self.high, name="uniform_rsample")
+
+    def log_prob(self, value):
+        def lp(v, a, b):
+            inside = (v >= a) & (v < b)
+            return jnp.where(inside, -jnp.log(b - a), -jnp.inf)
+
+        return _op(lp, _as_array(value), self.low, self.high,
+                   name="uniform_log_prob")
+
+    def entropy(self):
+        return _op(lambda a, b: jnp.log(b - a), self.low, self.high,
+                   name="uniform_entropy")
+
+    def cdf(self, value):
+        return _op(
+            lambda v, a, b: jnp.clip((v - a) / (b - a), 0.0, 1.0),
+            _as_array(value), self.low, self.high, name="uniform_cdf")

@@ -1,0 +1,152 @@
+"""paddle_tpu.fft — FFT family (reference `python/paddle/fft.py`).
+
+The reference lowers to cuFFT/pocketfft via `fft_c2c/r2c/c2r` ops; here
+every transform is jnp.fft, which XLA compiles directly (TPU FFT lowering).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import forward
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft", "fft2", "ifft2",
+    "rfft2", "irfft2", "hfft2", "ihfft2", "fftn", "ifftn", "rfftn",
+    "irfftn", "hfftn", "ihfftn", "fftfreq", "rfftfreq", "fftshift",
+    "ifftshift",
+]
+
+
+def _norm(norm):
+    # paddle norms: "backward" (default), "forward", "ortho" — same names
+    return norm
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.fft(a, n=n, axis=axis, norm=_norm(norm)),
+                   (x,), name="fft")
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.ifft(a, n=n, axis=axis, norm=_norm(norm)),
+                   (x,), name="ifft")
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.rfft(a, n=n, axis=axis, norm=_norm(norm)),
+                   (x,), name="rfft")
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.irfft(a, n=n, axis=axis,
+                                           norm=_norm(norm)),
+                   (x,), name="irfft")
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.hfft(a, n=n, axis=axis, norm=_norm(norm)),
+                   (x,), name="hfft")
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.ihfft(a, n=n, axis=axis,
+                                           norm=_norm(norm)),
+                   (x,), name="ihfft")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return forward(lambda a: jnp.fft.fft2(a, s=s, axes=axes, norm=_norm(norm)),
+                   (x,), name="fft2")
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return forward(lambda a: jnp.fft.ifft2(a, s=s, axes=axes,
+                                           norm=_norm(norm)),
+                   (x,), name="ifft2")
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return forward(lambda a: jnp.fft.rfft2(a, s=s, axes=axes,
+                                           norm=_norm(norm)),
+                   (x,), name="rfft2")
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return forward(lambda a: jnp.fft.irfft2(a, s=s, axes=axes,
+                                            norm=_norm(norm)),
+                   (x,), name="irfft2")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return forward(
+        lambda a: jnp.fft.hfft(jnp.fft.fft(
+            a, n=None if s is None else s[0], axis=axes[0], norm=_norm(norm)),
+            n=None if s is None else s[1], axis=axes[1], norm=_norm(norm)),
+        (x,), name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return forward(
+        lambda a: jnp.fft.ihfft(jnp.fft.ifft(
+            a, n=None if s is None else s[0], axis=axes[0], norm=_norm(norm)),
+            n=None if s is None else s[1], axis=axes[1], norm=_norm(norm)),
+        (x,), name="ihfft2")
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.fftn(a, s=s, axes=axes, norm=_norm(norm)),
+                   (x,), name="fftn")
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.ifftn(a, s=s, axes=axes,
+                                           norm=_norm(norm)),
+                   (x,), name="ifftn")
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.rfftn(a, s=s, axes=axes,
+                                           norm=_norm(norm)),
+                   (x,), name="rfftn")
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return forward(lambda a: jnp.fft.irfftn(a, s=s, axes=axes,
+                                            norm=_norm(norm)),
+                   (x,), name="irfftn")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    raise NotImplementedError("hfftn: use hfft/hfft2 per-axis")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    raise NotImplementedError("ihfftn: use ihfft/ihfft2 per-axis")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    from .core import dtype as dtypes
+
+    dt = dtypes.convert_dtype(dtype) if dtype else None
+    return forward(lambda: jnp.fft.fftfreq(n, d).astype(dt)
+                   if dt else jnp.fft.fftfreq(n, d), (), name="fftfreq",
+                   nondiff=True)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    from .core import dtype as dtypes
+
+    dt = dtypes.convert_dtype(dtype) if dtype else None
+    return forward(lambda: jnp.fft.rfftfreq(n, d).astype(dt)
+                   if dt else jnp.fft.rfftfreq(n, d), (), name="rfftfreq",
+                   nondiff=True)
+
+
+def fftshift(x, axes=None, name=None):
+    return forward(lambda a: jnp.fft.fftshift(a, axes=axes), (x,),
+                   name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return forward(lambda a: jnp.fft.ifftshift(a, axes=axes), (x,),
+                   name="ifftshift")

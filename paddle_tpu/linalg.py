@@ -1,0 +1,7 @@
+"""paddle_tpu.linalg namespace (reference `python/paddle/linalg.py` — thin
+re-export of tensor.linalg)."""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__ as _lin_all
+from .ops.math import matmul  # noqa: F401
+
+__all__ = list(_lin_all) + ["matmul"]

@@ -1,0 +1,136 @@
+"""paddle_tpu.signal — frame / overlap_add / stft / istft
+(reference `python/paddle/signal.py:31,151,236,403`).
+
+TPU-native: framing is a gather (XLA dynamic-slice batch), overlap-add is a
+segment-sum scatter, and the DFTs are jnp.fft — all fuse under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core.dispatch import forward, unwrap
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice into overlapping frames (signal.py:31). axis must be 0 or -1."""
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+
+    def f(a):
+        n = a.shape[axis]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        offs = jnp.arange(frame_length)
+        idx = starts[:, None] + offs[None, :]  # [num, frame_length]
+        if axis == -1:
+            out = jnp.take(a, idx, axis=-1)  # [..., num, frame_length]
+            return jnp.swapaxes(out, -1, -2)  # [..., frame_length, num]
+        out = jnp.take(a, idx, axis=0)  # [num, frame_length, ...]
+        return out
+
+    return forward(f, (x,), name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame (signal.py:151)."""
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+
+    def f(a):
+        if axis == -1:
+            fl, num = a.shape[-2], a.shape[-1]
+            seq = (num - 1) * hop_length + fl
+            frames = jnp.swapaxes(a, -1, -2)  # [..., num, fl]
+            out = jnp.zeros(a.shape[:-2] + (seq,), a.dtype)
+            idx = (jnp.arange(num) * hop_length)[:, None] \
+                + jnp.arange(fl)[None, :]
+            return out.at[..., idx.reshape(-1)].add(
+                frames.reshape(a.shape[:-2] + (-1,)))
+        num, fl = a.shape[0], a.shape[1]
+        seq = (num - 1) * hop_length + fl
+        out = jnp.zeros((seq,) + a.shape[2:], a.dtype)
+        idx = (jnp.arange(num) * hop_length)[:, None] \
+            + jnp.arange(fl)[None, :]
+        return out.at[idx.reshape(-1)].add(a.reshape((-1,) + a.shape[2:]))
+
+    return forward(f, (x,), name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform (signal.py:236)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = None if window is None else jnp.asarray(unwrap(window))
+
+    def f(a):
+        win = jnp.ones(win_length, a.dtype if not jnp.iscomplexobj(a)
+                       else jnp.float32) if w is None else w
+        if win_length < n_fft:  # center-pad window
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        sig = a
+        if center:
+            pad = [(0, 0)] * (sig.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            sig = jnp.pad(sig, pad, mode=pad_mode)
+        n = sig.shape[-1]
+        num = 1 + (n - n_fft) // hop_length
+        idx = (jnp.arange(num) * hop_length)[:, None] \
+            + jnp.arange(n_fft)[None, :]
+        frames = jnp.take(sig, idx, axis=-1)  # [..., num, n_fft]
+        frames = frames * win
+        if onesided and not jnp.iscomplexobj(a):
+            spec = jnp.fft.rfft(frames, axis=-1)
+        else:
+            spec = jnp.fft.fft(frames, axis=-1)
+        if normalized:
+            spec = spec / jnp.sqrt(n_fft).astype(spec.real.dtype)
+        return jnp.swapaxes(spec, -1, -2)  # [..., freq, num_frames]
+
+    return forward(f, (x,), name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT (signal.py:403)."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = None if window is None else jnp.asarray(unwrap(window))
+
+    def f(spec):
+        win = jnp.ones(win_length, jnp.float32) if w is None else w
+        if win_length < n_fft:
+            lp = (n_fft - win_length) // 2
+            win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        frames_fd = jnp.swapaxes(spec, -1, -2)  # [..., num, freq]
+        if onesided:
+            frames = jnp.fft.irfft(frames_fd, n=n_fft, axis=-1)
+        else:
+            frames = jnp.fft.ifft(frames_fd, n=n_fft, axis=-1)
+            if not return_complex:
+                frames = frames.real
+        if normalized:
+            frames = frames * jnp.sqrt(n_fft).astype(frames.dtype)
+        frames = frames * win
+        num = frames.shape[-2]
+        seq = (num - 1) * hop_length + n_fft
+        idx = (jnp.arange(num) * hop_length)[:, None] \
+            + jnp.arange(n_fft)[None, :]
+        out = jnp.zeros(frames.shape[:-2] + (seq,), frames.dtype)
+        out = out.at[..., idx.reshape(-1)].add(
+            frames.reshape(frames.shape[:-2] + (-1,)))
+        # window envelope normalization (COLA)
+        env = jnp.zeros(seq, win.dtype).at[idx.reshape(-1)].add(
+            jnp.tile(win * win, num))
+        out = out / jnp.maximum(env, 1e-11)
+        if center:
+            out = out[..., n_fft // 2: seq - n_fft // 2]
+        if length is not None:
+            out = out[..., :length]
+        return out
+
+    return forward(f, (x,), name="istft")

@@ -1,0 +1,32 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors.
+
+Capability parity with the reference's `python/paddle/sparse/` (creation.py,
+unary.py, binary.py, multiary.py) and the PHI sparse kernels
+(`paddle/phi/kernels/sparse/`), re-designed for TPU: storage is
+`jax.experimental.sparse` BCOO/BCSR, whose ops lower to XLA
+gather/scatter/dot_general — no hand-written CUDA kernels. Dense fallbacks
+are used only where XLA sparse support is absent, mirroring the reference's
+CPU fallbacks.
+"""
+from .creation import sparse_coo_tensor, sparse_csr_tensor  # noqa: F401
+from .tensor import SparseCooTensor, SparseCsrTensor  # noqa: F401
+from .unary import (  # noqa: F401
+    sin, tan, asin, atan, sinh, tanh, asinh, atanh, sqrt, square, log1p,
+    abs, pow, cast, neg, coalesce, deg2rad, rad2deg, expm1, transpose,
+    reshape, sum,
+)
+from .binary import (  # noqa: F401
+    add, subtract, multiply, divide, matmul, masked_matmul, mv,
+    is_same_shape,
+)
+from .multiary import addmm  # noqa: F401
+from . import nn  # noqa: F401
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "sin", "tan", "asin", "atan", "sinh", "tanh",
+    "asinh", "atanh", "sqrt", "square", "log1p", "abs", "pow", "cast",
+    "neg", "coalesce", "deg2rad", "rad2deg", "expm1", "transpose",
+    "reshape", "sum", "add", "subtract", "multiply", "divide", "matmul",
+    "masked_matmul", "mv", "is_same_shape", "addmm", "nn",
+]

@@ -1,0 +1,43 @@
+"""Sparse tensor creation (reference `python/paddle/sparse/creation.py:72,187`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core import dtype as dtypes
+from ..core.dispatch import unwrap
+from .tensor import SparseCooTensor, SparseCsrTensor
+
+
+def _values(values, dtype):
+    v = jnp.asarray(unwrap(values))
+    if dtype is not None:
+        v = v.astype(dtypes.convert_dtype(dtype))
+    return v
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """`paddle.sparse.sparse_coo_tensor` (creation.py:72).
+
+    indices: [sparse_dim, nnz] (reference layout — transposed into BCOO's
+    [nnz, sparse_dim] internally)."""
+    idx = jnp.asarray(unwrap(indices)).astype(jnp.int32)
+    if idx.ndim != 2:
+        raise ValueError("indices must be [sparse_dim, nnz]")
+    v = _values(values, dtype)
+    if shape is None:
+        upper = (idx.max(axis=1) + 1).tolist() if idx.size else [0] * idx.shape[0]
+        shape = tuple(int(u) for u in upper) + v.shape[1:]
+    bcoo = jsparse.BCOO((v, idx.T), shape=tuple(shape))
+    return SparseCooTensor(bcoo, stop_gradient=stop_gradient)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """`paddle.sparse.sparse_csr_tensor` (creation.py:187)."""
+    indptr = jnp.asarray(unwrap(crows)).astype(jnp.int32)
+    indices = jnp.asarray(unwrap(cols)).astype(jnp.int32)
+    v = _values(values, dtype)
+    bcsr = jsparse.BCSR((v, indices, indptr), shape=tuple(shape))
+    return SparseCsrTensor(bcsr, stop_gradient=stop_gradient)

@@ -1,0 +1,21 @@
+"""Sparse multiary ops (reference `python/paddle/sparse/multiary.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import unwrap
+from ..core.tensor import Tensor
+from .binary import matmul
+from .tensor import SparseCooTensor, SparseCsrTensor
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """out = beta*input + alpha*(x@y) (multiary.py:22)."""
+    prod = matmul(x, y)
+    if isinstance(prod, (SparseCooTensor, SparseCsrTensor)):
+        prod = prod.to_dense()
+    inp = input.to_dense() if isinstance(
+        input, (SparseCooTensor, SparseCsrTensor)) else input
+    a = unwrap(inp) if isinstance(inp, Tensor) else jnp.asarray(inp)
+    b = unwrap(prod) if isinstance(prod, Tensor) else jnp.asarray(prod)
+    return Tensor(beta * a + alpha * b)

@@ -1,0 +1,45 @@
+"""Sparse NN layers (reference `python/paddle/sparse/nn/`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ..tensor import SparseCooTensor, SparseCsrTensor, _coo
+from . import functional  # noqa: F401
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return functional.relu(x)
+
+
+class Softmax(Layer):
+    """Sparse softmax over the last dim (reference
+    sparse/nn/layer/activation.py Softmax): only nnz entries participate."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        self.axis = axis
+
+    def forward(self, x):
+        return functional.softmax(x, self.axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm on the values of a COO tensor (reference
+    sparse/nn/layer/norm.py BatchNorm — norm over channel dim of values)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5):
+        super().__init__()
+        from ...nn.layer.norm import BatchNorm1D
+
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    def forward(self, x):
+        b = _coo(x)
+        vals = self._bn(Tensor(b.data, stop_gradient=x.stop_gradient))
+        return SparseCooTensor(jsparse.BCOO((vals._data, b.indices),
+                                            shape=b.shape), x.stop_gradient)

@@ -1,0 +1,54 @@
+"""Sparse functional ops (reference `python/paddle/sparse/nn/functional/`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...core.tensor import Tensor
+from ..tensor import SparseCooTensor, SparseCsrTensor, _coo, _wrap_like
+
+
+def relu(x, name=None):
+    from ..unary import _unary
+
+    return _unary(x, jax.nn.relu)
+
+
+def softmax(x, axis=-1, name=None):
+    """Softmax over nnz entries per row (last-dim only, like the reference's
+    sparse softmax kernels)."""
+    if axis != -1:
+        raise ValueError("sparse softmax supports only axis=-1")
+    b = _coo(x).sum_duplicates(remove_zeros=False)
+    # one segment per "row" = one setting of ALL dims but the last
+    # (ravel_multi_index over the leading dims, so ndim>2 works)
+    import numpy as np
+
+    row_shape = b.shape[:-1]
+    strides = np.ones(len(row_shape), np.int64)
+    for d in range(len(row_shape) - 2, -1, -1):
+        strides[d] = strides[d + 1] * row_shape[d + 1]
+    rows = (b.indices[:, :-1] * jnp.asarray(strides)).sum(-1)
+    n_rows = int(np.prod(row_shape))
+    # segment softmax over XLA segment ops — no scatter loops
+    row_max = jax.ops.segment_max(b.data, rows, num_segments=n_rows)
+    shifted = jnp.exp(b.data - row_max[rows])
+    denom = jax.ops.segment_sum(shifted, rows, num_segments=n_rows)
+    vals = shifted / denom[rows]
+    return _wrap_like(x, jsparse.BCOO((vals, b.indices), shape=b.shape))
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse attention (reference sparse/nn/functional/transformer.py):
+    qk^T sampled at sparse_mask's pattern, sparse softmax, then spmm."""
+    from ..binary import masked_matmul
+
+    q = query._data if isinstance(query, Tensor) else jnp.asarray(query)
+    k = key._data if isinstance(key, Tensor) else jnp.asarray(key)
+    v = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    d = q.shape[-1]
+    scores = masked_matmul(Tensor(q / jnp.sqrt(d)), Tensor(k.T), sparse_mask)
+    probs = softmax(scores)
+    return Tensor(_coo(probs) @ v)

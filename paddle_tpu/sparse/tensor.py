@@ -1,0 +1,157 @@
+"""Sparse tensor wrappers over jax.experimental.sparse.
+
+Reference: `paddle/phi/core/sparse_coo_tensor.h`, `sparse_csr_tensor.h` —
+there, SparseCooTensor = (indices DenseTensor, values DenseTensor); here the
+storage is a BCOO/BCSR jax array so every op is an XLA lowering.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core.tensor import Tensor
+
+
+class SparseCooTensor:
+    """COO sparse tensor (PHI SparseCooTensor equivalent)."""
+
+    def __init__(self, bcoo: jsparse.BCOO, stop_gradient=True):
+        self._bcoo = bcoo
+        self.stop_gradient = stop_gradient
+
+    # paddle Tensor-protocol surface -----------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        from ..core.dtype import DType
+
+        return DType(self._bcoo.dtype)
+
+    @property
+    def ndim(self):
+        return self._bcoo.ndim
+
+    @property
+    def nnz(self):
+        return self._bcoo.nse
+
+    def indices(self):
+        """nnz indices, shape [sparse_dim, nnz] (reference layout)."""
+        return Tensor(self._bcoo.indices.T, stop_gradient=True)
+
+    def values(self):
+        return Tensor(self._bcoo.data, stop_gradient=self.stop_gradient)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense(),
+                      stop_gradient=self.stop_gradient)
+
+    def to_sparse_csr(self):
+        coo = self._bcoo.sum_duplicates(remove_zeros=False)
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(coo),
+                               self.stop_gradient)
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def coalesce(self):
+        return SparseCooTensor(
+            self._bcoo.sum_duplicates(remove_zeros=False),
+            self.stop_gradient)
+
+    def astype(self, dtype):
+        from ..core.dtype import convert_dtype
+
+        d = convert_dtype(dtype)
+        return SparseCooTensor(
+            jsparse.BCOO((self._bcoo.data.astype(d), self._bcoo.indices),
+                         shape=self._bcoo.shape), self.stop_gradient)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self._bcoo.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (PHI SparseCsrTensor equivalent)."""
+
+    def __init__(self, bcsr: jsparse.BCSR, stop_gradient=True):
+        self._bcsr = bcsr
+        self.stop_gradient = stop_gradient
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        from ..core.dtype import DType
+
+        return DType(self._bcsr.dtype)
+
+    @property
+    def ndim(self):
+        return self._bcsr.ndim
+
+    @property
+    def nnz(self):
+        return self._bcsr.nse
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr, stop_gradient=True)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices, stop_gradient=True)
+
+    def values(self):
+        return Tensor(self._bcsr.data, stop_gradient=self.stop_gradient)
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense(),
+                      stop_gradient=self.stop_gradient)
+
+    def to_sparse_coo(self, sparse_dim=None):
+        return SparseCooTensor(self._bcsr.to_bcoo(), self.stop_gradient)
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def numpy(self):
+        return self.to_dense().numpy()
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self._bcsr.dtype})")
+
+
+def _coo(x) -> jsparse.BCOO:
+    """Normalize any sparse/dense input to BCOO."""
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr.to_bcoo()
+    if isinstance(x, Tensor):
+        return jsparse.BCOO.fromdense(x._data)
+    return jsparse.BCOO.fromdense(jnp.asarray(x))
+
+
+def _wrap_like(x, bcoo):
+    """Wrap a BCOO result in the same sparse format as the input."""
+    if isinstance(x, SparseCsrTensor):
+        return SparseCsrTensor(
+            jsparse.BCSR.from_bcoo(bcoo.sum_duplicates(remove_zeros=False)),
+            x.stop_gradient)
+    sg = x.stop_gradient if hasattr(x, "stop_gradient") else True
+    return SparseCooTensor(bcoo, sg)

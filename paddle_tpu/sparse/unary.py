@@ -1,0 +1,134 @@
+"""Sparse unary ops (reference `python/paddle/sparse/unary.py`): applied to
+the nnz values only — all these fns map 0→0 so sparsity is preserved (the
+same invariant the reference's sparse kernels rely on)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..core import dtype as dtypes
+from .tensor import SparseCooTensor, SparseCsrTensor, _coo, _wrap_like
+
+
+def _unary(x, fn):
+    if isinstance(x, SparseCsrTensor):
+        b = x._bcsr
+        return SparseCsrTensor(
+            jsparse.BCSR((fn(b.data), b.indices, b.indptr), shape=b.shape),
+            x.stop_gradient)
+    b = _coo(x)
+    return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                        shape=b.shape), x.stop_gradient)
+
+
+def sin(x, name=None):
+    return _unary(x, jnp.sin)
+
+
+def tan(x, name=None):
+    return _unary(x, jnp.tan)
+
+
+def asin(x, name=None):
+    return _unary(x, jnp.arcsin)
+
+
+def atan(x, name=None):
+    return _unary(x, jnp.arctan)
+
+
+def sinh(x, name=None):
+    return _unary(x, jnp.sinh)
+
+
+def tanh(x, name=None):
+    return _unary(x, jnp.tanh)
+
+
+def asinh(x, name=None):
+    return _unary(x, jnp.arcsinh)
+
+
+def atanh(x, name=None):
+    return _unary(x, jnp.arctanh)
+
+
+def sqrt(x, name=None):
+    return _unary(x, jnp.sqrt)
+
+
+def square(x, name=None):
+    return _unary(x, jnp.square)
+
+
+def log1p(x, name=None):
+    return _unary(x, jnp.log1p)
+
+
+def abs(x, name=None):
+    return _unary(x, jnp.abs)
+
+
+def pow(x, factor, name=None):
+    return _unary(x, lambda v: jnp.power(v, factor))
+
+
+def neg(x, name=None):
+    return _unary(x, jnp.negative)
+
+
+def expm1(x, name=None):
+    return _unary(x, jnp.expm1)
+
+
+def deg2rad(x, name=None):
+    return _unary(x, jnp.deg2rad)
+
+
+def rad2deg(x, name=None):
+    return _unary(x, jnp.rad2deg)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    vd = dtypes.convert_dtype(value_dtype) if value_dtype else None
+    if isinstance(x, SparseCsrTensor):
+        b = x._bcsr
+        data = b.data.astype(vd) if vd else b.data
+        idx = b.indices.astype(index_dtype) if index_dtype else b.indices
+        ptr = b.indptr.astype(index_dtype) if index_dtype else b.indptr
+        return SparseCsrTensor(jsparse.BCSR((data, idx, ptr), shape=b.shape),
+                               x.stop_gradient)
+    b = _coo(x)
+    data = b.data.astype(vd) if vd else b.data
+    idx = b.indices.astype(index_dtype) if index_dtype else b.indices
+    return SparseCooTensor(jsparse.BCOO((data, idx), shape=b.shape),
+                           x.stop_gradient)
+
+
+def coalesce(x, name=None):
+    return x.coalesce()
+
+
+def transpose(x, perm, name=None):
+    b = _coo(x)
+    return _wrap_like(x, b.transpose(tuple(perm)))
+
+
+def reshape(x, shape, name=None):
+    b = _coo(x)
+    return _wrap_like(x, b.reshape(tuple(shape)))
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    from ..core.tensor import Tensor
+
+    b = _coo(x)
+    data = b.data.astype(dtypes.convert_dtype(dtype)) if dtype else b.data
+    b = jsparse.BCOO((data, b.indices), shape=b.shape)
+    if axis is None:
+        return Tensor(b.sum())
+    out = jsparse.sparsify(
+        lambda m: m.sum(axis if isinstance(axis, int) else tuple(axis)))(b)
+    if isinstance(out, jsparse.BCOO):
+        return _wrap_like(x, out)
+    return Tensor(out)

@@ -1,0 +1,153 @@
+"""MobileNet V1/V2 (reference `python/paddle/vision/models/mobilenetv1.py`,
+`mobilenetv2.py`). Depthwise convs = grouped Conv2D — XLA lowers these to
+depthwise convolution HLO directly."""
+from __future__ import annotations
+
+from ... import nn
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class _ConvBNRelu(nn.Sequential):
+    def __init__(self, in_ch, out_ch, kernel, stride=1, padding=0, groups=1,
+                 relu6=False):
+        super().__init__(
+            nn.Conv2D(in_ch, out_ch, kernel, stride=stride, padding=padding,
+                      groups=groups, bias_attr=False),
+            nn.BatchNorm2D(out_ch),
+            nn.ReLU6() if relu6 else nn.ReLU(),
+        )
+
+
+class _DepthwiseSeparable(nn.Layer):
+    def __init__(self, in_ch, out_ch1, out_ch2, stride, scale):
+        super().__init__()
+        c1 = int(out_ch1 * scale)
+        c2 = int(out_ch2 * scale)
+        self.depthwise = _ConvBNRelu(in_ch, c1, 3, stride=stride, padding=1,
+                                     groups=in_ch)
+        self.pointwise = _ConvBNRelu(c1, c2, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    """mobilenetv1.py MobileNetV1."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        s = lambda c: int(c * scale)
+        self.conv1 = _ConvBNRelu(3, s(32), 3, stride=2, padding=1)
+        cfg = [
+            (s(32), 32, 64, 1), (s(64), 64, 128, 2), (s(128), 128, 128, 1),
+            (s(128), 128, 256, 2), (s(256), 256, 256, 1),
+            (s(256), 256, 512, 2),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1), (s(512), 512, 512, 1),
+            (s(512), 512, 512, 1),
+            (s(512), 512, 1024, 2), (s(1024), 1024, 1024, 1),
+        ]
+        self.blocks = nn.Sequential(*[
+            _DepthwiseSeparable(i, o1, o2, st, scale)
+            for (i, o1, o2, st) in cfg
+        ])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(s(1024), num_classes)
+        self.flatten = nn.Flatten()
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.flatten(x))
+        return x
+
+
+class _InvertedResidual(nn.Layer):
+    def __init__(self, inp, oup, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(inp * expand_ratio))
+        self.use_res = stride == 1 and inp == oup
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNRelu(inp, hidden, 1, relu6=True))
+        layers += [
+            _ConvBNRelu(hidden, hidden, 3, stride=stride, padding=1,
+                        groups=hidden, relu6=True),
+            nn.Conv2D(hidden, oup, 1, bias_attr=False),
+            nn.BatchNorm2D(oup),
+        ]
+        self.conv = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(nn.Layer):
+    """mobilenetv2.py MobileNetV2."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        input_channel = _make_divisible(32 * scale)
+        cfg = [
+            # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
+        ]
+        features = [_ConvBNRelu(3, input_channel, 3, stride=2, padding=1,
+                                relu6=True)]
+        for t, c, n, s in cfg:
+            out_ch = _make_divisible(c * scale)
+            for i in range(n):
+                features.append(_InvertedResidual(
+                    input_channel, out_ch, s if i == 0 else 1, t))
+                input_channel = out_ch
+        self.last_channel = _make_divisible(1280 * max(1.0, scale))
+        features.append(_ConvBNRelu(input_channel, self.last_channel, 1,
+                                    relu6=True))
+        self.features = nn.Sequential(*features)
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Dropout(0.2), nn.Linear(self.last_channel, num_classes))
+        self.flatten = nn.Flatten()
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(self.flatten(x))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this build")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this build")
+    return MobileNetV2(scale=scale, **kwargs)

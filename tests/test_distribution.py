@@ -1,0 +1,221 @@
+"""paddle.distribution parity tests (reference
+python/paddle/fluid/tests/unittests/distribution/)."""
+import numpy as np
+import pytest
+import scipy.stats
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (
+    AffineTransform, Beta, Categorical, ChainTransform, Dirichlet,
+    ExpTransform, Gumbel, Independent, Laplace, LogNormal, Multinomial,
+    Normal, SigmoidTransform, TanhTransform, TransformedDistribution,
+    Uniform, kl_divergence,
+)
+
+
+class TestNormal:
+    def setup_method(self):
+        paddle.seed(0)
+        self.d = Normal(loc=np.array([0.0, 1.0], np.float32),
+                        scale=np.array([1.0, 2.0], np.float32))
+
+    def test_moments(self):
+        np.testing.assert_allclose(self.d.mean.numpy(), [0.0, 1.0])
+        np.testing.assert_allclose(self.d.variance.numpy(), [1.0, 4.0])
+
+    def test_log_prob_matches_scipy(self):
+        v = np.array([0.5, -0.3], np.float32)
+        expect = scipy.stats.norm(loc=[0, 1], scale=[1, 2]).logpdf(v)
+        np.testing.assert_allclose(self.d.log_prob(v).numpy(), expect,
+                                   rtol=1e-5)
+
+    def test_entropy_cdf_icdf(self):
+        expect = scipy.stats.norm(loc=[0, 1], scale=[1, 2]).entropy()
+        np.testing.assert_allclose(self.d.entropy().numpy(), expect,
+                                   rtol=1e-5)
+        v = np.array([0.3, 0.8], np.float32)
+        cdf = self.d.cdf(v).numpy()
+        back = self.d.icdf(paddle.to_tensor(cdf)).numpy()
+        np.testing.assert_allclose(back, v, rtol=1e-4, atol=1e-4)
+
+    def test_sample_stats(self):
+        s = self.d.sample([20000]).numpy()
+        np.testing.assert_allclose(s.mean(0), [0.0, 1.0], atol=0.1)
+        np.testing.assert_allclose(s.std(0), [1.0, 2.0], atol=0.1)
+
+    def test_rsample_grad(self):
+        loc = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+        d = Normal(loc, np.ones(2, np.float32))
+        s = d.rsample([8])
+        s.sum().backward()
+        assert loc.grad is not None
+        np.testing.assert_allclose(loc.grad.numpy(), [8.0, 8.0])
+
+    def test_kl(self):
+        q = Normal(np.zeros(2, np.float32), np.ones(2, np.float32))
+        kl = kl_divergence(self.d, q).numpy()
+        # manual closed form
+        expect = np.log(1.0 / np.array([1, 2.0])) + \
+            (np.array([1.0, 4.0]) + np.array([0.0, 1.0])) / 2.0 - 0.5
+        np.testing.assert_allclose(kl, expect, rtol=1e-5)
+
+
+class TestUniformBetaDirichlet:
+    def test_uniform(self):
+        d = Uniform(0.0, 2.0)
+        np.testing.assert_allclose(d.mean.numpy(), 1.0)
+        np.testing.assert_allclose(d.entropy().numpy(), np.log(2.0))
+        np.testing.assert_allclose(d.log_prob(np.float32(0.7)).numpy(),
+                                   -np.log(2.0), rtol=1e-6)
+        assert d.log_prob(np.float32(2.5)).numpy() == -np.inf
+
+    def test_beta(self):
+        d = Beta(2.0, 3.0)
+        np.testing.assert_allclose(d.mean.numpy(), 0.4, rtol=1e-6)
+        expect = scipy.stats.beta(2, 3).logpdf(0.3)
+        np.testing.assert_allclose(d.log_prob(np.float32(0.3)).numpy(),
+                                   expect, rtol=1e-5)
+        np.testing.assert_allclose(d.entropy().numpy(),
+                                   scipy.stats.beta(2, 3).entropy(),
+                                   rtol=1e-5)
+
+    def test_dirichlet(self):
+        c = np.array([1.0, 2.0, 3.0], np.float32)
+        d = Dirichlet(c)
+        np.testing.assert_allclose(d.mean.numpy(), c / c.sum(), rtol=1e-6)
+        v = np.array([0.2, 0.3, 0.5], np.float32)
+        expect = scipy.stats.dirichlet(c).logpdf(v)
+        np.testing.assert_allclose(d.log_prob(v).numpy(), expect, rtol=1e-5)
+
+    def test_kl_beta(self):
+        p, q = Beta(2.0, 3.0), Beta(4.0, 2.0)
+        # MC check
+        paddle.seed(1)
+        s = p.sample([200000]).numpy().clip(1e-6, 1 - 1e-6)
+        mc = (scipy.stats.beta(2, 3).logpdf(s)
+              - scipy.stats.beta(4, 2).logpdf(s)).mean()
+        np.testing.assert_allclose(kl_divergence(p, q).numpy(), mc,
+                                   rtol=0.05)
+
+
+class TestCategoricalMultinomial:
+    def test_categorical(self):
+        w = np.array([1.0, 2.0, 3.0], np.float32)
+        d = Categorical(w)
+        v = np.array([0, 2], np.int64)
+        np.testing.assert_allclose(d.log_prob(v).numpy(),
+                                   np.log(w[[0, 2]] / w.sum()), rtol=1e-6)
+        ent = -(w / w.sum() * np.log(w / w.sum())).sum()
+        np.testing.assert_allclose(d.entropy().numpy(), ent, rtol=1e-5)
+        paddle.seed(0)
+        s = d.sample([30000]).numpy()
+        freqs = np.bincount(s, minlength=3) / 30000.0
+        np.testing.assert_allclose(freqs, w / w.sum(), atol=0.02)
+
+    def test_categorical_kl(self):
+        p = Categorical(np.array([1.0, 1.0], np.float32))
+        q = Categorical(np.array([1.0, 3.0], np.float32))
+        pk, qk = np.array([0.5, 0.5]), np.array([0.25, 0.75])
+        expect = (pk * np.log(pk / qk)).sum()
+        np.testing.assert_allclose(kl_divergence(p, q).numpy(), expect,
+                                   rtol=1e-5)
+
+    def test_multinomial(self):
+        p = np.array([0.2, 0.3, 0.5], np.float32)
+        d = Multinomial(10, p)
+        np.testing.assert_allclose(d.mean.numpy(), 10 * p, rtol=1e-6)
+        v = np.array([2.0, 3.0, 5.0], np.float32)
+        expect = scipy.stats.multinomial(10, p).logpmf(v)
+        np.testing.assert_allclose(d.log_prob(v).numpy(), expect, rtol=1e-4)
+        paddle.seed(0)
+        s = d.sample([2000]).numpy()
+        assert s.shape == (2000, 3)
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+        np.testing.assert_allclose(s.mean(0), 10 * p, atol=0.2)
+
+
+class TestOtherDistributions:
+    def test_laplace(self):
+        d = Laplace(0.0, 1.0)
+        expect = scipy.stats.laplace.logpdf(0.5)
+        np.testing.assert_allclose(d.log_prob(np.float32(0.5)).numpy(),
+                                   expect, rtol=1e-5)
+        np.testing.assert_allclose(d.entropy().numpy(),
+                                   scipy.stats.laplace.entropy(), rtol=1e-5)
+        v = d.cdf(np.float32(0.3)).numpy()
+        np.testing.assert_allclose(
+            d.icdf(paddle.to_tensor(v)).numpy(), 0.3, rtol=1e-4)
+
+    def test_lognormal(self):
+        d = LogNormal(0.0, 0.5)
+        expect = scipy.stats.lognorm(s=0.5).logpdf(1.2)
+        np.testing.assert_allclose(d.log_prob(np.float32(1.2)).numpy(),
+                                   expect, rtol=1e-5)
+        np.testing.assert_allclose(d.mean.numpy(), np.exp(0.125), rtol=1e-5)
+
+    def test_gumbel(self):
+        d = Gumbel(1.0, 2.0)
+        expect = scipy.stats.gumbel_r(loc=1, scale=2).logpdf(0.5)
+        np.testing.assert_allclose(d.log_prob(np.float32(0.5)).numpy(),
+                                   expect, rtol=1e-5)
+        np.testing.assert_allclose(
+            d.mean.numpy(), scipy.stats.gumbel_r(loc=1, scale=2).mean(),
+            rtol=1e-5)
+
+    def test_independent(self):
+        base = Normal(np.zeros((3, 2), np.float32),
+                      np.ones((3, 2), np.float32))
+        d = Independent(base, 1)
+        assert d.batch_shape == (3,)
+        assert d.event_shape == (2,)
+        v = np.zeros((3, 2), np.float32)
+        np.testing.assert_allclose(d.log_prob(v).numpy(),
+                                   base.log_prob(v).numpy().sum(-1),
+                                   rtol=1e-6)
+
+
+class TestTransforms:
+    def test_affine(self):
+        t = AffineTransform(np.float32(1.0), np.float32(2.0))
+        x = np.array([0.5], np.float32)
+        np.testing.assert_allclose(t.forward(x).numpy(), [2.0])
+        np.testing.assert_allclose(
+            t.inverse(t.forward(x)).numpy(), x, rtol=1e-6)
+        np.testing.assert_allclose(t.forward_log_det_jacobian(x).numpy(),
+                                   [np.log(2.0)], rtol=1e-6)
+
+    def test_exp_tanh_sigmoid_roundtrip(self):
+        x = np.array([0.3, -0.7], np.float32)
+        for t in [ExpTransform(), TanhTransform(), SigmoidTransform()]:
+            y = t.forward(x)
+            np.testing.assert_allclose(t.inverse(y).numpy(), x, rtol=1e-4,
+                                       atol=1e-5)
+            # fldj consistency with autodiff
+            import jax
+            import jax.numpy as jnp
+
+            num = np.log(np.abs(jax.vmap(jax.grad(
+                lambda z: t._forward(z)))(jnp.asarray(x))))
+            np.testing.assert_allclose(
+                t.forward_log_det_jacobian(x).numpy(), num, rtol=1e-4)
+
+    def test_chain(self):
+        t = ChainTransform([AffineTransform(np.float32(0.0),
+                                            np.float32(2.0)),
+                            ExpTransform()])
+        x = np.array([0.1], np.float32)
+        np.testing.assert_allclose(t.forward(x).numpy(), np.exp(2 * 0.1),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(t.inverse(t.forward(x)).numpy(), x,
+                                   rtol=1e-6)
+
+    def test_transformed_distribution_lognormal(self):
+        d = TransformedDistribution(Normal(np.float32(0.0), np.float32(0.5)),
+                                    [ExpTransform()])
+        ref = LogNormal(0.0, 0.5)
+        v = np.float32(1.5)
+        np.testing.assert_allclose(d.log_prob(v).numpy(),
+                                   ref.log_prob(v).numpy(), rtol=1e-5)
+        paddle.seed(0)
+        s = d.sample([1000]).numpy()
+        assert (s > 0).all()

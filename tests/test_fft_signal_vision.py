@@ -1,0 +1,137 @@
+"""fft / signal / linalg namespaces + new vision models."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestFFT:
+    def test_fft_roundtrip(self):
+        x = np.random.default_rng(0).normal(size=16).astype(np.float32)
+        y = paddle.fft.fft(paddle.to_tensor(x))
+        back = paddle.fft.ifft(y)
+        np.testing.assert_allclose(back.numpy().real, x, atol=1e-5)
+
+    def test_rfft_matches_numpy(self):
+        x = np.random.default_rng(1).normal(size=32).astype(np.float32)
+        y = paddle.fft.rfft(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y, np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+
+    def test_fft2_and_shift(self):
+        x = np.random.default_rng(2).normal(size=(8, 8)).astype(np.float32)
+        y = paddle.fft.fft2(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(y, np.fft.fft2(x), rtol=1e-4, atol=1e-4)
+        s = paddle.fft.fftshift(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(s, np.fft.fftshift(x))
+
+    def test_fftfreq(self):
+        np.testing.assert_allclose(paddle.fft.fftfreq(8, 0.5).numpy(),
+                                   np.fft.fftfreq(8, 0.5))
+
+
+class TestSignal:
+    def test_frame(self):
+        x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+        y = paddle.signal.frame(x, frame_length=4, hop_length=2)
+        assert y.shape == [4, 3]
+        np.testing.assert_allclose(y.numpy()[:, 0], [0, 1, 2, 3])
+        np.testing.assert_allclose(y.numpy()[:, 1], [2, 3, 4, 5])
+
+    def test_overlap_add_inverts_frame_sum(self):
+        x = np.arange(8, dtype=np.float32)
+        framed = paddle.signal.frame(paddle.to_tensor(x), 4, 4)  # no overlap
+        back = paddle.signal.overlap_add(framed, hop_length=4)
+        np.testing.assert_allclose(back.numpy(), x)
+
+    def test_stft_istft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=512).astype(np.float32)
+        spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=64)
+        assert spec.shape[0] == 33  # onesided bins
+        back = paddle.signal.istft(spec, n_fft=64, length=512)
+        np.testing.assert_allclose(back.numpy(), x, atol=1e-4)
+
+    def test_stft_matches_scipy(self):
+        import scipy.signal as ss
+
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=256).astype(np.float64)
+        n_fft, hop = 32, 8
+        win = np.hanning(n_fft).astype(np.float64)
+        spec = paddle.signal.stft(
+            paddle.to_tensor(x), n_fft=n_fft, hop_length=hop,
+            window=paddle.to_tensor(win), center=False).numpy()
+        _, _, ref = ss.stft(x, window=win, nperseg=n_fft, noverlap=n_fft -
+                            hop, boundary=None, padded=False)
+        # scipy normalizes by win.sum(); ours is raw — rescale
+        np.testing.assert_allclose(spec, ref * win.sum(), rtol=1e-6,
+                                   atol=1e-8)
+
+
+class TestLinalgNamespace:
+    def test_namespace_ops(self):
+        a = np.array([[2.0, 0.0], [0.0, 3.0]], np.float32)
+        t = paddle.to_tensor(a)
+        np.testing.assert_allclose(paddle.linalg.det(t).numpy(), 6.0,
+                                   rtol=1e-6)
+        np.testing.assert_allclose(
+            paddle.linalg.matmul(t, t).numpy(), a @ a)
+        L = paddle.linalg.cholesky(t).numpy()
+        np.testing.assert_allclose(L @ L.T, a, rtol=1e-5)
+
+
+class TestVisionModels:
+    def _check(self, model, in_shape, n_out):
+        x = paddle.randn(in_shape)
+        with paddle.no_grad():
+            y = model(x)
+        assert y.shape == [in_shape[0], n_out]
+
+    def test_lenet(self):
+        from paddle_tpu.vision.models import LeNet
+
+        self._check(LeNet(num_classes=10), [2, 1, 28, 28], 10)
+
+    def test_alexnet(self):
+        from paddle_tpu.vision.models import alexnet
+
+        self._check(alexnet(num_classes=10), [1, 3, 224, 224], 10)
+
+    def test_vgg11(self):
+        from paddle_tpu.vision.models import vgg11
+
+        self._check(vgg11(num_classes=7), [1, 3, 64, 64], 7)
+
+    def test_mobilenet_v1(self):
+        from paddle_tpu.vision.models import mobilenet_v1
+
+        self._check(mobilenet_v1(num_classes=5), [1, 3, 64, 64], 5)
+
+    def test_mobilenet_v2(self):
+        from paddle_tpu.vision.models import mobilenet_v2
+
+        self._check(mobilenet_v2(num_classes=5), [1, 3, 64, 64], 5)
+
+    def test_squeezenet(self):
+        from paddle_tpu.vision.models import squeezenet1_1
+
+        self._check(squeezenet1_1(num_classes=4), [1, 3, 64, 64], 4)
+
+    def test_train_step_lenet(self):
+        from paddle_tpu.vision.models import LeNet
+
+        paddle.seed(0)
+        model = LeNet(num_classes=10)
+        opt = paddle.optimizer.Adam(1e-3, parameters=model.parameters())
+        x = paddle.randn([4, 1, 28, 28])
+        labels = paddle.to_tensor(np.array([1, 2, 3, 4], np.int64))
+        losses = []
+        for _ in range(3):
+            logits = model(x)
+            loss = paddle.nn.functional.cross_entropy(
+                logits, labels).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]

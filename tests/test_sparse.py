@@ -1,0 +1,195 @@
+"""paddle.sparse parity tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo3x4():
+    # [[0, 1, 0, 2],
+    #  [0, 0, 3, 0],
+    #  [4, 0, 0, 0]]
+    indices = np.array([[0, 0, 1, 2], [1, 3, 2, 0]], np.int64)
+    values = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+    return sparse.sparse_coo_tensor(indices, values, [3, 4])
+
+
+def _dense3x4():
+    d = np.zeros((3, 4), np.float32)
+    d[0, 1], d[0, 3], d[1, 2], d[2, 0] = 1, 2, 3, 4
+    return d
+
+
+class TestCreation:
+    def test_coo_roundtrip(self):
+        s = _coo3x4()
+        assert s.shape == [3, 4]
+        assert s.nnz == 4
+        np.testing.assert_allclose(s.to_dense().numpy(), _dense3x4())
+        np.testing.assert_allclose(s.values().numpy(), [1, 2, 3, 4])
+        assert s.indices().shape == [2, 4]
+
+    def test_csr_roundtrip(self):
+        crows = np.array([0, 2, 3, 4], np.int64)
+        cols = np.array([1, 3, 2, 0], np.int64)
+        vals = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 4])
+        np.testing.assert_allclose(s.to_dense().numpy(), _dense3x4())
+        np.testing.assert_allclose(s.crows().numpy(), crows)
+
+    def test_coo_csr_convert(self):
+        s = _coo3x4()
+        csr = s.to_sparse_csr()
+        assert csr.is_sparse_csr()
+        np.testing.assert_allclose(csr.to_dense().numpy(), _dense3x4())
+        back = csr.to_sparse_coo()
+        np.testing.assert_allclose(back.to_dense().numpy(), _dense3x4())
+
+    def test_infer_shape(self):
+        s = sparse.sparse_coo_tensor(
+            np.array([[0, 2], [1, 0]]), np.array([5.0, 6.0], np.float32))
+        assert s.shape == [3, 2]
+
+
+class TestUnary:
+    def test_elementwise_value_ops(self):
+        s = _coo3x4()
+        d = _dense3x4()
+        np.testing.assert_allclose(sparse.sin(s).to_dense().numpy(),
+                                   np.sin(d), rtol=1e-6)
+        np.testing.assert_allclose(sparse.sqrt(s).to_dense().numpy(),
+                                   np.sqrt(d), rtol=1e-6)
+        np.testing.assert_allclose(sparse.square(s).to_dense().numpy(),
+                                   d * d, rtol=1e-6)
+        np.testing.assert_allclose(sparse.neg(s).to_dense().numpy(), -d)
+        np.testing.assert_allclose(sparse.pow(s, 3).to_dense().numpy(),
+                                   d ** 3, rtol=1e-6)
+
+    def test_transpose_reshape(self):
+        s = _coo3x4()
+        d = _dense3x4()
+        np.testing.assert_allclose(
+            sparse.transpose(s, [1, 0]).to_dense().numpy(), d.T)
+        np.testing.assert_allclose(
+            sparse.reshape(s, [4, 3]).to_dense().numpy(), d.reshape(4, 3))
+
+    def test_cast(self):
+        s = sparse.cast(_coo3x4(), value_dtype="float64")
+        assert "float64" in repr(s)
+
+    def test_sum(self):
+        s = _coo3x4()
+        d = _dense3x4()
+        np.testing.assert_allclose(sparse.sum(s).numpy(), d.sum())
+
+    def test_csr_unary(self):
+        s = _coo3x4().to_sparse_csr()
+        out = sparse.abs(s)
+        assert out.is_sparse_csr()
+        np.testing.assert_allclose(out.to_dense().numpy(),
+                                   np.abs(_dense3x4()))
+
+
+class TestBinary:
+    def test_add_subtract(self):
+        a, b = _coo3x4(), _coo3x4()
+        d = _dense3x4()
+        np.testing.assert_allclose(sparse.add(a, b).to_dense().numpy(),
+                                   2 * d)
+        np.testing.assert_allclose(
+            sparse.subtract(a, b).to_dense().numpy(), 0 * d)
+
+    def test_multiply_divide(self):
+        a, b = _coo3x4(), _coo3x4()
+        d = _dense3x4()
+        np.testing.assert_allclose(
+            sparse.multiply(a, b).to_dense().numpy(), d * d)
+        div = sparse.divide(a, b).values().numpy()
+        np.testing.assert_allclose(div, np.ones(4))
+
+    def test_matmul_spmm(self):
+        s = _coo3x4()
+        d = _dense3x4()
+        y = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+        np.testing.assert_allclose(sparse.matmul(s, y).numpy(), d @ y,
+                                   rtol=1e-5)
+
+    def test_matmul_csr(self):
+        s = _coo3x4().to_sparse_csr()
+        y = np.random.default_rng(0).normal(size=(4, 2)).astype(np.float32)
+        np.testing.assert_allclose(sparse.matmul(s, y).numpy(),
+                                   _dense3x4() @ y, rtol=1e-5)
+
+    def test_mv(self):
+        s = _coo3x4()
+        v = np.arange(4, dtype=np.float32)
+        np.testing.assert_allclose(sparse.mv(s, v).numpy(),
+                                   _dense3x4() @ v, rtol=1e-6)
+
+    def test_masked_matmul(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(3, 6)).astype(np.float32)
+        y = rng.normal(size=(6, 4)).astype(np.float32)
+        mask = _coo3x4()
+        out = sparse.masked_matmul(x, y, mask)
+        full = x @ y
+        expect = np.where(_dense3x4() != 0, full, 0.0)
+        np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-5)
+
+    def test_addmm(self):
+        rng = np.random.default_rng(1)
+        inp = rng.normal(size=(3, 2)).astype(np.float32)
+        y = rng.normal(size=(4, 2)).astype(np.float32)
+        out = sparse.addmm(paddle.to_tensor(inp), _coo3x4(),
+                           paddle.to_tensor(y), beta=0.5, alpha=2.0)
+        np.testing.assert_allclose(out.numpy(),
+                                   0.5 * inp + 2.0 * (_dense3x4() @ y),
+                                   rtol=1e-5)
+
+
+class TestSparseNN:
+    def test_relu(self):
+        idx = np.array([[0, 1], [0, 1]])
+        vals = np.array([-1.0, 2.0], np.float32)
+        s = sparse.sparse_coo_tensor(idx, vals, [2, 2])
+        out = sparse.nn.functional.relu(s)
+        np.testing.assert_allclose(out.values().numpy(), [0.0, 2.0])
+
+    def test_softmax(self):
+        s = _coo3x4()
+        out = sparse.nn.functional.softmax(s)
+        d = out.to_dense().numpy()
+        # each row's nnz entries sum to 1
+        np.testing.assert_allclose(d[0].sum(), 1.0, rtol=1e-6)
+        np.testing.assert_allclose(d[1, 2], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(d[2, 0], 1.0, rtol=1e-6)
+
+    def test_softmax_3d(self):
+        # batched scores [B, R, C]: every (b, r) row must normalize alone
+        idx = np.array([[0, 0, 0, 1, 1], [0, 0, 1, 0, 0],
+                        [0, 1, 0, 1, 2]])
+        vals = np.array([1.0, 2.0, 5.0, 3.0, 3.0], np.float32)
+        s = sparse.sparse_coo_tensor(idx, vals, [2, 2, 3])
+        d = sparse.nn.functional.softmax(s).to_dense().numpy()
+        e = np.exp([1.0, 2.0])
+        np.testing.assert_allclose(d[0, 0, :2], e / e.sum(), rtol=1e-6)
+        np.testing.assert_allclose(d[0, 1, 0], 1.0, rtol=1e-6)
+        np.testing.assert_allclose(d[1, 0, 1], 0.5, rtol=1e-6)
+        np.testing.assert_allclose(d[1, 0, 2], 0.5, rtol=1e-6)
+
+    def test_sparse_attention(self):
+        rng = np.random.default_rng(0)
+        q = rng.normal(size=(3, 8)).astype(np.float32)
+        k = rng.normal(size=(3, 8)).astype(np.float32)
+        v = rng.normal(size=(3, 8)).astype(np.float32)
+        # full mask → equals dense attention
+        idx = np.array([[i, j] for i in range(3) for j in range(3)]).T
+        mask = sparse.sparse_coo_tensor(idx, np.ones(9, np.float32), [3, 3])
+        out = sparse.nn.functional.attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            mask).numpy()
+        scores = (q / np.sqrt(8)) @ k.T
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        np.testing.assert_allclose(out, probs @ v, rtol=1e-4)
