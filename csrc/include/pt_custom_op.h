@@ -1,0 +1,42 @@
+/* Custom-op C ABI (reference analog: paddle/phi/api/ext/op_meta_info.h
+ * PD_BUILD_OP).  A custom op is an extern "C" function:
+ *
+ *     PT_EXPORT void my_op(const PTTensor* ins, int32_t n_in,
+ *                          PTMutableTensor* outs, int32_t n_out);
+ *
+ * The TPU runtime invokes it on host buffers via jax.pure_callback, so the
+ * same .so serves eager, jit and shard_map execution. dtype codes follow
+ * numpy kind ordering (see paddle_tpu/utils/cpp_extension/extension_utils.py).
+ */
+#ifndef PT_CUSTOM_OP_H_
+#define PT_CUSTOM_OP_H_
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+#else
+#define PT_EXPORT __attribute__((visibility("default")))
+#endif
+
+typedef struct {
+  const void* data;
+  const int64_t* dims;
+  int32_t ndim;
+  int32_t dtype; /* 0=f32 1=f64 2=i32 3=i64 4=u8 5=bool */
+} PTTensor;
+
+typedef struct {
+  void* data;
+  const int64_t* dims;
+  int32_t ndim;
+  int32_t dtype;
+} PTMutableTensor;
+
+static inline int64_t pt_numel(const int64_t* dims, int32_t ndim) {
+  int64_t n = 1;
+  for (int32_t i = 0; i < ndim; ++i) n *= dims[i];
+  return n;
+}
+
+#endif /* PT_CUSTOM_OP_H_ */

@@ -1,0 +1,186 @@
+// Shared-memory ring buffer for DataLoader worker→parent batch transport.
+//
+// Reference analog: paddle/fluid/memory/allocation/mmap_allocator.cc +
+// fluid/dataloader shared-memory tensor transport (SURVEY §2.7
+// "Multiprocessing helper"). Worker processes pickle batches into ring
+// slots; the parent consumes them zero-copy-ish (one memcpy out of shm).
+//
+// Concurrency: multi-producer / single-consumer. POSIX shm + process-shared
+// semaphores; a process-shared mutex serializes producers claiming slots.
+//
+// Build: make -C csrc  (emits paddle_tpu/lib/libshmring.so)
+
+#include <errno.h>
+#include <fcntl.h>
+#include <pthread.h>
+#include <semaphore.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+#define PT_EXPORT extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+struct Header {
+  uint64_t n_slots;
+  uint64_t slot_size;  // payload capacity per slot
+  uint64_t write_idx;  // next slot to fill (producers, under mutex)
+  uint64_t read_idx;   // next slot to drain (single consumer)
+  pthread_mutex_t mu;
+  sem_t free_slots;
+  sem_t filled_slots;
+};
+
+struct Slot {
+  uint64_t len;
+  uint64_t tag;
+  // payload follows
+};
+
+struct Handle {
+  Header* hdr;
+  uint8_t* base;   // mapped region
+  size_t map_len;
+  char name[256];
+  int owner;
+};
+
+inline Slot* slot_at(Handle* h, uint64_t i) {
+  size_t stride = sizeof(Slot) + h->hdr->slot_size;
+  return reinterpret_cast<Slot*>(
+      h->base + sizeof(Header) + i * stride);
+}
+
+}  // namespace
+
+PT_EXPORT void* ptshm_create(const char* name, uint64_t n_slots,
+                             uint64_t slot_size) {
+  shm_unlink(name);
+  int fd = shm_open(name, O_CREAT | O_RDWR | O_EXCL, 0600);
+  if (fd < 0) return nullptr;
+  size_t map_len = sizeof(Header) + n_slots * (sizeof(Slot) + slot_size);
+  if (ftruncate(fd, (off_t)map_len) != 0) {
+    close(fd);
+    shm_unlink(name);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, map_len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                   fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Header* hdr = reinterpret_cast<Header*>(mem);
+  hdr->n_slots = n_slots;
+  hdr->slot_size = slot_size;
+  hdr->write_idx = 0;
+  hdr->read_idx = 0;
+  pthread_mutexattr_t ma;
+  pthread_mutexattr_init(&ma);
+  pthread_mutexattr_setpshared(&ma, PTHREAD_PROCESS_SHARED);
+  pthread_mutex_init(&hdr->mu, &ma);
+  sem_init(&hdr->free_slots, 1, (unsigned)n_slots);
+  sem_init(&hdr->filled_slots, 1, 0);
+  Handle* h = new Handle();
+  h->hdr = hdr;
+  h->base = reinterpret_cast<uint8_t*>(mem);
+  h->map_len = map_len;
+  snprintf(h->name, sizeof(h->name), "%s", name);
+  h->owner = 1;
+  return h;
+}
+
+PT_EXPORT void* ptshm_open(const char* name) {
+  int fd = shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  void* mem = mmap(nullptr, (size_t)st.st_size, PROT_READ | PROT_WRITE,
+                   MAP_SHARED, fd, 0);
+  close(fd);
+  if (mem == MAP_FAILED) return nullptr;
+  Handle* h = new Handle();
+  h->hdr = reinterpret_cast<Header*>(mem);
+  h->base = reinterpret_cast<uint8_t*>(mem);
+  h->map_len = (size_t)st.st_size;
+  snprintf(h->name, sizeof(h->name), "%s", name);
+  h->owner = 0;
+  return h;
+}
+
+// Blocks until a slot frees up. Returns 0 ok, -1 payload too large.
+PT_EXPORT int ptshm_write(void* vh, const void* data, uint64_t len,
+                          uint64_t tag) {
+  Handle* h = reinterpret_cast<Handle*>(vh);
+  if (len > h->hdr->slot_size) return -1;
+  int rc;
+  while ((rc = sem_wait(&h->hdr->free_slots)) != 0 && errno == EINTR) {
+  }
+  if (rc != 0) return -3;  // must NOT claim a slot we didn't acquire
+  pthread_mutex_lock(&h->hdr->mu);
+  uint64_t idx = h->hdr->write_idx % h->hdr->n_slots;
+  h->hdr->write_idx++;
+  Slot* s = slot_at(h, idx);
+  s->len = len;
+  s->tag = tag;
+  memcpy(reinterpret_cast<uint8_t*>(s) + sizeof(Slot), data, len);
+  pthread_mutex_unlock(&h->hdr->mu);
+  sem_post(&h->hdr->filled_slots);
+  return 0;
+}
+
+// Blocks until a message arrives; copies payload into out (cap bytes).
+// Returns payload length, sets *tag. Returns -1 if cap too small (message
+// is NOT consumed), -2 on timeout (ms >= 0).
+PT_EXPORT int64_t ptshm_read(void* vh, void* out, uint64_t cap,
+                             uint64_t* tag, int64_t timeout_ms) {
+  Handle* h = reinterpret_cast<Handle*>(vh);
+  if (timeout_ms >= 0) {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    ts.tv_sec += timeout_ms / 1000;
+    ts.tv_nsec += (timeout_ms % 1000) * 1000000L;
+    if (ts.tv_nsec >= 1000000000L) {
+      ts.tv_sec += 1;
+      ts.tv_nsec -= 1000000000L;
+    }
+    int rc;
+    while ((rc = sem_timedwait(&h->hdr->filled_slots, &ts)) != 0 &&
+           errno == EINTR) {
+    }
+    if (rc != 0) return errno == ETIMEDOUT ? -2 : -3;
+  } else {
+    int rc;
+    while ((rc = sem_wait(&h->hdr->filled_slots)) != 0 && errno == EINTR) {
+    }
+    if (rc != 0) return -3;
+  }
+  uint64_t idx = h->hdr->read_idx % h->hdr->n_slots;
+  Slot* s = slot_at(h, idx);
+  if (s->len > cap) {
+    sem_post(&h->hdr->filled_slots);  // put it back
+    return -1;
+  }
+  int64_t len = (int64_t)s->len;
+  if (tag) *tag = s->tag;
+  memcpy(out, reinterpret_cast<uint8_t*>(s) + sizeof(Slot), (size_t)len);
+  h->hdr->read_idx++;
+  sem_post(&h->hdr->free_slots);
+  return len;
+}
+
+PT_EXPORT uint64_t ptshm_slot_size(void* vh) {
+  return reinterpret_cast<Handle*>(vh)->hdr->slot_size;
+}
+
+PT_EXPORT void ptshm_close(void* vh) {
+  Handle* h = reinterpret_cast<Handle*>(vh);
+  munmap(h->base, h->map_len);
+  if (h->owner) shm_unlink(h->name);
+  delete h;
+}

@@ -12,6 +12,8 @@ from .collective import (Group, ReduceOp, all_gather, all_reduce,  # noqa: F401
                          new_group, reduce, reduce_scatter, scatter, send,
                          recv, wait, get_global_mesh, set_global_mesh)
 from .parallel import DataParallel  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from . import collective  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401

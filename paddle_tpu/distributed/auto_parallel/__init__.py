@@ -1,0 +1,22 @@
+"""paddle_tpu.distributed.auto_parallel — semi-automatic distributed.
+
+Reference: `python/paddle/distributed/auto_parallel/` — ProcessMesh/DistAttr
+annotations (`process_mesh.py:45`, `interface.py:28` shard_tensor), a
+1900-line completion pass, Partitioner (`partitioner.py:549`) and Reshard
+that rewrite the serial program per rank, and an `Engine` (`engine.py:119`)
+fit/evaluate/predict facade.
+
+TPU re-design: annotation → GSPMD. `shard_tensor` lowers a shard_spec
+directly to a `jax.sharding.NamedSharding` (device_put outside jit,
+`with_sharding_constraint` inside); the completion/partition/reshard
+machinery is XLA's sharding propagation — we keep the user API and delete
+~40k LoC of machinery. The Engine compiles one SPMD train step via jit and
+lets GSPMD place collectives over ICI.
+"""
+from .process_mesh import ProcessMesh, get_current_process_mesh  # noqa: F401
+from .interface import shard_tensor, shard_op  # noqa: F401
+from .engine import Engine  # noqa: F401
+from .strategy import Strategy  # noqa: F401
+
+__all__ = ["ProcessMesh", "get_current_process_mesh", "shard_tensor",
+           "shard_op", "Engine", "Strategy"]

@@ -1,0 +1,64 @@
+"""shard_tensor / shard_op (reference `auto_parallel/interface.py:28,108`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...core.tensor import Tensor
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+
+def _to_pspec(shard_spec):
+    if shard_spec is None:
+        return P()
+    return P(*[s if s is not None else None for s in shard_spec])
+
+
+def shard_tensor(x, process_mesh=None, shard_spec=None):
+    """Annotate a tensor with a mesh sharding (interface.py:28). Dimension i
+    of `x` is split over mesh axis `shard_spec[i]` (None = replicated).
+
+    Outside jit this physically reshards (device_put); inside a trace it
+    becomes a GSPMD sharding constraint — the TPU equivalent of writing the
+    dist_attr that the reference's completion pass would propagate."""
+    mesh = process_mesh or get_current_process_mesh()
+    if mesh is None:
+        raise ValueError("no process_mesh given and none is active")
+    spec = _to_pspec(shard_spec)
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    if isinstance(arr, jax.core.Tracer):
+        out = jax.lax.with_sharding_constraint(
+            arr, NamedSharding(mesh.jax_mesh, spec))
+    else:
+        out = jax.device_put(arr, NamedSharding(mesh.jax_mesh, spec))
+    if isinstance(x, Tensor):
+        x._data = out
+        x.process_mesh = mesh
+        x.shard_spec = list(shard_spec) if shard_spec else None
+        return x
+    return Tensor(out)
+
+
+def shard_op(op, process_mesh=None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Annotate a callable's inputs/outputs with shardings
+    (interface.py:108). Returns a wrapped callable."""
+    mesh = process_mesh or get_current_process_mesh()
+
+    def wrapped(*args, **kwargs):
+        if in_shard_specs is not None:
+            args = tuple(
+                shard_tensor(a, mesh, s) if isinstance(a, Tensor) else a
+                for a, s in zip(args, in_shard_specs))
+        out = op(*args, **kwargs)
+        if out_shard_specs is not None:
+            if isinstance(out, (tuple, list)):
+                out = type(out)(
+                    shard_tensor(o, mesh, s)
+                    for o, s in zip(out, out_shard_specs))
+            else:
+                out = shard_tensor(out, mesh, out_shard_specs[0])
+        return out
+
+    return wrapped

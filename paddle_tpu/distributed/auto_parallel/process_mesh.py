@@ -1,0 +1,75 @@
+"""ProcessMesh (reference `auto_parallel/process_mesh.py:45,66`)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+_mesh_stack: list["ProcessMesh"] = []
+
+
+class ProcessMesh:
+    """A logical mesh of processes, usable as a context manager (the
+    reference's `with ProcessMesh(...)` annotation scope). Backed by a
+    `jax.sharding.Mesh` over the matching devices."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is not None:
+            arr = np.asarray(mesh)
+            self._shape = list(arr.shape)
+            self._process_ids = arr.flatten().tolist()
+        else:
+            self._shape = list(shape)
+            self._process_ids = list(process_ids)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(len(self._shape))]
+        self._dim_names = list(dim_names)
+        devs = np.array(jax.devices())
+        n = len(self._process_ids)
+        if n > devs.size:
+            raise ValueError(
+                f"mesh needs {n} devices, only {devs.size} present")
+        sel = devs[np.asarray(self._process_ids)]
+        self.jax_mesh = Mesh(sel.reshape(self._shape),
+                             tuple(self._dim_names))
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def dim_names(self):
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self):
+        return list(self._process_ids)
+
+    @property
+    def ndim(self):
+        return len(self._shape)
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def __enter__(self):
+        _mesh_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _mesh_stack.pop()
+        return False
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids)
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+
+def get_current_process_mesh():
+    return _mesh_stack[-1] if _mesh_stack else None

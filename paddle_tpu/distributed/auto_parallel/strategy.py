@@ -1,0 +1,27 @@
+"""Auto-parallel Strategy (reference `auto_parallel/strategy.py` — nested
+config groups; here plain attribute bags with the same names)."""
+from __future__ import annotations
+
+
+class _Config:
+    def __init__(self, **defaults):
+        self.__dict__.update(defaults)
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.__dict__})"
+
+
+class Strategy:
+    def __init__(self, config=None):
+        self.auto_mode = "semi"
+        self.seed = None
+        self.amp = _Config(enable=False, dtype="bfloat16", level="O1")
+        self.recompute = _Config(enable=False, checkpoints=None)
+        self.sharding = _Config(enable=False, stage=1, degree=1)
+        self.gradient_merge = _Config(enable=False, k_steps=1, avg=True)
+        self.pipeline = _Config(enable=False, schedule_mode="1F1B",
+                                micro_batch_size=1, accumulate_steps=1)
+        self.fused_passes = _Config(enable=False, fused_passes_list=[])
+        if config:
+            for k, v in config.items():
+                setattr(self, k, v)

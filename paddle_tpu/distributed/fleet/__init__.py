@@ -8,6 +8,7 @@ HybridCommunicateGroup(:432); `distributed_model` (fleet/model.py:134);
 from __future__ import annotations
 
 from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .. import auto_parallel as auto  # noqa: F401  (fleet.auto namespace)
 from .hybrid_engine import HybridParallelEngine  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from . import utils  # noqa: F401

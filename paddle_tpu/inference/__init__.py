@@ -1,0 +1,210 @@
+"""paddle_tpu.inference — deployment API.
+
+Reference: `python/paddle/inference/` binding AnalysisPredictor
+(`paddle/fluid/inference/api/analysis_predictor.cc:256`): Config →
+create_predictor → zero-copy input/output handles → Run.
+
+TPU re-design: the "analysis + IR pass pipeline + engine subgraphs" stage
+collapses into XLA — the artifact produced by `paddle.jit.save` /
+`paddle.static.save_inference_model` is already StableHLO, so the predictor
+deserializes it (jax.export), uploads params once, and every `run()` is one
+device executable call. Batch dims are symbolic in the artifact, so one
+predictor serves any batch size without recompiling Python-side.
+"""
+from __future__ import annotations
+
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["Config", "Predictor", "Tensor", "create_predictor",
+           "PrecisionType", "PlaceType", "get_version"]
+
+
+def get_version():
+    from .. import __version__
+
+    return __version__
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 2
+    CUSTOM = 3
+
+
+class Config:
+    """`paddle.inference.Config` (reference AnalysisConfig).
+
+    Accepts `Config(prog_file, params_file)` or `Config(model_dir)` where
+    the dir/prefix points at the `.pdmodel`/`.pdiparams` pair."""
+
+    def __init__(self, prog_file=None, params_file=None):
+        if prog_file is not None:
+            self._prefix = prog_file[:-8] if prog_file.endswith(".pdmodel") \
+                else prog_file
+        else:
+            self._prefix = None
+        self._params_path = params_file  # None → <prefix>.pdiparams
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._ir_optim = True  # XLA always optimizes; kept for API parity
+        self._precision = PrecisionType.Float32
+
+    # -- device selection (reference enable_use_gpu etc.) --------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
+                       precision=PrecisionType.Float32):
+        self._device, self._device_id = "tpu", device_id  # best device wins
+        self._precision = precision
+
+    def enable_tpu(self, device_id=0):
+        self._device, self._device_id = "tpu", device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def set_cpu_math_library_num_threads(self, n):
+        pass
+
+    def use_gpu(self):
+        return self._device == "tpu"
+
+    # -- graph optim toggles (XLA owns these; parity no-ops) -----------------
+    def switch_ir_optim(self, flag=True):
+        self._ir_optim = flag
+
+    def ir_optim(self):
+        return self._ir_optim
+
+    def enable_memory_optim(self):
+        self._enable_memory_optim = True
+
+    def switch_use_feed_fetch_ops(self, flag):
+        pass
+
+    def switch_specify_input_names(self, flag=True):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        # TensorRT subgraphs have no TPU analog — XLA compiles the whole
+        # graph; accept and ignore for API compatibility.
+        pass
+
+    def set_model(self, prog_file, params_file=None):
+        self._prefix = prog_file[:-8] if prog_file.endswith(".pdmodel") \
+            else prog_file
+        self._params_path = params_file
+
+    def model_dir(self):
+        return self._prefix
+
+    def summary(self):
+        return (f"Config(prefix={self._prefix}, device={self._device}, "
+                f"ir_optim={self._ir_optim})")
+
+
+class Tensor:
+    """Zero-copy style I/O handle (reference ZeroCopyTensor)."""
+
+    def __init__(self, name, predictor, is_input):
+        self._name = name
+        self._pred = predictor
+        self._is_input = is_input
+
+    def name(self):
+        return self._name
+
+    def copy_from_cpu(self, data):
+        if not self._is_input:
+            raise RuntimeError("copy_from_cpu on an output handle")
+        self._pred._inputs[self._name] = np.asarray(data)
+
+    def copy_to_cpu(self):
+        if self._is_input:
+            return self._pred._inputs[self._name]
+        return np.asarray(self._pred._outputs[self._name])
+
+    def shape(self):
+        if self._is_input:
+            a = self._pred._inputs.get(self._name)
+        else:
+            a = self._pred._outputs.get(self._name)
+        return list(a.shape) if a is not None else []
+
+    def reshape(self, shape):
+        pass  # shapes flow from copy_from_cpu
+
+
+class Predictor:
+    """`paddle.inference.Predictor` — deserialized StableHLO + params."""
+
+    def __init__(self, config: Config):
+        from jax import export as jax_export
+
+        self._config = config
+        prefix = config._prefix
+        with open(prefix + ".pdmodel", "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        params_path = config._params_path or (prefix + ".pdiparams")
+        with open(params_path, "rb") as f:
+            meta = pickle.load(f)
+        self._params = tuple(jnp.asarray(a) for a in meta["arrays"])
+        n_feeds = len(self._exported.in_avals) - len(self._params)
+        self._feed_names = list(
+            meta.get("feed_names") or [f"x{i}" for i in range(n_feeds)])
+        self._fetch_names = list(
+            meta.get("fetch_names") or [])
+        self._inputs: dict[str, np.ndarray] = {}
+        self._outputs: dict[str, np.ndarray] = {}
+
+    def get_input_names(self):
+        return list(self._feed_names)
+
+    def get_output_names(self):
+        if self._fetch_names:
+            return list(self._fetch_names)
+        return [f"out{i}" for i in range(len(self._exported.out_avals))]
+
+    def get_input_handle(self, name):
+        return Tensor(name, self, is_input=True)
+
+    def get_output_handle(self, name):
+        return Tensor(name, self, is_input=False)
+
+    def run(self, inputs=None):
+        """Reference Predictor.run: execute with the staged inputs. If
+        `inputs` (list of arrays in input-name order) is given, use those —
+        the list form mirrors PaddlePredictor::Run(inputs, &outputs)."""
+        if inputs is not None:
+            for n, a in zip(self._feed_names, inputs):
+                self._inputs[n] = np.asarray(a)
+        feeds = tuple(jnp.asarray(self._inputs[n]) for n in self._feed_names)
+        outs = self._exported.call(self._params, *feeds)
+        names = self.get_output_names()
+        self._outputs = {n: o for n, o in zip(names, outs)}
+        if inputs is not None:
+            return [np.asarray(o) for o in outs]
+        return True
+
+    def clone(self):
+        return Predictor(self._config)
+
+    def try_shrink_memory(self):
+        pass
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -1,0 +1,84 @@
+"""DataLoader worker-process entry (reference `fluid/dataloader/worker.py`
+_worker_loop).
+
+Deliberately JAX-FREE: workers are forkserver/spawn children (plain `fork`
+deadlocks once XLA's compile threads exist in the parent), and nothing here
+may pull in the JAX runtime — batches cross the shm ring as pickled numpy.
+"""
+from __future__ import annotations
+
+import pickle
+import traceback
+
+import numpy as np
+
+_DONE_TAG = 2 ** 63 - 1
+_ERR_TAG = 2 ** 63 - 2
+
+
+def np_collate(batch):
+    """Numpy-only default collate (mirror of dataloader.default_collate_fn
+    minus the Tensor wrapping)."""
+    sample = batch[0]
+    if hasattr(sample, "numpy") and not isinstance(sample, np.ndarray):
+        return np.stack([np.asarray(s.numpy()) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    if isinstance(sample, (list, tuple)):
+        return [np_collate(list(s)) for s in zip(*batch)]
+    if isinstance(sample, dict):
+        return {k: np_collate([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+class UserCollate:
+    """Picklable wrapper running a user collate_fn, then stripping any
+    framework tensors down to numpy (imports stay lazy: only pay for
+    paddle/jax in the worker if the user's collate actually needs them)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def __call__(self, samples):
+        out = self.fn(samples)
+        return _strip(out)
+
+
+def _strip(x):
+    if hasattr(x, "numpy") and not isinstance(x, np.ndarray):
+        return np.asarray(x.numpy())
+    if isinstance(x, (list, tuple)):
+        return type(x)(_strip(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _strip(v) for k, v in x.items()}
+    return x
+
+
+def worker_main(ring_name, job_blob, worker_id, nw):
+    """`job_blob` is cloudpickle-serialized (dataset, collate, batches,
+    worker_init_fn) — cloudpickle so datasets/collates defined in local
+    scopes or __main__ survive the forkserver/spawn boundary."""
+    import cloudpickle
+
+    from .shm_ring import ShmRing
+
+    dataset, collate, batches, worker_init_fn = cloudpickle.loads(job_blob)
+    wring = ShmRing(ring_name, create=False)
+    try:
+        if worker_init_fn is not None:
+            worker_init_fn(worker_id)
+        for bi in range(worker_id, len(batches), nw):
+            payload = pickle.dumps(
+                collate([dataset[i] for i in batches[bi]]), protocol=4)
+            wring.write(payload, tag=bi)
+        wring.write(b"", tag=_DONE_TAG)
+    except BaseException as e:  # surface the real error to the parent
+        wring.write(pickle.dumps(
+            (type(e).__name__, str(e), traceback.format_exc())),
+            tag=_ERR_TAG)
+    finally:
+        wring.close()

@@ -24,6 +24,29 @@ from .dataset import BatchSampler, IterableDataset
 
 __all__ = ["DataLoader", "default_collate_fn"]
 
+from ._worker import _DONE_TAG, _ERR_TAG
+
+
+def _to_numpy_tree(x):
+    """Tensors → numpy for cross-process pickling."""
+    if isinstance(x, Tensor):
+        return np.asarray(x.numpy())
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_numpy_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _to_numpy_tree(v) for k, v in x.items()}
+    return x
+
+
+def _from_numpy_tree(x):
+    if isinstance(x, np.ndarray):
+        return Tensor(x)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_from_numpy_tree(v) for v in x)
+    if isinstance(x, dict):
+        return {k: _from_numpy_tree(v) for k, v in x.items()}
+    return x
+
 
 def default_collate_fn(batch):
     """Reference `fluid/dataloader/collate.py`: stack samples into batches."""
@@ -45,24 +68,56 @@ def default_collate_fn(batch):
 
 
 class _PrefetchIterator:
-    """Background-thread prefetcher (BufferedReader equivalent)."""
+    """Background-thread prefetcher (BufferedReader equivalent).
+
+    close()/__del__ unblock the producer thread and close the underlying
+    generator so its finally-blocks run (worker teardown + shm unlink) even
+    when the consumer abandons iteration early."""
 
     def __init__(self, gen_fn, depth=2):
+        import weakref
+
         self._q = queue.Queue(maxsize=depth)
         self._sentinel = object()
         self._err = None
+        self._stop = threading.Event()
+        # The thread must NOT hold a strong ref to self: a live thread's
+        # closure is GC-reachable, which would keep the iterator alive
+        # forever and __del__ (→ cleanup) would never fire on early break.
+        q, stop, sentinel = self._q, self._stop, self._sentinel
+        weak_self = weakref.ref(self)
 
         def run():
+            gen = gen_fn()
             try:
-                for item in gen_fn():
-                    self._q.put(item)
+                for item in gen:
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as e:  # propagate to consumer
-                self._err = e
+                s = weak_self()
+                if s is not None:
+                    s._err = e
             finally:
-                self._q.put(self._sentinel)
+                gen.close()  # run the generator's finally (kill workers...)
+                try:
+                    q.put_nowait(sentinel)
+                except queue.Full:
+                    pass
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
+
+    def close(self):
+        self._stop.set()
+
+    def __del__(self):
+        self.close()
 
     def __iter__(self):
         return self
@@ -86,6 +141,8 @@ class DataLoader:
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
+        self.worker_init_fn = worker_init_fn
+        self._shm_slot_size = 32 << 20
         self.use_buffer_reader = use_buffer_reader
         self.prefetch_factor = prefetch_factor
         self._iterable_ds = isinstance(dataset, IterableDataset)
@@ -119,7 +176,117 @@ class DataLoader:
             for indices in self.batch_sampler:
                 yield self.collate_fn([self.dataset[i] for i in indices])
 
+    # ------------------------------------------------ multiprocess workers
+    def _gen_multiprocess(self):
+        """num_workers>0: worker processes pickle batches into the native
+        shm ring (csrc/shm_ring); parent reorders by batch tag. Mirrors the
+        reference's worker-loop + shared-memory transport
+        (fluid/dataloader/worker.py, use_shared_memory=True).
+
+        Workers use forkserver (fallback spawn) — plain fork deadlocks once
+        XLA's compile threads exist in the parent, so the worker entry lives
+        in the jax-free module `_worker.py` and everything it needs is
+        pickled across."""
+        import multiprocessing as mp
+        import os
+        import pickle
+
+        # top-level worker module (light import in children); fall back to
+        # the in-package copy if the repo-root module isn't on sys.path
+        try:
+            import paddle_tpu_worker as _worker
+        except ImportError:
+            from . import _worker
+
+        from .shm_ring import ShmRing
+
+        batches = list(self.batch_sampler)
+        nw = self.num_workers
+        ring_name = f"/pt_dl_{os.getpid()}_{id(self)}"
+        ring = ShmRing(ring_name, n_slots=max(2 * nw, 4),
+                       slot_size=self._shm_slot_size)
+        methods = mp.get_all_start_methods()
+        ctx = mp.get_context(
+            "forkserver" if "forkserver" in methods else "spawn")
+        if self.collate_fn is default_collate_fn:
+            w_collate = _worker.np_collate
+        else:
+            w_collate = _worker.UserCollate(self.collate_fn)
+        import cloudpickle
+
+        job_blob = cloudpickle.dumps(
+            (self.dataset, w_collate, batches, self.worker_init_fn))
+
+        procs = [ctx.Process(
+            target=_worker.worker_main,
+            args=(ring_name, job_blob, w, nw),
+            daemon=True) for w in range(nw)]
+        # Don't let multiprocessing re-exec the user's __main__ in workers:
+        # the job is cloudpickled by value, so the re-import is pure waste
+        # (it would re-run the training script / fail for <stdin> mains).
+        import sys
+
+        main_mod = sys.modules.get("__main__")
+        saved = (getattr(main_mod, "__file__", None),
+                 getattr(main_mod, "__spec__", None))
+        try:
+            if main_mod is not None:
+                try:
+                    del main_mod.__file__
+                except AttributeError:
+                    pass
+                main_mod.__spec__ = None
+            for p in procs:
+                p.start()
+        finally:
+            if main_mod is not None:
+                if saved[0] is not None:
+                    main_mod.__file__ = saved[0]
+                main_mod.__spec__ = saved[1]
+        try:
+            pending = {}
+            done_workers = 0
+            next_bi = 0
+            total = len(batches)
+            while next_bi < total:
+                while next_bi not in pending:
+                    msg = ring.read(timeout_ms=5000)
+                    if msg is None:
+                        dead = [p for p in procs
+                                if not p.is_alive() and p.exitcode != 0]
+                        if dead:
+                            raise RuntimeError(
+                                f"DataLoader worker died with exit code "
+                                f"{dead[0].exitcode}")
+                        continue
+                    payload, tag = msg
+                    if tag == _ERR_TAG:
+                        name, message, tb = pickle.loads(payload)
+                        raise RuntimeError(
+                            f"DataLoader worker raised {name}: {message}\n"
+                            f"{tb}")
+                    if tag == _DONE_TAG:
+                        done_workers += 1
+                        if done_workers == nw:
+                            raise RuntimeError(
+                                f"all workers exited but batch {next_bi} "
+                                f"was never produced")
+                        continue
+                    pending[tag] = payload
+                payload = pending.pop(next_bi)
+                yield _from_numpy_tree(pickle.loads(payload))
+                next_bi += 1
+        finally:
+            for p in procs:
+                p.terminate()
+            ring.close()
+
     def __iter__(self):
+        if self.num_workers and self.num_workers > 0 \
+                and not self._iterable_ds:
+            gen = self._gen_multiprocess
+        else:
+            gen = self._gen
         if self.use_buffer_reader:
-            return _PrefetchIterator(self._gen, depth=self.prefetch_factor)
-        return self._gen()
+            return _PrefetchIterator(gen, depth=self.prefetch_factor)
+        return gen()

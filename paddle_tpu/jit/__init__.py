@@ -291,7 +291,10 @@ def save(layer, path, input_spec=None, **configs):
         f.write(blob)
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump({"names": names,
-                     "arrays": [np.asarray(state[n]._data) for n in names]},
+                     "arrays": [np.asarray(state[n]._data) for n in names],
+                     "feed_names": [getattr(s, "name", None) or f"x{i}"
+                                    for i, s in enumerate(input_spec)],
+                     "kind": "jit_save"},
                     f, protocol=4)
 
 

@@ -1,0 +1,25 @@
+"""paddle_tpu.quantization — QAT / PTQ.
+
+Reference: `python/paddle/quantization/` (QuantConfig `config.py:60`,
+QAT `qat.py:23`, PTQ `ptq.py:24`, observers/quanters) + the fake_quantize
+CUDA ops (`fluid/operators/fake_quantize_op.cu`).
+
+TPU re-design: fake-quantization is a pure jnp function with a
+straight-through estimator (`x + stop_gradient(q(x) - x)`) — XLA fuses it
+into the surrounding matmul; no custom kernels. Observer state (absmax
+moving averages) lives as layer buffers so QAT works under jit.TrainStep.
+"""
+from .config import QuantConfig, SingleLayerConfig  # noqa: F401
+from .observers import AbsmaxObserver, AbsmaxObserverLayer  # noqa: F401
+from .quanters import (  # noqa: F401
+    FakeQuanterWithAbsMaxObserver, FakeQuanterWithAbsMaxObserverLayer,
+    quant_dequant,
+)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .wrapper import QuantedLayer  # noqa: F401
+
+__all__ = ["QuantConfig", "SingleLayerConfig", "AbsmaxObserver",
+           "AbsmaxObserverLayer", "FakeQuanterWithAbsMaxObserver",
+           "FakeQuanterWithAbsMaxObserverLayer", "quant_dequant", "QAT",
+           "PTQ", "QuantedLayer"]

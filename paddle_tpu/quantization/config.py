@@ -1,0 +1,59 @@
+"""QuantConfig (reference `quantization/config.py:60`)."""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+
+
+class SingleLayerConfig:
+    def __init__(self, activation, weight):
+        self.activation = activation
+        self.weight = weight
+
+    def __repr__(self):
+        return f"SingleLayerConfig(act={self.activation}, w={self.weight})"
+
+
+class QuantConfig:
+    """Maps layers → quanter factories. Priority: layer > name > type >
+    global default (config.py:96,140,183)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._global = SingleLayerConfig(activation, weight) \
+            if (activation or weight) else None
+        self._layer_configs: list[tuple[list[Layer], SingleLayerConfig]] = []
+        self._name_configs: list[tuple[list[str], SingleLayerConfig]] = []
+        self._type_configs: list[tuple[list[type], SingleLayerConfig]] = []
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, list) else [layer]
+        self._layer_configs.append(
+            (layers, SingleLayerConfig(activation, weight)))
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, list) else [layer_name]
+        self._name_configs.append(
+            (names, SingleLayerConfig(activation, weight)))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, list) else [layer_type]
+        self._type_configs.append(
+            (types, SingleLayerConfig(activation, weight)))
+
+    def config_for(self, layer, name=""):
+        for layers, cfg in self._layer_configs:
+            if any(layer is l for l in layers):
+                return cfg
+        for names, cfg in self._name_configs:
+            if name in names:
+                return cfg
+        for types, cfg in self._type_configs:
+            if isinstance(layer, tuple(types)):
+                return cfg
+        return self._global
+
+    # default-quantable types when only a global config is given
+    def default_quantable_types(self):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv1D, Conv2D, Conv3D
+
+        return (Linear, Conv1D, Conv2D, Conv3D)

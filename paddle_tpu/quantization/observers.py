@@ -1,0 +1,49 @@
+"""PTQ observers (reference `quantization/observers/abs_max.py`)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .quanters import _Factory, quant_dequant
+
+
+class AbsmaxObserverLayer(Layer):
+    """Calibration-time absmax collector (abs_max.py:48): forward records
+    max |x| seen; after calibration `scales` is the quant threshold."""
+
+    def __init__(self, layer=None, quant_bits=8):
+        super().__init__()
+        self._quant_bits = int(quant_bits)
+        self._max = Tensor(jnp.zeros((), jnp.float32), stop_gradient=True)
+        self.register_buffer("abs_max_val", self._max)
+
+    def forward(self, x):
+        absmax = forward(lambda a: jnp.max(jnp.abs(a)).astype(jnp.float32),
+                         (x,), name="absmax", nondiff=True)
+        self._max._data = jnp.maximum(self._max._data, absmax._data)
+        return x
+
+    def cal_thresholds(self):
+        return float(self._max._data)
+
+    @property
+    def scales(self):
+        return Tensor(self._max._data)
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def as_quanter(self, x):
+        """Post-calibration simulated quantization."""
+        return quant_dequant(x, Tensor(self._max._data),
+                             bits=self._quant_bits)
+
+
+class AbsmaxObserver(_Factory):
+    def _layer_cls(self):
+        return AbsmaxObserverLayer

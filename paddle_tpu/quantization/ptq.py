@@ -1,0 +1,31 @@
+"""PTQ (reference `quantization/ptq.py:24`)."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .qat import Quantization, _walk_and_wrap
+
+
+class PTQ(Quantization):
+    """Post-training quantization: insert observers, run calibration
+    batches through the model, then `convert` to frozen scales."""
+
+    def quantize(self, model: Layer, inplace=False):
+        m = model if inplace else copy.deepcopy(model)
+        m.eval()
+
+        def make(child, cfg):
+            aq = cfg.activation._instance(child) \
+                if cfg.activation is not None else None
+            wq = cfg.weight._instance(child) \
+                if cfg.weight is not None else None
+            # observers must SEE data in eval mode: force training-like
+            # collection by leaving them in train() state
+            for q in (aq, wq):
+                if q is not None:
+                    q.training = True
+            return aq, wq
+
+        return _walk_and_wrap(m, self._config, make)

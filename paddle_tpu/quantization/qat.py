@@ -1,0 +1,61 @@
+"""QAT (reference `quantization/qat.py:23`)."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer.layers import Layer
+from .config import QuantConfig
+from .wrapper import ConvertedQuantedLayer, QuantedLayer
+
+
+def _walk_and_wrap(model: Layer, config: QuantConfig, make_quanters):
+    """Replace quantable sublayers with QuantedLayer wrappers in place
+    (Layer stores children in `_sub_layers`)."""
+    quantable = config.default_quantable_types()
+    for key, child in list(model._sub_layers.items()):
+        if isinstance(child, QuantedLayer):
+            continue
+        cfg = config.config_for(child, str(key))
+        if cfg is not None and isinstance(child, quantable):
+            aq, wq = make_quanters(child, cfg)
+            model._sub_layers[key] = QuantedLayer(child, aq, wq)
+        else:
+            _walk_and_wrap(child, config, make_quanters)
+    return model
+
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def convert(self, model: Layer, inplace=False):
+        """Freeze observers into fixed-scale simulated-int8 layers."""
+        m = model if inplace else copy.deepcopy(model)
+
+        def conv(layer):
+            for key, child in list(layer._sub_layers.items()):
+                if isinstance(child, QuantedLayer):
+                    layer._sub_layers[key] = ConvertedQuantedLayer(child)
+                else:
+                    conv(child)
+
+        conv(m)
+        m.eval()
+        return m
+
+
+class QAT(Quantization):
+    """Quantization-aware training: wrap layers with fake quanters whose
+    moving-average scales update during training."""
+
+    def quantize(self, model: Layer, inplace=False):
+        m = model if inplace else copy.deepcopy(model)
+
+        def make(child, cfg):
+            aq = cfg.activation._instance(child) \
+                if cfg.activation is not None else None
+            wq = cfg.weight._instance(child) \
+                if cfg.weight is not None else None
+            return aq, wq
+
+        return _walk_and_wrap(m, self._config, make)

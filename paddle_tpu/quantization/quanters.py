@@ -1,0 +1,77 @@
+"""Fake quanters (reference `quantization/quanters/abs_max.py`
+FakeQuanterWithAbsMaxObserver; kernel `fluid/operators/fake_quantize_op`)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+
+def quant_dequant(x, scale, bits=8):
+    """Simulated quantization with straight-through gradients.
+
+    q = round(clip(x, ±scale) / scale * qmax) * scale / qmax; the backward
+    pass sees identity inside the clip range (STE)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def f(a, s):
+        s = jnp.maximum(s, 1e-9)
+        clipped = jnp.clip(a, -s, s)
+        q = jnp.round(clipped / s * qmax) * (s / qmax)
+        return a + jax.lax.stop_gradient(q - a)
+
+    return forward(f, (x, scale), name="fake_quantize_dequantize")
+
+
+class _Factory:
+    """Reference QuanterFactory: stores ctor args, `_instance(layer)` builds
+    the quanter layer."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def _instance(self, layer):
+        return self._layer_cls()(layer, **self._kwargs)
+
+
+class FakeQuanterWithAbsMaxObserverLayer(Layer):
+    """Moving-average absmax fake quanter (abs_max.py)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, bit_length=8,
+                 dtype="float32"):
+        super().__init__()
+        self._moving_rate = float(moving_rate)
+        self._bit_length = int(bit_length)
+        self._scale = Tensor(jnp.ones((), jnp.float32), stop_gradient=True)
+        self._accum = Tensor(jnp.ones((), jnp.float32), stop_gradient=True)
+        self._state = Tensor(jnp.ones((), jnp.float32), stop_gradient=True)
+        self.register_buffer("quant_scale", self._scale)
+
+    def forward(self, x):
+        if self.training:
+            absmax = forward(
+                lambda a: jnp.max(jnp.abs(a)).astype(jnp.float32), (x,),
+                name="absmax", nondiff=True)
+            r = self._moving_rate
+            state = self._state._data * r + 1.0
+            accum = self._accum._data * r + absmax._data
+            self._state._data = state
+            self._accum._data = accum
+            self._scale._data = accum / state
+        return quant_dequant(x, Tensor(self._scale._data),
+                             bits=self._bit_length)
+
+    @property
+    def scales(self):
+        return Tensor(self._scale._data)
+
+    def bit_length(self):
+        return self._bit_length
+
+
+class FakeQuanterWithAbsMaxObserver(_Factory):
+    def _layer_cls(self):
+        return FakeQuanterWithAbsMaxObserverLayer

@@ -1,0 +1,75 @@
+"""Quanted layer wrapper (reference `quantization/wrapper.py` +
+`nn/quant/qat` wrappers)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+from .quanters import quant_dequant
+
+
+class QuantedLayer(Layer):
+    """Wraps a source layer: fake-quantize activations on the way in and
+    the layer's `weight` before the wrapped forward."""
+
+    def __init__(self, source: Layer, activation_quanter=None,
+                 weight_quanter=None):
+        super().__init__()
+        self.source = source
+        self.activation_quanter = activation_quanter
+        self.weight_quanter = weight_quanter
+
+    def forward(self, x, *args, **kwargs):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        if self.weight_quanter is not None and hasattr(self.source, "weight"):
+            w = self.source.weight
+            orig = w._data
+            wq = self.weight_quanter(Tensor(orig, stop_gradient=False))
+            # run wrapped forward against the fake-quantized weight
+            self.source.weight._data = wq._data \
+                if isinstance(wq, Tensor) else jnp.asarray(wq)
+            try:
+                out = self.source(x, *args, **kwargs)
+            finally:
+                self.source.weight._data = orig
+            return out
+        return self.source(x, *args, **kwargs)
+
+    def weights_to_quanters(self):
+        return [("weight", self.weight_quanter)]
+
+    def activation_quanters(self):
+        return [self.activation_quanter]
+
+
+class ConvertedQuantedLayer(Layer):
+    """Inference form after `convert`: frozen scales, simulated int8."""
+
+    def __init__(self, quanted: QuantedLayer):
+        super().__init__()
+        self.source = quanted.source
+        wq = quanted.weight_quanter
+        aq = quanted.activation_quanter
+        self._w_scale = float(wq.scales._data) if wq is not None else None
+        self._w_bits = wq.bit_length() if wq is not None else 8
+        self._a_scale = float(aq.scales._data) if aq is not None else None
+        self._a_bits = aq.bit_length() if aq is not None else 8
+
+    def forward(self, x, *args, **kwargs):
+        if self._a_scale is not None:
+            x = quant_dequant(x, Tensor(jnp.float32(self._a_scale)),
+                              bits=self._a_bits)
+        if self._w_scale is not None and hasattr(self.source, "weight"):
+            w = self.source.weight
+            orig = w._data
+            wq = quant_dequant(Tensor(orig),
+                               Tensor(jnp.float32(self._w_scale)),
+                               bits=self._w_bits)
+            self.source.weight._data = wq._data
+            try:
+                return self.source(x, *args, **kwargs)
+            finally:
+                self.source.weight._data = orig
+        return self.source(x, *args, **kwargs)

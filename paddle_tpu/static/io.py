@@ -1,0 +1,135 @@
+"""Static-graph inference-model save/load
+(reference `python/paddle/static/io.py` save_inference_model /
+load_inference_model, backed there by save_combine/load_combine ops).
+
+TPU re-design: the pruned inference graph is traced to StableHLO via
+jax.export (batch dims symbolic, so one artifact serves any batch size) and
+parameters are pickled alongside — the same `.pdmodel`/`.pdiparams` pair
+`paddle.jit.save` emits and `paddle.inference` consumes.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd, dispatch
+from ..core.tensor import Tensor
+from . import program as prog_mod
+from .program import Program, Variable, global_scope
+
+__all__ = ["save_inference_model", "load_inference_model"]
+
+
+def _export_program(program: Program, feed_vars, fetch_vars, scope):
+    """Trace the program's op record (no optimizer) into a jax.export
+    artifact with params baked as the first argument group."""
+    param_vars = [v for v, _ in program.params]
+    param_arrays = []
+    for pv, init in program.params:
+        arr = scope.vars.get(pv.name)
+        param_arrays.append(jnp.asarray(arr if arr is not None else init))
+
+    def pure(params, *feeds):
+        env = {}
+        for pv, arr in zip(param_vars, params):
+            env[pv.vid] = Tensor(arr)
+        for fv, arr in zip(feed_vars, feeds):
+            env[fv.vid] = Tensor(arr)
+
+        def resolve(ref):
+            return env[ref.vid] if isinstance(ref, Variable) else ref
+
+        with autograd._scoped(False):
+            for op in program.ops:
+                ins = tuple(resolve(r) for r in op.inputs)
+                out = dispatch.forward(op.fn, ins, dict(op.attrs),
+                                       name=op.name)
+                outs = out if isinstance(out, tuple) else (out,)
+                for v, o in zip(op.outputs, outs):
+                    env[v.vid] = o
+        return tuple(env[v.vid]._data for v in fetch_vars)
+
+    # symbolic batch dims for every -1 in a feed shape → artifact serves
+    # any batch size (jax.export shape polymorphism)
+    from jax import export as jax_export
+
+    feed_shapes = []
+    n_sym = 0
+    for fv in feed_vars:
+        dims = []
+        for s in fv._static_shape:
+            if s in (-1, None):
+                dims.append(f"b{n_sym}")
+                n_sym += 1
+            else:
+                dims.append(str(s))
+        shape = jax_export.symbolic_shape(",".join(dims)) if dims else ()
+        feed_shapes.append(jax.ShapeDtypeStruct(shape, fv._np_dtype))
+
+    param_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
+                         for a in param_arrays)
+    prev = dispatch.static_recorder
+    dispatch.static_recorder = None
+    try:
+        exported = jax_export.export(jax.jit(pure))(param_shapes,
+                                                    *feed_shapes)
+    finally:
+        dispatch.static_recorder = prev
+    return exported, param_arrays
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """`paddle.static.save_inference_model` equivalent."""
+    program = program or prog_mod.default_main_program()
+    scope = global_scope()
+    if not isinstance(feed_vars, (list, tuple)):
+        feed_vars = [feed_vars]
+    if not isinstance(fetch_vars, (list, tuple)):
+        fetch_vars = [fetch_vars]
+    exported, param_arrays = _export_program(program, feed_vars, fetch_vars,
+                                             scope)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({
+            "arrays": [np.asarray(a) for a in param_arrays],
+            "feed_names": [fv.name for fv in feed_vars],
+            "fetch_names": [fv.name for fv in fetch_vars],
+            "kind": "static_inference",
+        }, f, protocol=4)
+
+
+class _LoadedInferenceProgram:
+    """Callable stand-in for the loaded inference program."""
+
+    def __init__(self, exported, params, feed_names, fetch_names):
+        self._exported = exported
+        self._params = [jnp.asarray(a) for a in params]
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+
+    def run(self, feed):
+        feeds = tuple(jnp.asarray(feed[n]) for n in self.feed_names)
+        return [np.asarray(o)
+                for o in self._exported.call(tuple(self._params), *feeds)]
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    """`paddle.static.load_inference_model` equivalent. Returns
+    [program_like, feed_target_names, fetch_targets] per reference API."""
+    from jax import export as jax_export
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        exported = jax_export.deserialize(f.read())
+    with open(path_prefix + ".pdiparams", "rb") as f:
+        meta = pickle.load(f)
+    prog = _LoadedInferenceProgram(exported, meta["arrays"],
+                                   meta.get("feed_names", []),
+                                   meta.get("fetch_names", []))
+    return [prog, prog.feed_names, prog.fetch_names]

@@ -1,0 +1,38 @@
+"""paddle_tpu.utils (reference `python/paddle/utils/`)."""
+from . import cpp_extension  # noqa: F401
+
+
+def try_import(module_name, err_msg=None):
+    import importlib
+
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        if err_msg:
+            raise ImportError(err_msg) from None
+        raise
+
+
+def run_check():
+    """`paddle.utils.run_check` — sanity-check the install + device."""
+    import jax
+
+    from .. import __version__
+
+    devs = jax.devices()
+    print(f"paddle_tpu {__version__} is installed; "
+          f"{len(devs)} device(s) available: {devs}")
+    import numpy as np
+
+    from .. import matmul, to_tensor
+
+    x = to_tensor(np.ones((2, 2), np.float32))
+    assert float(matmul(x, x).numpy()[0, 0]) == 2.0
+    print("PaddlePaddle-TPU works well on this machine.")
+
+
+def deprecated(update_to="", since="", reason=""):
+    def decorator(fn):
+        return fn
+
+    return decorator

@@ -1,0 +1,16 @@
+"""paddle_tpu.utils.cpp_extension — out-of-tree C++ custom ops.
+
+Reference: `python/paddle/utils/cpp_extension/cpp_extension.py:79` (setup)
+and `:800` (load) building `PD_BUILD_OP` ops
+(`paddle/phi/api/ext/op_meta_info.h:687`).
+
+TPU re-design: the op is compiled with g++ against the C ABI in
+`csrc/include/pt_custom_op.h` and bound via ctypes (no pybind11 in this
+image). At call time the op runs as a host callback (`jax.pure_callback`),
+which makes it usable from eager code, inside `jax.jit`, and under
+`shard_map` — the TPU equivalent of the reference's custom CPU kernel path.
+Gradients attach via `register_vjp`.
+"""
+from .extension_utils import CppExtension, CUDAExtension, load, setup  # noqa: F401
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup"]

@@ -1,0 +1,209 @@
+"""C++ custom-op builder + ctypes bridge (see package docstring)."""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+_DTYPE_CODES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.uint8): 4,
+    np.dtype(np.bool_): 5,
+}
+
+_INCLUDE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))), "csrc", "include")
+
+
+class _PTTensor(ctypes.Structure):
+    _fields_ = [("data", ctypes.c_void_p),
+                ("dims", ctypes.POINTER(ctypes.c_int64)),
+                ("ndim", ctypes.c_int32),
+                ("dtype", ctypes.c_int32)]
+
+
+class CppExtension:
+    """Extension spec (cpp_extension.py CppExtension)."""
+
+    def __init__(self, sources, name=None, extra_compile_args=None,
+                 include_dirs=None, **kwargs):
+        self.sources = list(sources)
+        self.name = name
+        self.extra_compile_args = list(extra_compile_args or [])
+        self.include_dirs = list(include_dirs or [])
+
+
+# On TPU there is no separate CUDA path; accept the reference's spelling.
+CUDAExtension = CppExtension
+
+
+def _build_so(name, sources, extra_cflags, include_dirs, build_dir):
+    os.makedirs(build_dir, exist_ok=True)
+    so_path = os.path.join(build_dir, f"{name}.so")
+    digest = hashlib.sha256()
+    for s in sources:
+        with open(s, "rb") as f:
+            digest.update(f.read())
+    # flags/include dirs are part of the build identity too
+    digest.update(repr((sorted(extra_cflags or []),
+                        sorted(include_dirs or []))).encode())
+    stamp = os.path.join(build_dir, f"{name}.hash")
+    h = digest.hexdigest()
+    if os.path.exists(so_path) and os.path.exists(stamp):
+        with open(stamp) as f:
+            if f.read().strip() == h:
+                return so_path
+    cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+           f"-I{_INCLUDE_DIR}"]
+    cmd += [f"-I{d}" for d in include_dirs]
+    cmd += list(extra_cflags or [])
+    cmd += ["-o", so_path] + list(sources)
+    subprocess.run(cmd, check=True, capture_output=True, text=True)
+    with open(stamp, "w") as f:
+        f.write(h)
+    return so_path
+
+
+class _CustomOp:
+    """One loaded op: callable on Tensors/arrays, jit-safe via
+    pure_callback."""
+
+    def __init__(self, lib, name):
+        self._fn = getattr(lib, name)
+        self._fn.restype = None
+        self._fn.argtypes = [ctypes.POINTER(_PTTensor), ctypes.c_int32,
+                             ctypes.POINTER(_PTTensor), ctypes.c_int32]
+        self.name = name
+        self._vjp = None
+        self._infer = None  # callable(*in_avals) -> list[(shape, dtype)]
+
+    def register_infer_shape(self, fn):
+        self._infer = fn
+        return self
+
+    def register_vjp(self, fn):
+        """fn(cotangents, *primals) -> input cotangents."""
+        self._vjp = fn
+        return self
+
+    # ------------------------------------------------------------ host impl
+    def _host_call(self, out_specs, *arrays):
+        ins = (_PTTensor * len(arrays))()
+        keep = []
+        for i, a in enumerate(arrays):
+            a = np.ascontiguousarray(a)
+            keep.append(a)
+            dims = (ctypes.c_int64 * a.ndim)(*a.shape)
+            keep.append(dims)
+            ins[i] = _PTTensor(
+                a.ctypes.data_as(ctypes.c_void_p), dims, a.ndim,
+                _DTYPE_CODES[a.dtype])
+        outs_np = [np.empty(s, d) for s, d in out_specs]
+        outs = (_PTTensor * len(outs_np))()
+        for i, o in enumerate(outs_np):
+            dims = (ctypes.c_int64 * o.ndim)(*o.shape)
+            keep.append(dims)
+            outs[i] = _PTTensor(
+                o.ctypes.data_as(ctypes.c_void_p), dims, o.ndim,
+                _DTYPE_CODES[o.dtype])
+        self._fn(ins, len(arrays), outs, len(outs_np))
+        return tuple(outs_np)
+
+    def __call__(self, *inputs, out_shapes=None, out_dtypes=None):
+        from ...core.dispatch import forward, unwrap
+
+        arrays = [jnp.asarray(unwrap(x)) for x in inputs]
+        if self._infer is not None:
+            specs = self._infer(*[(a.shape, a.dtype) for a in arrays])
+        else:
+            if out_shapes is None:  # default: elementwise, like-first-input
+                specs = [(arrays[0].shape, arrays[0].dtype)]
+            else:
+                dts = out_dtypes or [arrays[0].dtype] * len(out_shapes)
+                specs = list(zip([tuple(s) for s in out_shapes],
+                                 [np.dtype(d) for d in dts]))
+        specs = [(tuple(s), np.dtype(d)) for s, d in specs]
+        result_avals = [jax.ShapeDtypeStruct(s, d) for s, d in specs]
+
+        def callback_fn(*arrs):
+            return self._host_call(specs, *arrs)
+
+        if self._vjp is None:
+            def op_fn(*arrs):
+                out = jax.pure_callback(callback_fn, tuple(result_avals),
+                                        *arrs, vmap_method="sequential")
+                return out if len(out) > 1 else out[0]
+
+            return forward(op_fn, tuple(inputs), name=self.name,
+                           nondiff=True)
+
+        vjp_py = self._vjp
+
+        @jax.custom_vjp
+        def op_fn(*arrs):
+            out = jax.pure_callback(callback_fn, tuple(result_avals),
+                                    *arrs, vmap_method="sequential")
+            return out if len(out) > 1 else out[0]
+
+        def fwd(*arrs):
+            out = op_fn(*arrs)
+            return out, arrs
+
+        def bwd(res, ct):
+            cts = ct if isinstance(ct, tuple) else (ct,)
+            grads = vjp_py(cts, *res)
+            return tuple(grads)
+
+        op_fn.defvjp(fwd, bwd)
+        return forward(op_fn, tuple(inputs), name=self.name)
+
+
+class _OpModule:
+    def __init__(self, lib, so_path):
+        self._lib = lib
+        self._so_path = so_path
+        self._ops = {}
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        if name not in self._ops:
+            try:
+                self._ops[name] = _CustomOp(self._lib, name)
+            except AttributeError as e:
+                raise AttributeError(
+                    f"custom op '{name}' not found in {self._so_path}") from e
+        return self._ops[name]
+
+
+def load(name, sources, extra_cflags=None, extra_cuda_cflags=None,
+         extra_ldflags=None, extra_include_paths=None, build_directory=None,
+         verbose=False):
+    """`paddle.utils.cpp_extension.load` (cpp_extension.py:800): JIT-build
+    the sources and return a module-like object exposing each exported op."""
+    build_dir = build_directory or os.path.join(
+        tempfile.gettempdir(), "paddle_tpu_extensions")
+    so_path = _build_so(name, sources, extra_cflags,
+                        extra_include_paths or [], build_dir)
+    lib = ctypes.CDLL(so_path)
+    return _OpModule(lib, so_path)
+
+
+def setup(name=None, ext_modules=None, **kwargs):
+    """`paddle.utils.cpp_extension.setup` (cpp_extension.py:79): build the
+    extensions in place (install-less: import via `load`'s build dir)."""
+    mods = []
+    for ext in ext_modules or []:
+        mods.append(load(ext.name or name, ext.sources,
+                         extra_cflags=ext.extra_compile_args,
+                         extra_include_paths=ext.include_dirs))
+    return mods[0] if len(mods) == 1 else mods

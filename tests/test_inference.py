@@ -1,0 +1,92 @@
+"""Inference deployment path: static save_inference_model →
+paddle.inference Config/Predictor, and jit.save → Predictor.
+(reference: AnalysisPredictor flow, BASELINE config 5's ERNIE static path)"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def static_artifact(tmp_path):
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            x = paddle.static.data("x", [-1, 4], "float32")
+            h = paddle.static.nn.fc(x, 8, activation="relu")
+            y = paddle.static.nn.fc(h, 2)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        # one forward to materialize params in scope
+        out = exe.run(main, feed={"x": np.zeros((3, 4), np.float32)},
+                      fetch_list=[y])
+        prefix = str(tmp_path / "model")
+        paddle.static.save_inference_model(prefix, [x], [y], exe,
+                                           program=main)
+        return prefix, out[0]
+    finally:
+        paddle.disable_static()
+
+
+class TestStaticInference:
+    def test_save_load_inference_model(self, static_artifact, tmp_path):
+        prefix, ref_out = static_artifact
+        prog, feeds, fetches = paddle.static.load_inference_model(prefix)
+        assert feeds == ["x"]
+        out = prog.run({"x": np.zeros((3, 4), np.float32)})
+        np.testing.assert_allclose(out[0], ref_out, rtol=1e-5)
+
+    def test_predictor_roundtrip(self, static_artifact):
+        prefix, ref_out = static_artifact
+        from paddle_tpu import inference
+
+        config = inference.Config(prefix + ".pdmodel",
+                                  prefix + ".pdiparams")
+        pred = inference.create_predictor(config)
+        assert pred.get_input_names() == ["x"]
+        h = pred.get_input_handle("x")
+        h.copy_from_cpu(np.zeros((3, 4), np.float32))
+        assert pred.run()
+        out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
+        np.testing.assert_allclose(out, ref_out, rtol=1e-5)
+
+    def test_predictor_dynamic_batch(self, static_artifact):
+        """Symbolic batch dim: one artifact, many batch sizes."""
+        prefix, _ = static_artifact
+        from paddle_tpu import inference
+
+        pred = inference.create_predictor(inference.Config(prefix))
+        for bs in (1, 5, 16):
+            x = np.random.default_rng(bs).normal(size=(bs, 4)) \
+                .astype(np.float32)
+            outs = pred.run([x])
+            assert outs[0].shape == (bs, 2)
+
+    def test_run_list_api(self, static_artifact):
+        prefix, ref_out = static_artifact
+        from paddle_tpu import inference
+
+        pred = inference.create_predictor(inference.Config(prefix))
+        outs = pred.run([np.zeros((3, 4), np.float32)])
+        np.testing.assert_allclose(outs[0], ref_out, rtol=1e-5)
+
+
+class TestJitSavePredictor:
+    def test_jit_saved_layer_through_predictor(self, tmp_path):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        model.eval()
+        x = paddle.randn([2, 4])
+        ref = model(x).numpy()
+        prefix = str(tmp_path / "jit_model")
+        paddle.jit.save(model, prefix,
+                        input_spec=[paddle.static.InputSpec([2, 4])])
+        from paddle_tpu import inference
+
+        pred = inference.create_predictor(inference.Config(prefix))
+        outs = pred.run([x.numpy()])
+        np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)

@@ -1,0 +1,104 @@
+"""Quantization QAT/PTQ tests (reference test_quant_aware / PTQ suites)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, AbsmaxObserver, FakeQuanterWithAbsMaxObserver, QuantConfig,
+    quant_dequant,
+)
+
+
+class TestQuantDequant:
+    def test_values_quantized(self):
+        x = paddle.to_tensor(np.array([0.5, -0.26, 0.9], np.float32))
+        out = quant_dequant(x, paddle.to_tensor(np.float32(1.0)), bits=8)
+        q = np.round(np.array([0.5, -0.26, 0.9]) * 127) / 127
+        np.testing.assert_allclose(out.numpy(), q, rtol=1e-6)
+
+    def test_clip(self):
+        x = paddle.to_tensor(np.array([2.0, -3.0], np.float32))
+        out = quant_dequant(x, paddle.to_tensor(np.float32(1.0)), bits=8)
+        np.testing.assert_allclose(out.numpy(), [1.0, -1.0], rtol=1e-6)
+
+    def test_straight_through_gradient(self):
+        x = paddle.to_tensor(np.array([0.3, 0.7], np.float32),
+                             stop_gradient=False)
+        out = quant_dequant(x, paddle.to_tensor(np.float32(1.0)))
+        out.sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+
+class TestQAT:
+    def _model(self):
+        return nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 2))
+
+    def test_quantize_wraps_linears(self):
+        from paddle_tpu.quantization import QuantedLayer
+
+        quanter = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+        qat = QAT(QuantConfig(activation=quanter, weight=quanter))
+        model = qat.quantize(self._model())
+        kinds = [type(m).__name__ for m in model.children()]
+        assert kinds.count("QuantedLayer") == 2
+
+    def test_qat_trains_and_converts(self):
+        paddle.seed(0)
+        quanter = FakeQuanterWithAbsMaxObserver(moving_rate=0.9)
+        qat = QAT(QuantConfig(activation=quanter, weight=quanter))
+        model = qat.quantize(self._model())
+        opt = paddle.optimizer.Adam(1e-2, parameters=model.parameters())
+        x = paddle.randn([16, 8])
+        y = paddle.to_tensor(
+            np.random.default_rng(0).integers(0, 2, 16).astype(np.int64))
+        losses = []
+        for _ in range(10):
+            loss = paddle.nn.functional.cross_entropy(model(x), y).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0]
+        inf = qat.convert(model)
+        out = inf(x)
+        assert out.shape == [16, 2]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_converted_close_to_fp(self):
+        paddle.seed(1)
+        model = self._model()
+        model.eval()
+        x = paddle.randn([4, 8])
+        ref = model(x).numpy()
+        quanter = FakeQuanterWithAbsMaxObserver()
+        qat = QAT(QuantConfig(activation=quanter, weight=quanter))
+        q = qat.quantize(model)
+        q.eval()
+        # run once in train mode to set scales
+        q.train()
+        q(x)
+        q.eval()
+        inf = qat.convert(q)
+        out = inf(x).numpy()
+        # int8 sim should be within a few percent
+        assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05
+
+
+class TestPTQ:
+    def test_ptq_calibrate_convert(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 2))
+        model.eval()
+        observer = AbsmaxObserver(quant_bits=8)
+        ptq = PTQ(QuantConfig(activation=observer, weight=observer))
+        q = ptq.quantize(model)
+        # calibration passes (observers collect, outputs unchanged)
+        x = paddle.randn([32, 8])
+        ref = model(x).numpy()
+        out_cal = q(x).numpy()
+        np.testing.assert_allclose(out_cal, ref, rtol=1e-5)
+        inf = ptq.convert(q)
+        out = inf(x).numpy()
+        assert np.isfinite(out).all()
+        assert np.abs(out - ref).max() < 0.15 * np.abs(ref).max() + 0.05
