@@ -19,7 +19,30 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# When the process is pinned to the CPU platform, neutralize any TPU-tunnel
+# PJRT plugin (registered from sitecustomize before this import) so that jax
+# backend init can never block on an unreachable accelerator transport. A
+# CPU-only process must import + compute in seconds regardless of plugin
+# health; users who want the TPU simply don't set JAX_PLATFORMS=cpu.
+_plats = (_os.environ.get("JAX_PLATFORMS")
+          or _os.environ.get("JAX_PLATFORM_NAME") or "")
+_names = {p.strip().lower() for p in _plats.split(",") if p.strip()}
+_cpu_pinned = bool(_names) and _names <= {"cpu"}
+if _cpu_pinned and "PALLAS_AXON_POOL_IPS" not in _os.environ:
+    _os.environ["PALLAS_AXON_POOL_IPS"] = ""
+del _plats, _names
+
 import jax as _jax
+
+# A plugin registered at interpreter start may have overridden jax_platforms
+# (env vars are only jax.config's *defaults*, captured at jax import). The
+# user's explicit JAX_PLATFORMS=cpu wins: restore it so no later jax call
+# can touch the accelerator transport.
+if _cpu_pinned and (_jax.config.jax_platforms or "") != "cpu":
+    _jax.config.update("jax_platforms", "cpu")
+del _cpu_pinned
 
 # f32 matmuls run at full float32 precision, matching the reference's cuBLAS
 # default (TF32 disabled — `FLAGS_allow_tf32` analog). bf16 — the TPU perf

@@ -12,7 +12,9 @@ not the host CPU platform (tpu / axon tunnel) is treated as the accelerator
 """
 from __future__ import annotations
 
-import functools
+import os
+import time
+import threading
 
 import jax
 
@@ -61,18 +63,79 @@ CUDAPlace = TPUPlace
 XPUPlace = TPUPlace
 
 
-@functools.cache
+def cpu_only_env() -> bool:
+    """True when the process is pinned to the CPU platform (via jax.config or
+    env), in which case accelerator probing must never touch the TPU plugin.
+    jax.config is checked first: on hosts where sitecustomize imports jax at
+    interpreter start, config updates are authoritative and env vars are not."""
+    plats = getattr(jax.config, "jax_platforms", None) \
+        or os.environ.get("JAX_PLATFORMS") \
+        or os.environ.get("JAX_PLATFORM_NAME") or ""
+    names = {p.strip().lower() for p in plats.split(",") if p.strip()}
+    return bool(names) and names <= {"cpu"}
+
+
+# Accelerator discovery runs jax's full backend init (including any PJRT
+# plugin tunnel), which can block for minutes when the transport is down
+# (reference analog: dynload of vendor libs, `phi/backends/dynload/`). Probe
+# in a daemon thread with a bounded wait; a timeout returns "no accelerator"
+# for the current call but is NOT cached — the probe keeps running and later
+# calls pick up its result, so a slow-but-healthy init is not permanently
+# misclassified as CPU-only.
+_PROBE_TIMEOUT = float(os.environ.get("PADDLE_TPU_DEVICE_PROBE_TIMEOUT", "60"))
+_probe_state: dict = {"thread": None, "result": None, "deadline": None}
+_probe_lock = threading.Lock()
+
+
+def _probe_worker():
+    try:
+        devs = tuple(d for d in jax.devices() if d.platform != "cpu")
+    except Exception:
+        devs = ()
+    _probe_state["result"] = devs
+
+
+def _probe_wait():
+    """Start the probe if needed and wait until it finishes or the single
+    global deadline passes. The deadline is shared across calls: once the
+    first call has burned the timeout, later calls return immediately
+    instead of stalling another full timeout each."""
+    with _probe_lock:
+        th = _probe_state["thread"]
+        if th is None:
+            th = threading.Thread(
+                target=_probe_worker, name="paddle-tpu-device-probe",
+                daemon=True)
+            _probe_state["thread"] = th
+            _probe_state["deadline"] = time.monotonic() + _PROBE_TIMEOUT
+            th.start()
+    th.join(max(0.0, _probe_state["deadline"] - time.monotonic()))
+    return th
+
+
 def _accelerators():
     """Non-CPU JAX devices (tpu chips; 'axon' tunnel devices count as tpu)."""
-    try:
-        return tuple(d for d in jax.devices() if d.platform != "cpu")
-    except RuntimeError:
+    if cpu_only_env():
         return ()
+    res = _probe_state["result"]
+    if res is not None:
+        return res
+    _probe_wait()
+    return _probe_state["result"] or ()
 
 
-@functools.cache
-def _cpu_devices():
-    return tuple(jax.devices("cpu")) if jax.default_backend() == "cpu" else ()
+def _backend_or_raise():
+    """Gate before any raw jax.devices() call: raise instead of blocking
+    forever when backend init is known to be hung (probe timed out)."""
+    if cpu_only_env():
+        return
+    th = _probe_wait()
+    if th.is_alive():
+        raise RuntimeError(
+            "jax accelerator backend initialization did not complete within "
+            f"{_PROBE_TIMEOUT:.0f}s (is the TPU tunnel up?). Set "
+            "JAX_PLATFORMS=cpu to run on CPU, or raise "
+            "PADDLE_TPU_DEVICE_PROBE_TIMEOUT.")
 
 
 _current_place: Place | None = None
@@ -91,7 +154,10 @@ is_compiled_with_custom_device = lambda _name="tpu": is_compiled_with_tpu()
 
 def device_count() -> int:
     n = len(_accelerators())
-    return n if n else len(jax.devices())
+    if n:
+        return n
+    _backend_or_raise()
+    return len(jax.devices())
 
 
 def set_device(device) -> Place:
@@ -139,6 +205,7 @@ def jax_device(place: Place | None = None):
     p = place or current_place()
     if p.device_type == "tpu" and _accelerators():
         return _accelerators()[p.device_id]
+    _backend_or_raise()
     return jax.devices()[0] if not _accelerators() else jax.devices("cpu")[0]
 
 

@@ -105,10 +105,15 @@ class _PrefetchIterator:
                     s._err = e
             finally:
                 gen.close()  # run the generator's finally (kill workers...)
-                try:
-                    q.put_nowait(sentinel)
-                except queue.Full:
-                    pass
+                # The sentinel MUST be delivered (a put_nowait drop leaves
+                # the consumer blocked forever once it drains the queue),
+                # so retry with the same stop-aware loop as items.
+                while not stop.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
 
         self._thread = threading.Thread(target=run, daemon=True)
         self._thread.start()
@@ -123,7 +128,23 @@ class _PrefetchIterator:
         return self
 
     def __next__(self):
-        item = self._q.get()
+        # Bounded gets + producer-liveness checks: a dead producer thread
+        # must surface as an error/StopIteration, never an infinite block
+        # (reference: fluid/dataloader/dataloader_iter.py's timeout +
+        # SIGCHLD handling).
+        while True:
+            try:
+                item = self._q.get(timeout=1.0)
+                break
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    try:  # sentinel may have raced in just before death
+                        item = self._q.get_nowait()
+                        break
+                    except queue.Empty:
+                        if self._err is not None:
+                            raise self._err
+                        raise StopIteration from None
         if item is self._sentinel:
             if self._err is not None:
                 raise self._err
@@ -200,9 +221,14 @@ class DataLoader:
 
         from .shm_ring import ShmRing
 
+        import uuid
+
         batches = list(self.batch_sampler)
         nw = self.num_workers
-        ring_name = f"/pt_dl_{os.getpid()}_{id(self)}"
+        # uuid per iteration: ptshm_create starts with shm_unlink(name), so
+        # a name reused across concurrent/back-to-back iterators of the same
+        # DataLoader would destroy the live ring of the earlier one.
+        ring_name = f"/pt_dl_{os.getpid()}_{uuid.uuid4().hex[:12]}"
         ring = ShmRing(ring_name, n_slots=max(2 * nw, 4),
                        slot_size=self._shm_slot_size)
         methods = mp.get_all_start_methods()
@@ -279,6 +305,8 @@ class DataLoader:
         finally:
             for p in procs:
                 p.terminate()
+            for p in procs:
+                p.join(timeout=5)
             ring.close()
 
     def __iter__(self):

@@ -36,10 +36,23 @@ def _on_tpu() -> bool:
         return False
 
 
-# =========================== flash attention =================================
+def _i0():
+    """int32 zero for BlockSpec index maps: under jax_enable_x64 a bare
+    python 0 lowers as an i64 constant, which Mosaic rejects."""
+    return jnp.int32(0)
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
-                  block_k, seq_len):
+
+# =========================== flash attention =================================
+#
+# Forward + backward both run as Pallas kernels wired together with
+# jax.custom_vjp (FlashAttention-2 style): the forward emits the row
+# logsumexp, the backward recomputes score blocks from (q, k, lse) so the
+# full [T, T] matrix never exists in HBM in either pass. Replaces the
+# reference's dynloaded libflashattn fwd/bwd pair
+# (`phi/kernels/gpu/flash_attn_kernel.cu`, `flash_attn_grad_kernel.cu`).
+
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                      block_q, block_k, seq_len):
     head_dim = q_ref.shape[-1]
     q = q_ref[:].astype(jnp.float32) * scale
     q_blk = pl.program_id(1)
@@ -48,21 +61,25 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, head_dim), jnp.float32)
 
+    # All index arithmetic pinned to int32: under jax_enable_x64, bare python
+    # ints lower as i64 constants, which Mosaic rejects next to i32
+    # program_ids.
+    bq, bk = jnp.int32(block_q), jnp.int32(block_k)
     if causal:
-        hi = jax.lax.div(q_blk * block_q + block_q + block_k - 1, block_k)
-        hi = jnp.minimum(hi, seq_len // block_k)
+        hi = (q_blk * bq + bq + bk - jnp.int32(1)) // bk
+        hi = jnp.minimum(hi, jnp.int32(seq_len // block_k))
     else:
-        hi = seq_len // block_k
+        hi = jnp.int32(seq_len // block_k)
 
     def body(i, carry):
         m, l, acc = carry
-        k = k_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
-        v = v_ref[pl.ds(i * block_k, block_k), :].astype(jnp.float32)
+        k = k_ref[pl.ds(i * bk, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(i * bk, block_k), :].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32)
         if causal:
-            qpos = q_blk * block_q + jax.lax.broadcasted_iota(
+            qpos = q_blk * bq + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            kpos = i * block_k + jax.lax.broadcasted_iota(
+            kpos = i * bk + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1)
             s = jnp.where(qpos >= kpos, s, -jnp.inf)
         m_new = jnp.maximum(m, s.max(axis=-1, keepdims=True))
@@ -73,7 +90,167 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, scale, causal, block_q,
         return m_new, l_new, acc_new
 
     m, l, acc = jax.lax.fori_loop(0, hi, body, (m0, l0, acc0))
-    o_ref[:] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, *, scale, causal, block_q, block_k, seq_len):
+    q = q_ref[:].astype(jnp.float32)
+    do = do_ref[:].astype(jnp.float32)
+    lse = lse_ref[:]
+    delta = delta_ref[:]
+    q_blk = pl.program_id(1)
+
+    bq, bk = jnp.int32(block_q), jnp.int32(block_k)
+    if causal:
+        hi = (q_blk * bq + bq + bk - jnp.int32(1)) // bk
+        hi = jnp.minimum(hi, jnp.int32(seq_len // block_k))
+    else:
+        hi = jnp.int32(seq_len // block_k)
+
+    def body(i, dq):
+        k = k_ref[pl.ds(i * bk, block_k), :].astype(jnp.float32)
+        v = v_ref[pl.ds(i * bk, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = q_blk * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = i * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros_like(q)
+    dq = jax.lax.fori_loop(0, hi, body, dq0)
+    dq_ref[:] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                          seq_len):
+    k = k_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    k_blk = pl.program_id(1)
+
+    bq, bk = jnp.int32(block_q), jnp.int32(block_k)
+    lo = (k_blk * bk) // bq if causal else jnp.int32(0)
+    n_q = jnp.int32(seq_len // block_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[pl.ds(i * bq, block_q), :].astype(jnp.float32)
+        do = do_ref[pl.ds(i * bq, block_q), :].astype(jnp.float32)
+        lse = lse_ref[pl.ds(i * bq, block_q), :]
+        delta = delta_ref[pl.ds(i * bq, block_q), :]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            kpos = k_blk * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(qpos >= kpos, s, -jnp.inf)
+        p = jnp.exp(s - lse)
+        dv_new = dv + jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_new = dk + jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros_like(k)
+    dv0 = jnp.zeros_like(v)
+    dk, dv = jax.lax.fori_loop(lo, n_q, body, (dk0, dv0))
+    dk_ref[:] = (dk * scale).astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _flash_fwd_call(q, k, v, causal, scale, block_q, block_k):
+    """q,k,v: [BN, T, H] flattened batch*heads. Returns (out, lse[BN,T,1])."""
+    BN, T, H = q.shape
+    grid = (BN, T // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_flash_fwd_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=T),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, H), lambda b, i: (b, i, _i0())),
+            pl.BlockSpec((None, T, H), lambda b, i: (b, _i0(), _i0())),
+            pl.BlockSpec((None, T, H), lambda b, i: (b, _i0(), _i0())),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, H), lambda b, i: (b, i, _i0())),
+            pl.BlockSpec((None, block_q, 1), lambda b, i: (b, i, _i0())),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BN, T, H), q.dtype),
+            jax.ShapeDtypeStruct((BN, T, 1), jnp.float32),
+        ],
+    )(q, k, v)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_flat(q, k, v, causal, scale, block_q, block_k):
+    return _flash_fwd_call(q, k, v, causal, scale, block_q, block_k)[0]
+
+
+def _flash_flat_fwd(q, k, v, causal, scale, block_q, block_k):
+    out, lse = _flash_fwd_call(q, k, v, causal, scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_flat_bwd(causal, scale, block_q, block_k, res, do):
+    q, k, v, out, lse = res
+    BN, T, H = q.shape
+    # delta_i = rowsum(do * o): cheap elementwise-reduce, XLA fuses it.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)
+    common = dict(scale=scale, causal=causal, block_q=block_q,
+                  block_k=block_k, seq_len=T)
+    full = lambda b, i: (b, _i0(), _i0())  # noqa: E731
+    row = lambda b, i: (b, i, _i0())  # noqa: E731
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **common),
+        grid=(BN, T // block_q),
+        in_specs=[
+            pl.BlockSpec((None, block_q, H), row),
+            pl.BlockSpec((None, T, H), full),
+            pl.BlockSpec((None, T, H), full),
+            pl.BlockSpec((None, block_q, H), row),
+            pl.BlockSpec((None, block_q, 1), row),
+            pl.BlockSpec((None, block_q, 1), row),
+        ],
+        out_specs=pl.BlockSpec((None, block_q, H), row),
+        out_shape=jax.ShapeDtypeStruct((BN, T, H), q.dtype),
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **common),
+        grid=(BN, T // block_k),
+        in_specs=[
+            pl.BlockSpec((None, T, H), full),
+            pl.BlockSpec((None, block_k, H), row),
+            pl.BlockSpec((None, block_k, H), row),
+            pl.BlockSpec((None, T, H), full),
+            pl.BlockSpec((None, T, 1), full),
+            pl.BlockSpec((None, T, 1), full),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_k, H), row),
+            pl.BlockSpec((None, block_k, H), row),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BN, T, H), k.dtype),
+            jax.ShapeDtypeStruct((BN, T, H), v.dtype),
+        ],
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_flat.defvjp(_flash_flat_fwd, _flash_flat_bwd)
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "scale", "block_q",
@@ -91,19 +268,7 @@ def _flash_attention_tpu(q, k, v, causal=False, scale=None, block_q=256,
         return x.transpose(0, 2, 1, 3).reshape(B * N, x.shape[1], H)
 
     qf, kf, vf = reshape_in(q), reshape_in(k), reshape_in(v)
-    grid = (B * N, T // block_q)
-    out = pl.pallas_call(
-        functools.partial(_flash_kernel, scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len=T),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, block_q, H), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((None, T, H), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((None, T, H), lambda b, i: (b, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((None, block_q, H), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * N, T, H), q.dtype),
-    )(qf, kf, vf)
+    out = _flash_flat(qf, kf, vf, causal, scale, block_q, block_k)
     return out.reshape(B, N, T, H).transpose(0, 2, 1, 3)
 
 

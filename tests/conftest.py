@@ -1,18 +1,31 @@
-"""Test env: force a virtual 8-device CPU mesh BEFORE jax initializes.
+"""Test env: force a virtual 8-device CPU mesh BEFORE jax backend init.
 
 Mirrors the reference's fake_cpu_device.h pattern (SURVEY §4): distributed/
 sharding tests run against virtual devices, no TPU pod needed.
 
-Note: on hosts with the axon TPU tunnel, prefer launching as
-    PALLAS_AXON_POOL_IPS= python -m pytest tests/ -q
-so the axon PJRT plugin is never registered (it is registered from
-sitecustomize at interpreter start, before this file runs, and its
-initialization contacts the TPU tunnel).
+Two layers of forcing are required because on hosts with a TPU-tunnel PJRT
+plugin, `jax` is imported at interpreter start from sitecustomize — so env
+vars set here are already too late for jax.config's env-seeded defaults.
+`jax.config.update` is authoritative after import; the env vars still cover
+worker subprocesses (DataLoader workers, launch tests) that start fresh
+interpreters.
 """
 import os
 
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "--xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except RuntimeError:  # a backend already initialized — reset, then retry
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
+    jax.config.update("jax_num_cpu_devices", 8)
