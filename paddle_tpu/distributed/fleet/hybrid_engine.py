@@ -14,16 +14,21 @@ collectives):
                            `param.sharding_spec` (('mp' on in/out dims);
                            XLA inserts the all-reduces the reference issued
                            manually via mp_ops._mp_allreduce)
-  pp (pipeline parallel)   REAL pipelined schedule: uniform transformer
-                           blocks are stacked [L, ...] and layer-sharded
-                           over 'pp'; a `shard_map(axis_names={'pp'})`
-                           region runs the GPipe schedule — microbatches
-                           rotate stage-to-stage via `lax.ppermute` over ICI
-                           (the p2p_communication.py equivalent), while
+  pp (pipeline parallel)   REAL 1F1B schedule: uniform transformer blocks
+                           are stacked [L, ...] and layer-sharded over
+                           'pp'; ONE `shard_map(axis_names={'pp'})` region
+                           runs forward, loss AND backward in lockstep —
+                           each tick every stage does one fwd slot and one
+                           bwd slot (explicit jax.vjp), so at most
+                           2·pp−1 microbatch inputs are live per stage
+                           (vs M for GPipe) and stage transfer is p2p-only
+                           `lax.ppermute` over ICI (the
+                           p2p_communication.py equivalent). Backward
+                           recomputes the stage forward from its saved
+                           input (reference recompute semantics), while
                            dp/sharding/mp stay in GSPMD "auto" mode inside.
-                           `jax.grad` through the region yields the reverse
-                           pipeline automatically (cooldown = transposed
-                           ppermute) — no hand-written 1F1B bookkeeping.
+                           Matches pipeline_parallel.py:117's 1F1B memory
+                           behavior without per-microbatch Python.
   sharding (ZeRO)          stage1: optimizer moments sharded over 'sharding'
                            (+ batch axis). GSPMD reshards on the fly —
                            the reference's GroupShardedOptimizerStage2.
@@ -148,9 +153,13 @@ class HybridParallelEngine:
             for i, (s, d) in enumerate(zip(parts, shape)):
                 if s is None and d % sh_deg == 0:
                     parts[i] = "sharding"
-                    break
-                if isinstance(s, str) and s == "pp" and False:
-                    pass
+                    return P(*parts)
+            import warnings
+
+            warnings.warn(
+                f"ZeRO stage-1: no dim of {tuple(shape)} divides "
+                f"sharding_degree={sh_deg}; optimizer state for this param "
+                "stays replicated", stacklevel=2)
             return P(*parts)
 
         self.param_names = [f"__stack__.{k}" for k in block_keys] + \
@@ -192,13 +201,10 @@ class HybridParallelEngine:
             t._data = a
         return saved
 
-    def _forward_loss(self, params, tokens, labels):
-        """Pure loss over (params dict, batch). Tape disabled: jax.grad is
-        the differentiator (the tape can't cross lax.scan boundaries)."""
-        n_stack = len(self.block_keys)
-        stack_arrays = {k: params[i] for i, k in enumerate(self.block_keys)}
-        other_arrays = params[n_stack:]
-        saved = self._bind(self.other_tensors, other_arrays)
+    def _make_run_block(self):
+        """Pure per-block forward over (x, layer_arrays), optionally
+        remat-wrapped. Returns (run_block, block_tensors, saved_arrays);
+        caller restores via _bind(block_tensors, saved_arrays)."""
         block_tensors = [self.block0.state_dict()[k] for k in self.block_keys]
         saved_blk = [t._data for t in block_tensors]
         use_remat = bool(self.strategy and self.strategy.recompute) or \
@@ -213,20 +219,29 @@ class HybridParallelEngine:
 
         if use_remat:
             run_block = jax.checkpoint(run_block)
+        return run_block, block_tensors, saved_blk
 
+    def _forward_loss(self, params, tokens, labels):
+        """Pure loss over (params dict, batch). Tape disabled: jax.grad is
+        the differentiator (the tape can't cross lax.scan boundaries)."""
+        n_stack = len(self.block_keys)
+        stack_arrays = {k: params[i] for i, k in enumerate(self.block_keys)}
+        other_arrays = params[n_stack:]
+        saved = self._bind(self.other_tensors, other_arrays)
+        run_block, block_tensors, saved_blk = self._make_run_block()
+
+        assert self.pp == 1, "pp>1 uses _pipeline_loss_and_grads"
         try:
             with autograd._scoped(False):
                 x = self._embed(Tensor(tokens))
                 xa = jax.lax.with_sharding_constraint(
                     x._data, NamedSharding(self.mesh,
                                            P(("dp", "sharding"), None, None)))
-                if self.pp == 1:
-                    def body(carry, layer_arrays):
-                        return run_block(carry, layer_arrays), None
 
-                    xa, _ = jax.lax.scan(body, xa, stack_arrays)
-                else:
-                    xa = self._pipelined(xa, stack_arrays, run_block)
+                def body(carry, layer_arrays):
+                    return run_block(carry, layer_arrays), None
+
+                xa, _ = jax.lax.scan(body, xa, stack_arrays)
                 loss = self._head_loss(xa, labels)
             return loss
         finally:
@@ -250,77 +265,177 @@ class HybridParallelEngine:
         return -ll.mean()
 
     # --------------------------------------------------------------- pipeline
-    def _pipelined(self, xa, stack_arrays, run_block):
-        """GPipe schedule inside shard_map(axis_names={'pp'}).
+    def _pipeline_loss_and_grads(self, params, tokens, labels):
+        """1F1B pipeline in one shard_map(axis_names={'pp'}) region, returning
+        (loss, grads-matching-params) directly — forward, per-microbatch loss
+        and hand-scheduled backward all inside.
 
         Reference equivalent: PipelineParallel.forward_backward_pipeline
-        (fleet/meta_parallel/pipeline_parallel.py:117) + p2p send/recv
-        (pp_utils/p2p_communication.py) — here one compiled region; the
-        backward schedule falls out of jax.grad's transposition of
-        ppermute+scan. Microbatches rotate stage-to-stage via ppermute over
-        ICI; dp/sharding/mp axes stay in GSPMD auto mode inside the region.
-        Returns the last stage's activations (head/loss run outside, in
-        GSPMD land, so tied embeddings shard over mp)."""
-        pp = self.pp
-        M = self.accumulate_steps
-        B = xa.shape[0]
-        mb = B // M
-        xmb = xa.reshape(M, mb, *xa.shape[1:])
+        (fleet/meta_parallel/pipeline_parallel.py:117 — 1F1B) + p2p send/recv
+        (pp_utils/p2p_communication.py), collapsed into one compiled SPMD
+        program. Schedule (lockstep; each tick = one fwd slot + one bwd
+        slot on every stage):
 
-        def stage_fn(x_all, local_stack):
-            # x_all: [M, mb, T, D] (replicated over pp); local_stack leading
-            # dim = n_layers/pp (this stage's slice)
+          stage s runs fwd of microbatch i at tick  i + s
+          stage s runs bwd of microbatch i at tick  i + 2(pp-1) - s
+          (last stage: fwd and bwd of i in the SAME tick — classic 1F1B)
+
+        Stage s therefore holds at most 2(pp-1-s)+1 ≤ 2·pp−1 in-flight
+        microbatch INPUTS (not full activations: backward recomputes the
+        stage forward from its saved input under jax.vjp, the recompute
+        trade the reference makes via recompute_hybrid.py). Activations and
+        cotangents move stage-to-stage via p2p ppermute only; the sole
+        collectives are the final scalar-loss/shared-weight-grad psums over
+        'pp' (the reference's tied-embedding allreduce,
+        pp_layers.py shared-weight groups). dp/sharding/mp stay GSPMD-auto
+        inside the region."""
+        n_stack = len(self.block_keys)
+        stack_arrays = {k: params[i] for i, k in enumerate(self.block_keys)}
+        other_arrays = list(params[n_stack:])
+        pp, M = self.pp, self.accumulate_steps
+        B = tokens.shape[0]
+        mb = B // M
+        tok_all = tokens.reshape(M, mb, *tokens.shape[1:])
+        lab_all = labels.reshape(M, mb, *labels.shape[1:])
+        BUF = min(M, 2 * pp - 1)
+
+        run_block, block_tensors, saved_blk = self._make_run_block()
+        saved_other = [t._data for t in self.other_tensors]
+
+        def embed_fn(oth, toks):
+            self._bind(self.other_tensors, oth)
+            return self._embed(Tensor(toks))._data
+
+        def head_fn(oth, xa, lab):
+            self._bind(self.other_tensors, oth)
+            return self._head_loss(xa, lab)
+
+        def run_local(x, stk):
+            def body(c, la):
+                return run_block(c, la), None
+
+            out, _ = jax.lax.scan(body, x, stk)
+            return out
+
+        def stage_fn(tok_all, lab_all, local_stack, other):
+            # tok/lab: [M, mb, T] replicated over pp (tokens are cheap —
+            # activations are never replicated); local_stack leading dim =
+            # n_layers/pp (this stage's slice); other replicated over pp.
             stage = jax.lax.axis_index("pp")
             is_first = stage == 0
             is_last = stage == pp - 1
 
-            def run_local(x):
-                def body(c, la):
-                    return run_block(c, la), None
+            x_sds = jax.eval_shape(embed_fn, other, tok_all[0])
+            zero_act = jnp.zeros(x_sds.shape, x_sds.dtype)
+            fwd_perm = [(i, i + 1) for i in range(pp - 1)]
+            bwd_perm = [(i + 1, i) for i in range(pp - 1)]
 
-                out, _ = jax.lax.scan(body, x, local_stack)
-                return out
+            carry0 = (
+                zero_act,                                   # recv_fwd
+                zero_act,                                   # recv_bwd
+                jnp.zeros((BUF,) + x_sds.shape, x_sds.dtype),  # saved inputs
+                jnp.zeros((), jnp.float32),                 # loss acc
+                jax.tree.map(jnp.zeros_like, local_stack),  # trunk grads
+                jax.tree.map(jnp.zeros_like, other),        # shared grads
+            )
 
             def tick(carry, t):
-                recv, outs = carry
-                inject = jnp.clip(t, 0, M - 1)
-                x_in = jnp.where(is_first, x_all[inject], recv)
-                act = run_local(x_in)
-                # microbatch this stage just finished
-                mb_idx = t - stage
-                valid = (mb_idx >= 0) & (mb_idx < M) & is_last
-                upd = jax.lax.dynamic_update_index_in_dim(
-                    outs, act, jnp.clip(mb_idx, 0, M - 1), 0)
-                outs = jnp.where(valid, upd, outs)
-                sent = jax.lax.ppermute(
-                    act, "pp", [(i, i + 1) for i in range(pp - 1)])
-                return (sent, outs), None
+                recv_f, recv_b, buf, loss_acc, d_local, d_other = carry
+                # ---------------------------------------------- fwd slot
+                fi = t - stage
+                fvalid = (fi >= 0) & (fi < M)
+                fic = jnp.clip(fi, 0, M - 1)
+                # NOTE: every stage computes the (cheap) embedding and the
+                # masked head below — lax.cond on the per-device stage index
+                # makes XLA's SPMD partitioner abort when the branch holds
+                # GSPMD-sharded collectives, so lockstep where-select it is.
+                x_in = jnp.where(is_first, embed_fn(other, tok_all[fic]),
+                                 recv_f)
+                act = run_local(x_in, local_stack)
+                slot = fic % BUF
+                old = jax.lax.dynamic_index_in_dim(buf, slot, 0,
+                                                   keepdims=False)
+                buf = jax.lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(fvalid, x_in, old), slot, 0)
+                # ---------------------------------------------- bwd slot
+                bi = t - (2 * (pp - 1) - stage)
+                bvalid = (bi >= 0) & (bi < M)
+                bic = jnp.clip(bi, 0, M - 1)
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    buf, bic % BUF, 0, keepdims=False)
+                act_b, vjp_local = jax.vjp(run_local, x_saved, local_stack)
 
-            recv0 = jnp.zeros_like(x_all[0])
-            outs0 = jnp.zeros_like(x_all)
-            (_, outs), _ = jax.lax.scan(tick, (recv0, outs0),
-                                        jnp.arange(M + pp - 1))
-            # only the last stage holds real outputs; make them uniform
-            outs = jax.lax.psum(jnp.where(is_last, outs, 0.0), "pp")
-            return outs
+                # Head fwd+bwd (the vocab matmul): the last stage seeds
+                # backward from the loss, upstream stages from the received
+                # cotangent (their head output gets cotangent 0).
+                loss_b, (d_oth_h, d_act_h) = jax.value_and_grad(
+                    lambda oth, a: head_fn(oth, a, lab_all[bic]),
+                    argnums=(0, 1))(other, act_b)
+                ones = jnp.where(is_last, 1.0, 0.0)
+                d_oth_h = jax.tree.map(lambda g: g * ones, d_oth_h)
+                ct = jnp.where(is_last, d_act_h, recv_b)
+                dx, d_stk = vjp_local(ct)
 
-        specs = {k: P(*(["pp"] + [None] * (self.stack_arrays[k].ndim - 1)))
-                 for k in self.block_keys}
-        sm = jax.shard_map(
-            stage_fn, mesh=self.mesh,
-            in_specs=(P(), specs),
-            out_specs=P(),
-            axis_names={"pp"}, check_vma=False)
-        outs = sm(xmb, stack_arrays)
-        return outs.reshape(B, *xa.shape[1:])
+                # First stage: push the input cotangent through the
+                # embedding to get table/position grads.
+                _, vjp_e = jax.vjp(
+                    lambda oth: embed_fn(oth, tok_all[bic]), other)
+                (d_oth_e,) = vjp_e(
+                    jnp.where(is_first, dx, jnp.zeros_like(dx)))
+                d_local = jax.tree.map(
+                    lambda a, g: a + jnp.where(bvalid, g, 0.0),
+                    d_local, d_stk)
+                d_other = jax.tree.map(
+                    lambda a, g, ge: a + jnp.where(bvalid, g + ge, 0.0),
+                    d_other, d_oth_h, d_oth_e)
+                loss_acc = loss_acc + jnp.where(
+                    bvalid & is_last, loss_b, 0.0)
+                # ------------------------------------------- p2p transfer
+                recv_f = jax.lax.ppermute(act, "pp", fwd_perm)
+                recv_b = jax.lax.ppermute(dx, "pp", bwd_perm)
+                return (recv_f, recv_b, buf, loss_acc, d_local,
+                        d_other), None
+
+            n_ticks = M + 2 * (pp - 1)
+            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+            _, _, _, loss_acc, d_local, d_other = carry
+            loss = jax.lax.psum(loss_acc, "pp") / M
+            # shared (embedding/head/norm) grads: tied-weight allreduce
+            d_other = jax.tree.map(
+                lambda g: jax.lax.psum(g, "pp") / M, d_other)
+            d_local = jax.tree.map(lambda g: g / M, d_local)
+            return loss, d_local, d_other
+
+        stack_specs = {
+            k: P(*(["pp"] + [None] * (self.stack_arrays[k].ndim - 1)))
+            for k in self.block_keys}
+        other_in = [P() for _ in other_arrays]
+        try:
+            with autograd._scoped(False):
+                sm = jax.shard_map(
+                    stage_fn, mesh=self.mesh,
+                    in_specs=(P(), P(), stack_specs, other_in),
+                    out_specs=(P(), stack_specs, other_in),
+                    axis_names={"pp"}, check_vma=False)
+                loss, d_stack, d_other = sm(tok_all, lab_all, stack_arrays,
+                                            other_arrays)
+        finally:
+            self._bind(block_tensors, saved_blk)
+            self._bind(self.other_tensors, saved_other)
+        grads = [d_stack[k] for k in self.block_keys] + list(d_other)
+        return loss, grads
 
     # ---------------------------------------------------------------- compile
     def _compile(self):
         opt = self.optimizer
 
         def step(params, accs, step_count, tokens, labels):
-            loss, grads = jax.value_and_grad(self._forward_loss)(
-                params, tokens, labels)
+            if self.pp == 1:
+                loss, grads = jax.value_and_grad(self._forward_loss)(
+                    params, tokens, labels)
+            else:
+                loss, grads = self._pipeline_loss_and_grads(
+                    params, tokens, labels)
             new_params = list(params)
             new_accs = {an: list(accs[an]) for an in self._acc_names}
             step_count = step_count + 1.0

@@ -21,7 +21,8 @@ class TensorParallel(DataParallel):
 
 class PipelineParallel(DataParallel):
     """Dygraph PipelineParallel facade (pipeline_parallel.py:31). The actual
-    1F1B compiled schedule lives in fleet.HybridParallelEngine._pipelined;
+    1F1B compiled schedule lives in
+    fleet.HybridParallelEngine._pipeline_loss_and_grads;
     use fleet.distributed_model(model, optimizer=...) to obtain the engine
     with train_batch()."""
 

@@ -3,6 +3,8 @@
 create parameters directly in the default main program."""
 from __future__ import annotations
 
+import itertools
+
 import numpy as np
 
 from ..core import dtype as dtypes
@@ -11,13 +13,19 @@ from . import program as prog_mod
 from .program import Variable
 
 
+# Globally-unique auto names (reference `utils/unique_name.generate`): param
+# values live in the process-wide global_scope keyed by name, so names
+# scoped per-Program would collide across programs (stale shapes resurface).
+_param_counter = itertools.count()
+
+
 def _make_param(shape, dtype, attr=None, is_bias=False, name_hint="w"):
     attr = ParamAttr._to_attr(attr)
     init = attr.initializer or (Constant(0.0) if is_bias else XavierUniform())
     arr = init(tuple(shape), dtype)
     prog = prog_mod.default_main_program()
     v = Variable(list(shape), dtypes.convert_dtype(dtype),
-                 name=attr.name or f"{name_hint}_{len(prog.params)}",
+                 name=attr.name or f"{name_hint}_{next(_param_counter)}",
                  is_param=True, trainable=attr.trainable)
     prog._add_var(v)
     prog.params.append((v, arr))
