@@ -3,45 +3,63 @@
 Reference: `python/paddle/distributed/fleet/layers/mpu/mp_layers.py:35`
 (VocabParallelEmbedding), `:173` (ColumnParallelLinear), `:343`
 (RowParallelLinear), `:524` (ParallelCrossEntropy), with comm primitives
-`mpu/mp_ops.py` (_c_identity/_c_concat/_mp_allreduce).
+`mpu/mp_ops.py` (_c_identity/_c_concat/_c_split/_mp_allreduce).
 
-TPU re-design: these layers hold the FULL logical weight and annotate it
-with a PartitionSpec over the 'mp' axis. Inside a pjit step, GSPMD shards
-the parameter and inserts exactly the collectives the reference issues by
-hand: Column (weight [in, out/mp]) needs no comm forward / allreduce
-backward = _c_identity; Row (weight [in/mp, out]) needs allreduce forward =
-_mp_allreduce. Eagerly (single chip) they are plain dense layers — same
-numerics, so mp-degree never changes results (the reference's correctness
-oracle for its hybrid tests).
+TPU re-design: the layers hold the FULL logical weight, placed on the fleet
+mesh with a real NamedSharding over the 'mp' axis (mp_ops.shard_parameter).
+That makes them genuinely parallel in BOTH modes:
+
+- eager: per-op jit partitions every op touching the sharded weight —
+  a Column matmul runs on [in, out/mp] shards with no forward comm, a Row
+  matmul contracts the sharded dim and XLA inserts the allreduce
+  (_mp_allreduce), exactly the reference's manual schedule;
+- compiled (engine/pjit): GSPMD propagates the same layouts whole-program.
+
+mp-degree never changes numerics (the reference's correctness oracle for
+hybrid_parallel_mp_model.py): weights are initialized full-size and then
+sharded, so results match the dense single-device run bit-for-bit modulo
+reduction order.
 """
 from __future__ import annotations
 
-from ... import nn, ops
-from ...nn import functional as F
+from ... import nn
+from ...core.tensor import Tensor
+from . import mp_ops
+from .mp_ops import (_c_concat, _c_identity, _c_softmax_with_cross_entropy,
+                     _c_split, _mp_allreduce)
 
 __all__ = ["VocabParallelEmbedding", "ColumnParallelLinear",
            "RowParallelLinear", "ParallelCrossEntropy"]
 
 
 class VocabParallelEmbedding(nn.Layer):
+    """Vocab dim sharded over mp (c_embedding semantics,
+    fluid/operators/collective/c_embedding_op.cc): each device owns
+    num_embeddings/mp rows; out-of-shard ids hit zeros and the psum the
+    partitioner inserts for the sharded gather assembles full rows."""
+
     def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
                  mp_group=None, name=None):
         super().__init__()
         self.embedding = nn.Embedding(num_embeddings, embedding_dim,
                                       weight_attr=weight_attr)
-        # vocab dim sharded over mp (c_embedding semantics,
-        # fluid/operators/collective/c_embedding_op.cc)
         self.embedding.weight.sharding_spec = ("mp", None)
+        mp_ops.shard_parameter(self.embedding.weight)
 
     @property
     def weight(self):
         return self.embedding.weight
 
     def forward(self, x):
+        mp_ops.ensure_on_mesh(x)
         return self.embedding(x)
 
 
 class ColumnParallelLinear(nn.Layer):
+    """Weight [in, out/mp]: no forward comm (input marked _c_identity →
+    backward allreduce); optional gather_output all-gathers the sharded
+    output (reference mp_layers.py:173)."""
+
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, gather_output=True, mp_group=None,
                  fuse_matmul_bias=False, name=None):
@@ -50,8 +68,10 @@ class ColumnParallelLinear(nn.Layer):
                                 weight_attr=weight_attr,
                                 bias_attr=None if has_bias else False)
         self.linear.weight.sharding_spec = (None, "mp")
+        mp_ops.shard_parameter(self.linear.weight)
         if self.linear.bias is not None:
             self.linear.bias.sharding_spec = ("mp",)
+            mp_ops.shard_parameter(self.linear.bias)
         self.gather_output = gather_output
 
     @property
@@ -63,10 +83,27 @@ class ColumnParallelLinear(nn.Layer):
         return self.linear.bias
 
     def forward(self, x):
-        return self.linear(x)
+        if mp_ops.axis_in_scope():
+            # manual shard_map region: tape is off, arrays are shard-local
+            x = Tensor(_c_identity(x._data))
+            out = self.linear(x)
+            if self.gather_output:
+                out = Tensor(_c_concat(out._data))
+            return out
+        mp_ops.ensure_on_mesh(x)
+        out = self.linear(x)
+        if self.gather_output:
+            # layout-only (identity value): safe to update in place without
+            # disturbing the autograd tape
+            out._data = _c_concat(out._data)
+        return out
 
 
 class RowParallelLinear(nn.Layer):
+    """Weight [in/mp, out]: the contraction dim is sharded, so the matmul
+    produces partial sums that XLA allreduces (_mp_allreduce forward /
+    identity backward — reference mp_layers.py:343)."""
+
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=False, mp_group=None,
                  fuse_matmul_bias=False, name=None):
@@ -75,6 +112,7 @@ class RowParallelLinear(nn.Layer):
                                 weight_attr=weight_attr,
                                 bias_attr=None if has_bias else False)
         self.linear.weight.sharding_spec = ("mp", None)
+        mp_ops.shard_parameter(self.linear.weight)
         self.input_is_parallel = input_is_parallel
 
     @property
@@ -86,18 +124,44 @@ class RowParallelLinear(nn.Layer):
         return self.linear.bias
 
     def forward(self, x):
+        if mp_ops.axis_in_scope():
+            if not self.input_is_parallel:
+                x = Tensor(_c_split(x._data))
+            out = self.linear(x)
+            return Tensor(_mp_allreduce(out._data))
+        mp_ops.ensure_on_mesh(x)
+        if not self.input_is_parallel and isinstance(x, Tensor):
+            # layout-only reshard of the contraction dim; value unchanged,
+            # tape untouched
+            x._data = _c_split(x._data)
         return self.linear(x)
 
 
 class ParallelCrossEntropy(nn.Layer):
-    """Reference mp_layers.py:524 → c_softmax_with_cross_entropy (vocab-
-    sharded logits). GSPMD computes the sharded logsumexp with the same
-    comm pattern when logits carry an 'mp' sharding."""
+    """Reference mp_layers.py:524 → c_softmax_with_cross_entropy: the
+    logsumexp over a vocab-sharded logits tensor is computed shard-locally
+    (pmax of local max, psum of local exp-sums, masked label-logit psum)
+    inside manual mp regions; under GSPMD the partitioner emits the same
+    pattern for the sharded reductions. Returns per-token loss [..., 1]."""
 
     def __init__(self, mp_group=None, name=None, ignore_index=-100):
         super().__init__()
         self.ignore_index = ignore_index
 
     def forward(self, input, label):
-        return F.cross_entropy(input, label, reduction="none",
-                               ignore_index=self.ignore_index)
+        from ...core.dispatch import forward as dispatch_forward
+
+        lab = label if isinstance(label, Tensor) else Tensor(label)
+        if lab._data.ndim == input._data.ndim:  # [..., 1] label form
+            lab = Tensor(lab._data[..., 0])
+
+        mp_ops.ensure_on_mesh(input)
+        mp_ops.ensure_on_mesh(lab)
+
+        def f(logits, labels):
+            loss = _c_softmax_with_cross_entropy(
+                logits, labels, ignore_index=self.ignore_index)
+            return loss[..., None]
+
+        return dispatch_forward(f, (input, lab),
+                                name="c_softmax_with_cross_entropy")
