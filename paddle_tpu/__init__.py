@@ -105,6 +105,8 @@ from .framework import save, load, in_dynamic_mode, enable_static, \
     disable_static  # noqa: F401
 from . import framework  # noqa: F401
 from . import device  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
 
 
 def is_grad_enabled_():  # pragma: no cover - back-compat alias

@@ -34,6 +34,34 @@ static_recorder = None
 # into the traced function without materializing throwaway casted arrays.
 amp_cast_hook = None
 
+# Op-coverage recorder: PADDLE_TPU_OP_COVERAGE=<path> records every op name
+# dispatched in this process and writes the set at exit — consumed by
+# tools/gen_ops_coverage.py to mark ops as exercised by the test suite.
+_coverage_sink = None
+
+
+def _init_coverage_sink():
+    global _coverage_sink
+    import atexit
+    import os
+
+    path = os.environ.get("PADDLE_TPU_OP_COVERAGE")
+    if not path:
+        return
+
+    _coverage_sink = set()
+
+    def _flush():
+        # O_APPEND write: atomic per-write on POSIX, so concurrent process
+        # exits interleave instead of clobbering (the reader dedupes)
+        with open(path, "a") as f:
+            f.write("\n".join(sorted(_coverage_sink)) + "\n")
+
+    atexit.register(_flush)
+
+
+_init_coverage_sink()
+
 
 def unwrap(x):
     return x._data if isinstance(x, Tensor) else x
@@ -77,6 +105,9 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
     """
     attrs = attrs or {}
     name = name or getattr(fn, "__name__", "op")
+
+    if _coverage_sink is not None:
+        _coverage_sink.add(name)
 
     if static_recorder is not None:
         out = static_recorder(fn, name, inputs, attrs)

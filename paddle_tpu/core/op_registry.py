@@ -1,0 +1,109 @@
+"""Op registry — the single-source-of-truth inventory of public ops.
+
+Reference: `paddle/phi/api/yaml/ops.yaml:8` + `legacy_ops.yaml` are the
+reference's op registry (args/output/infer_meta/kernel/backward per op,
+consumed by codegen). The TPU build needs no codegen — every op lowers
+through the one dispatch point (`core/dispatch.forward`) — so the registry
+here is pure metadata: it enumerates the public op surface by introspection,
+records where each op lives, whether it is differentiable (jax.vjp-capable),
+and its AMP list membership, and it is what `tools/gen_ops_coverage.py`
+diffs against the reference YAMLs to produce OPS_COVERAGE.md.
+
+InferMeta equivalence: `jax.eval_shape` over the same callable (used by the
+static recorder) — per-op shape functions need no separate registration.
+"""
+from __future__ import annotations
+
+import dataclasses
+import inspect
+
+__all__ = ["OpSpec", "registry", "build_registry", "lookup", "all_ops"]
+
+
+@dataclasses.dataclass
+class OpSpec:
+    name: str
+    module: str
+    fn: object
+    signature: str
+    differentiable: bool
+    amp_list: str | None  # 'fp16_white' | 'fp16_black' | None
+
+
+registry: dict[str, OpSpec] = {}
+
+
+def _amp_membership():
+    try:
+        from ..amp.auto_cast import BLACK_LIST, WHITE_LIST
+
+        return {n: "fp16_white" for n in WHITE_LIST} | \
+               {n: "fp16_black" for n in BLACK_LIST}
+    except Exception:
+        return {}
+
+
+# ops that are integer/bool/index-valued (no gradient path) — everything
+# else dispatches through jax.vjp and is differentiable by construction
+_NONDIFF = {
+    "argmax", "argmin", "argsort", "nonzero", "where_index", "equal",
+    "not_equal", "less_than", "less_equal", "greater_than", "greater_equal",
+    "logical_and", "logical_or", "logical_not", "logical_xor", "isnan",
+    "isinf", "isfinite", "shape", "numel", "rank", "bincount", "unique",
+    "searchsorted", "bucketize", "one_hot", "randint", "randperm",
+    "bernoulli", "multinomial", "any", "all", "histogram", "mode",
+    "count_nonzero", "is_empty", "allclose", "equal_all", "sign",
+}
+
+_OP_MODULES = (
+    "paddle_tpu.ops.math", "paddle_tpu.ops.manipulation",
+    "paddle_tpu.ops.creation", "paddle_tpu.ops.logic",
+    "paddle_tpu.ops.linalg", "paddle_tpu.ops.activation",
+    "paddle_tpu.ops.nn_ops", "paddle_tpu.ops.random_ops",
+    "paddle_tpu.ops.methods", "paddle_tpu.ops.pallas_ops",
+    "paddle_tpu.nn.functional", "paddle_tpu.fft", "paddle_tpu.signal",
+    "paddle_tpu.linalg", "paddle_tpu.sparse", "paddle_tpu.sparse.nn.functional",
+    "paddle_tpu.incubate.nn", "paddle_tpu.distributed.collective",
+    "paddle_tpu.distributed.meta_parallel.mp_ops",
+)
+
+
+def build_registry() -> dict[str, OpSpec]:
+    """Populate from the public op modules' __all__ (idempotent)."""
+    import importlib
+
+    amp = _amp_membership()
+    for modname in _OP_MODULES:
+        try:
+            mod = importlib.import_module(modname)
+        except ImportError:
+            continue
+        names = getattr(mod, "__all__", None) or [
+            n for n in dir(mod) if not n.startswith("_")]
+        for n in names:
+            fn = getattr(mod, n, None)
+            if not callable(fn) or inspect.isclass(fn):
+                continue
+            if n in registry:  # first (most specific) module wins
+                continue
+            try:
+                sig = str(inspect.signature(fn))
+            except (TypeError, ValueError):
+                sig = "(...)"
+            registry[n] = OpSpec(
+                name=n, module=modname, fn=fn, signature=sig,
+                differentiable=n not in _NONDIFF,
+                amp_list=amp.get(n))
+    return registry
+
+
+def lookup(name: str) -> OpSpec | None:
+    if not registry:
+        build_registry()
+    return registry.get(name)
+
+
+def all_ops() -> dict[str, OpSpec]:
+    if not registry:
+        build_registry()
+    return registry

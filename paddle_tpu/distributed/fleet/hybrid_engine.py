@@ -98,6 +98,9 @@ class HybridParallelEngine:
         self.accumulate_steps = max(
             (strategy.pipeline_configs.get("accumulate_steps", 1)
              if strategy else 1), self.pp)
+        # ZeRO offload: optimizer states + master update on host
+        # (set by sharding.group_sharded_parallel(offload=True))
+        self._offload = bool(getattr(optimizer, "_sharding_offload", False))
         self._built = False
 
     # ------------------------------------------------------------------ build
@@ -150,6 +153,9 @@ class HybridParallelEngine:
                 return pspec
             # ZeRO stage-1: add 'sharding' to the first divisible free dim
             parts = list(pspec) + [None] * (len(shape) - len(list(pspec)))
+            if any(s == "sharding" or (isinstance(s, tuple) and
+                                       "sharding" in s) for s in parts):
+                return P(*parts)  # stage-3 already shards this param
             for i, (s, d) in enumerate(zip(parts, shape)):
                 if s is None and d % sh_deg == 0:
                     parts[i] = "sharding"
@@ -183,16 +189,26 @@ class HybridParallelEngine:
         self._built = True
 
     def _place_state(self):
-        """device_put state onto the mesh with its shardings."""
+        """device_put state onto the mesh with its shardings (offload:
+        optimizer states stay host-resident)."""
         def put(arr, spec):
             return jax.device_put(arr, NamedSharding(self.mesh, spec))
 
         self.param_arrays = [put(a, s) for a, s in zip(self.param_arrays,
                                                        self.param_specs)]
-        for an in self._acc_names:
-            self.acc_arrays[an] = [put(a, s) for a, s in
-                                   zip(self.acc_arrays[an], self.acc_specs)]
-        self._step_count = jnp.zeros((), jnp.float32)
+        if self._offload:
+            host = jax.devices("cpu")[0]
+            for an in self._acc_names:
+                self.acc_arrays[an] = [jax.device_put(a, host)
+                                       for a in self.acc_arrays[an]]
+            self._step_count = jax.device_put(jnp.zeros((), jnp.float32),
+                                              host)
+        else:
+            for an in self._acc_names:
+                self.acc_arrays[an] = [
+                    put(a, s) for a, s in zip(self.acc_arrays[an],
+                                              self.acc_specs)]
+            self._step_count = jnp.zeros((), jnp.float32)
 
     # ---------------------------------------------------------------- forward
     def _bind(self, tensors, arrays):
@@ -426,55 +442,79 @@ class HybridParallelEngine:
         return loss, grads
 
     # ---------------------------------------------------------------- compile
-    def _compile(self):
+    def _apply_updates(self, params, accs, step_count, grads):
+        """Optimizer update over explicit (params, accs, grads) arrays —
+        traced either inside the device step or, with offload, in a
+        host-compiled executable over CPU-resident state."""
         opt = self.optimizer
+        new_params = list(params)
+        new_accs = {an: list(accs[an]) for an in self._acc_names}
+        step_count = step_count + 1.0
+        prev = opt._opt_step
+        opt._opt_step = step_count
+        try:
+            pairs = []
+            for i, trainable in enumerate(self.trainable_mask):
+                if not trainable:
+                    continue
+                p = Tensor(params[i], stop_gradient=False)
+                p.grad = Tensor(grads[i])
+                pairs.append((i, p))
+            pg = [(p, p.grad) for _, p in pairs]
+            if opt._grad_clip is not None:
+                pg = opt._grad_clip(pg)
+            for (i, p), (_, g) in zip(pairs, pg):
+                for an in self._acc_names:
+                    opt._accumulators.setdefault(an, {})[id(p)] = \
+                        Tensor(accs[an][i])
+                opt._apply_one(p, g)
+                new_params[i] = p._data
+                for an in self._acc_names:
+                    new_accs[an][i] = opt._accumulators[an][id(p)]._data
+        finally:
+            opt._opt_step = prev
+        return new_params, new_accs, step_count
 
-        def step(params, accs, step_count, tokens, labels):
-            if self.pp == 1:
-                loss, grads = jax.value_and_grad(self._forward_loss)(
-                    params, tokens, labels)
-            else:
-                loss, grads = self._pipeline_loss_and_grads(
-                    params, tokens, labels)
-            new_params = list(params)
-            new_accs = {an: list(accs[an]) for an in self._acc_names}
-            step_count = step_count + 1.0
-            prev = opt._opt_step
-            opt._opt_step = step_count
-            try:
-                pairs = []
-                for i, trainable in enumerate(self.trainable_mask):
-                    if not trainable:
-                        continue
-                    p = Tensor(params[i], stop_gradient=False)
-                    p.grad = Tensor(grads[i])
-                    pairs.append((i, p))
-                pg = [(p, p.grad) for _, p in pairs]
-                if opt._grad_clip is not None:
-                    pg = opt._grad_clip(pg)
-                for (i, p), (_, g) in zip(pairs, pg):
-                    for an in self._acc_names:
-                        opt._accumulators.setdefault(an, {})[id(p)] = \
-                            Tensor(accs[an][i])
-                    opt._apply_one(p, g)
-                    new_params[i] = p._data
-                    for an in self._acc_names:
-                        new_accs[an][i] = opt._accumulators[an][id(p)]._data
-            finally:
-                opt._opt_step = prev
-            return loss, new_params, new_accs, step_count
-
+    def _compile(self):
         mesh = self.mesh
         p_sh = [NamedSharding(mesh, s) for s in self.param_specs]
         a_sh = {an: [NamedSharding(mesh, s) for s in self.acc_specs]
                 for an in self._acc_names}
         b_sh = NamedSharding(mesh, self.batch_spec)
         scalar = NamedSharding(mesh, P())
-        self._step = jax.jit(
-            step,
-            in_shardings=(p_sh, a_sh, scalar, b_sh, b_sh),
-            out_shardings=(scalar, p_sh, a_sh, scalar),
-            donate_argnums=(0, 1))
+
+        def loss_and_grads(params, tokens, labels):
+            if self.pp == 1:
+                return jax.value_and_grad(self._forward_loss)(
+                    params, tokens, labels)
+            return self._pipeline_loss_and_grads(params, tokens, labels)
+
+        if self._offload:
+            # Reference GroupSharded offload semantics
+            # (group_sharded_stage2.py `offload=True`): optimizer states —
+            # and the master copy of the params the update produces — live
+            # on HOST; the device executable computes only (loss, grads),
+            # grads stream to host, the update runs as a CPU executable,
+            # and fresh params stream back to the mesh. Trades step time
+            # for device memory, exactly the reference trade.
+            self._dev_grads = jax.jit(
+                loss_and_grads,
+                in_shardings=(p_sh, b_sh, b_sh),
+                out_shardings=(scalar, p_sh))
+            self._host_update = jax.jit(self._apply_updates)
+            self._step = None
+        else:
+            def step(params, accs, step_count, tokens, labels):
+                loss, grads = loss_and_grads(params, tokens, labels)
+                new_params, new_accs, step_count = self._apply_updates(
+                    params, accs, step_count, grads)
+                return loss, new_params, new_accs, step_count
+
+            self._step = jax.jit(
+                step,
+                in_shardings=(p_sh, a_sh, scalar, b_sh, b_sh),
+                out_shardings=(scalar, p_sh, a_sh, scalar),
+                donate_argnums=(0, 1))
 
     # -------------------------------------------------------------------- api
     def train_batch(self, data, optimizer=None, lr_scheduler=None,
@@ -490,6 +530,18 @@ class HybridParallelEngine:
         b_sh = NamedSharding(self.mesh, self.batch_spec)
         tokens = jax.device_put(tokens, b_sh)
         labels = jax.device_put(labels, b_sh)
+        if self._offload:
+            loss, grads = self._dev_grads(self.param_arrays, tokens, labels)
+            host = jax.devices("cpu")[0]
+            grads_h = [jax.device_put(g, host) for g in grads]
+            params_h = [jax.device_put(p, host) for p in self.param_arrays]
+            new_params, self.acc_arrays, self._step_count = \
+                self._host_update(params_h, self.acc_arrays,
+                                  self._step_count, grads_h)
+            self.param_arrays = [
+                jax.device_put(p, NamedSharding(self.mesh, s))
+                for p, s in zip(new_params, self.param_specs)]
+            return Tensor(loss)
         accs = self.acc_arrays
         loss, self.param_arrays, self.acc_arrays, self._step_count = \
             self._step(self.param_arrays, accs, self._step_count, tokens,

@@ -43,24 +43,79 @@ class _GroupShardedModel(Layer):
     def set_state_dict(self, sd, *a, **k):
         return self._layers.set_state_dict(sd, *a, **k)
 
+    def __getattr__(self, name):
+        # transparent facade: anything not on the wrapper resolves on the
+        # wrapped layer (engine probes model.gpt/embeddings/ln_f etc.)
+        try:
+            return super().__getattr__(name)
+        except AttributeError:
+            return getattr(super().__getattr__("_layers"), name)
+
 
 def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                            group=None, offload=False, sync_buffers=False,
                            buffer_max_size=2 ** 23, segment_size=2 ** 20,
-                           sync_comm=False):
+                           sync_comm=False, dp_group=None,
+                           exclude_layer=None):
     """`paddle.distributed.sharding.group_sharded_parallel`.
 
     Marks parameters for ZeRO: stage 3 ('p_g_os') adds 'sharding' to each
-    large parameter's PartitionSpec; stages 1/2 shard only optimizer state
-    (the engine applies the moment sharding). Returns (model, optimizer,
-    scaler) like the reference."""
-    assert level in ("os", "os_g", "p_g_os")
+    large parameter's PartitionSpec (honored by HybridParallelEngine's
+    in_shardings, so per-device parameter memory really is 1/degree);
+    stages 1/2 shard only optimizer state. `offload=True` moves optimizer
+    states and the master update to host memory (engine runs a CPU update
+    executable — reference group_sharded_stage2.py offload semantics).
+    Returns (model, optimizer, scaler) like the reference.
+
+    `buffer_max_size`/`segment_size` (grad-fusion bucket tuning) have no
+    effect under XLA, which owns fusion — accepted silently by design.
+    `sync_buffers` is trivially satisfied: SPMD keeps one logical copy of
+    every buffer. `sync_comm` and `exclude_layer` are NOT implemented and
+    raise rather than silently drop reference semantics."""
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(
+            f"group_sharded_parallel: unknown level {level!r} "
+            "(expected 'os', 'os_g' or 'p_g_os')")
+    if sync_comm:
+        raise NotImplementedError(
+            "group_sharded_parallel(sync_comm=True): synchronous-comm mode "
+            "has no meaning for compiled XLA collectives; remove the flag")
+    if exclude_layer:
+        raise NotImplementedError(
+            "group_sharded_parallel(exclude_layer=...) is not supported; "
+            "set param.sharding_spec = None on the layers to exclude")
     if level == "p_g_os":
+        mesh = None
+        try:
+            from . import fleet
+
+            hcg = fleet._fleet_state.get("hcg")
+            mesh = hcg.mesh if hcg is not None else None
+        except Exception:
+            pass
+        deg = dict(mesh.shape).get("sharding", 1) if mesh is not None else 0
+
+        def effectively_sharded(spec):
+            if mesh is None:
+                return spec is not None
+            return any(s is not None and dict(mesh.shape).get(s, 1) > 1
+                       for s in spec or ())
+
         for p in model.parameters():
-            if p.ndim >= 2 and p.sharding_spec is None:
-                p.sharding_spec = tuple(
-                    ["sharding"] + [None] * (p.ndim - 1))
+            if p.ndim < 2 or effectively_sharded(p.sharding_spec):
+                continue
+            # add 'sharding' on the first free dim the degree divides (a
+            # param spec'd only over degree-1 axes is NOT actually sharded
+            # — e.g. mp annotations under mp=1)
+            spec = list(p.sharding_spec or (None,) * p.ndim)
+            for d in range(p.ndim):
+                if spec[d] is None and (deg <= 1 or
+                                        p.shape[d] % max(deg, 1) == 0):
+                    spec[d] = "sharding"
+                    p.sharding_spec = tuple(spec)
+                    break
     optimizer._sharding_level = level
+    optimizer._sharding_offload = bool(offload)
     return _GroupShardedModel(model, level), optimizer, scaler
 
 

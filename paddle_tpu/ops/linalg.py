@@ -15,8 +15,8 @@ __all__ = [
     "cholesky", "cholesky_solve", "qr", "svd", "pinv", "det", "slogdet",
     "norm", "cond", "matrix_power", "matrix_rank", "solve",
     "triangular_solve", "lstsq", "eig", "eigh", "eigvals", "eigvalsh",
-    "lu", "multi_dot", "corrcoef", "cov", "householder_product", "vander",
-    "p_norm",
+    "lu", "lu_unpack", "multi_dot", "corrcoef", "cov",
+    "householder_product", "vander", "p_norm",
 ]
 
 
@@ -141,7 +141,8 @@ def eigvalsh(x, UPLO="L", name=None):
 def lu(x, pivot=True, get_infos=False, name=None):
     def f(a):
         lu_, piv = jax.scipy.linalg.lu_factor(a)
-        return lu_, piv.astype(jnp.int32)
+        # reference/LAPACK convention: 1-based sequential row swaps
+        return lu_, piv.astype(jnp.int32) + 1
     out = forward(f, (x,), name="lu")
     if get_infos:
         from .creation import zeros
@@ -183,3 +184,35 @@ def householder_product(x, tau, name=None):
 def vander(x, n=None, increasing=False, name=None):
     return forward(lambda a: jnp.vander(a, N=n, increasing=increasing), (x,),
                    name="vander")
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack jax.scipy-style packed LU + pivots into (P, L, U)
+    (reference phi/kernels/lu_unpack_kernel.h)."""
+    def f(lu_data, pivots):
+        m, n = lu_data.shape[-2], lu_data.shape[-1]
+        k = min(m, n)
+        L = jnp.tril(lu_data[..., :, :k], -1) + jnp.eye(
+            m, k, dtype=lu_data.dtype)
+        U = jnp.triu(lu_data[..., :k, :])
+        # pivots (1-based sequential row swaps) -> permutation matrix
+        def perm_one(piv):
+            perm = jnp.arange(m)
+
+            def body(i, p):
+                j = piv[i] - 1
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+
+            perm = jax.lax.fori_loop(0, piv.shape[0], body, perm)
+            return jnp.eye(m, dtype=lu_data.dtype)[perm].T
+
+        batch = lu_data.shape[:-2]
+        if batch:
+            P = jax.vmap(perm_one)(pivots.reshape((-1, pivots.shape[-1]))
+                                   ).reshape(batch + (m, m))
+        else:
+            P = perm_one(pivots)
+        return P, L, U
+
+    return forward(f, (x, y), name="lu_unpack")

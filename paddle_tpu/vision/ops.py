@@ -1,0 +1,574 @@
+"""paddle.vision.ops — detection operators.
+
+Reference: `python/paddle/vision/ops.py` (yolo_box:262, prior_box:425,
+box_coder:572, distribute_fpn_proposals:1151, psroi_pool:1384,
+roi_pool:1504, roi_align:1628, nms:1853) backed by
+`fluid/operators/detection/` CUDA/C++ kernels.
+
+TPU re-design: every op is vectorized jnp with static shapes — greedy NMS
+runs as a fixed-trip `lax.scan` over candidate slots (data-dependent loops
+don't map to XLA), RoI ops build their sampling grids as dense gathers that
+XLA fuses, and all of it jits/vmaps/shards like any other op.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+
+__all__ = ["yolo_box", "prior_box", "box_coder", "nms", "roi_align",
+           "roi_pool", "psroi_pool", "distribute_fpn_proposals",
+           "deform_conv2d", "generate_proposals", "RoIAlign", "RoIPool"]
+
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+# --------------------------------- nms ---------------------------------------
+
+def _iou_matrix(boxes):
+    """Pairwise IoU for [N, 4] boxes (x1, y1, x2, y2)."""
+    area = jnp.maximum(boxes[:, 2] - boxes[:, 0], 0) * \
+        jnp.maximum(boxes[:, 3] - boxes[:, 1], 0)
+    lt = jnp.maximum(boxes[:, None, :2], boxes[None, :, :2])
+    rb = jnp.minimum(boxes[:, None, 2:], boxes[None, :, 2:])
+    wh = jnp.maximum(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area[:, None] + area[None, :] - inter
+    return inter / jnp.maximum(union, 1e-10)
+
+
+def _greedy_nms(boxes, scores, iou_threshold):
+    """Fixed-trip greedy NMS: N picks of the best unsuppressed box.
+    Returns (keep_mask, order) where order[i] is the i-th picked index."""
+    n = boxes.shape[0]
+    iou = _iou_matrix(boxes)
+
+    def pick(carry, _):
+        alive, keep = carry
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        valid = masked[best] > -jnp.inf
+        keep = keep.at[best].set(keep[best] | valid)
+        suppress = iou[best] > iou_threshold
+        alive = alive & ~suppress & (jnp.arange(n) != best)
+        return (alive, keep), jnp.where(valid, best, -1)
+
+    (alive, keep), order = jax.lax.scan(
+        pick, (jnp.ones(n, bool), jnp.zeros(n, bool)), None, length=n)
+    return keep, order
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Greedy (optionally category-aware) hard NMS (vision/ops.py:1853).
+    Returns kept indices sorted by descending score."""
+    b = _unwrap(boxes)
+    s = _unwrap(scores) if scores is not None else \
+        jnp.arange(b.shape[0], 0, -1).astype(b.dtype)
+    if category_idxs is not None:
+        # offset boxes per category so cross-category IoU is 0
+        c = _unwrap(category_idxs).astype(b.dtype)
+        span = (b.max() - b.min()) + 1.0
+        b = b + (c * span)[:, None]
+    keep, order = _greedy_nms(b, s, float(iou_threshold))
+    picked = np.asarray(order)
+    picked = picked[picked >= 0]
+    kept = np.asarray(keep)
+    picked = np.array([i for i in picked if kept[i]], np.int64)
+    if top_k is not None:
+        picked = picked[:top_k]
+    return Tensor(jnp.asarray(picked))
+
+
+# ------------------------------- box coder -----------------------------------
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0, name=None):
+    """Encode/decode boxes against priors (vision/ops.py:572 /
+    fluid/operators/detection/box_coder_op.cc)."""
+    pb = _unwrap(prior_box)
+    tb = _unwrap(target_box)
+    var = _unwrap(prior_box_var) if not isinstance(
+        prior_box_var, (list, tuple)) else jnp.asarray(prior_box_var)
+    norm = 0.0 if box_normalized else 1.0
+
+    pw = pb[:, 2] - pb[:, 0] + norm
+    ph = pb[:, 3] - pb[:, 1] + norm
+    pcx = pb[:, 0] + pw * 0.5
+    pcy = pb[:, 1] + ph * 0.5
+
+    def f(pb_, var_, tb_):
+        if code_type == "encode_center_size":
+            tw = tb_[:, 2] - tb_[:, 0] + norm
+            th = tb_[:, 3] - tb_[:, 1] + norm
+            tcx = tb_[:, 0] + tw * 0.5
+            tcy = tb_[:, 1] + th * 0.5
+            ox = (tcx[:, None] - pcx[None, :]) / pw[None, :]
+            oy = (tcy[:, None] - pcy[None, :]) / ph[None, :]
+            ow = jnp.log(tw[:, None] / pw[None, :])
+            oh = jnp.log(th[:, None] / ph[None, :])
+            out = jnp.stack([ox, oy, ow, oh], -1)
+            if var_.ndim == 2:
+                out = out / var_[None, :, :]
+            else:
+                out = out / var_.reshape(1, 1, 4)
+            return out
+        # decode_center_size: tb_ [N, M, 4]; a per-prior [M, 4] variance
+        # aligns with whichever dim the priors broadcast over (axis)
+        v = var_ if var_.ndim == 3 else (
+            (var_[None, :, :] if axis == 0 else var_[:, None, :])
+            if var_.ndim == 2 else var_.reshape(1, 1, 4))
+        if axis == 0:
+            w, h, cx, cy = (pw[None, :], ph[None, :], pcx[None, :],
+                            pcy[None, :])
+        else:
+            w, h, cx, cy = (pw[:, None], ph[:, None], pcx[:, None],
+                            pcy[:, None])
+        dcx = v[..., 0] * tb_[..., 0] * w + cx
+        dcy = v[..., 1] * tb_[..., 1] * h + cy
+        dw = jnp.exp(v[..., 2] * tb_[..., 2]) * w
+        dh = jnp.exp(v[..., 3] * tb_[..., 3]) * h
+        return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
+                          dcx + dw * 0.5 - norm, dcy + dh * 0.5 - norm], -1)
+
+    return Tensor(f(pb, var, tb))
+
+
+# -------------------------------- yolo box -----------------------------------
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio=32, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode a YOLO detection head (vision/ops.py:262 /
+    detection/yolo_box_op.cc). x: [N, C, H, W], C = na*(5+class_num).
+    Returns (boxes [N, H*W*na, 4], scores [N, H*W*na, class_num])."""
+    xv = _unwrap(x).astype(jnp.float32)
+    img = _unwrap(img_size).astype(jnp.float32)
+    na = len(anchors) // 2
+    N, C, H, W = xv.shape
+    anc = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+    feat = xv.reshape(N, na, 5 + class_num + (1 if iou_aware else 0), H, W)
+    if iou_aware:
+        ioup, feat = feat[:, :, :1], feat[:, :, 1:]
+    gx = jnp.arange(W, dtype=jnp.float32)
+    gy = jnp.arange(H, dtype=jnp.float32)
+    bx = (jax.nn.sigmoid(feat[:, :, 0]) * scale_x_y -
+          0.5 * (scale_x_y - 1.0) + gx[None, None, None, :]) / W
+    by = (jax.nn.sigmoid(feat[:, :, 1]) * scale_x_y -
+          0.5 * (scale_x_y - 1.0) + gy[None, None, :, None]) / H
+    in_w = downsample_ratio * W
+    in_h = downsample_ratio * H
+    bw = jnp.exp(feat[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+    bh = jnp.exp(feat[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+    conf = jax.nn.sigmoid(feat[:, :, 4])
+    if iou_aware:
+        conf = conf ** (1 - iou_aware_factor) * \
+            jax.nn.sigmoid(ioup[:, :, 0]) ** iou_aware_factor
+    cls = jax.nn.sigmoid(feat[:, :, 5:]) * conf[:, :, None]
+    cls = jnp.where(conf[:, :, None] >= conf_thresh, cls, 0.0)
+
+    imw = img[:, 1].reshape(N, 1, 1, 1)
+    imh = img[:, 0].reshape(N, 1, 1, 1)
+    x1 = (bx - bw * 0.5) * imw
+    y1 = (by - bh * 0.5) * imh
+    x2 = (bx + bw * 0.5) * imw
+    y2 = (by + bh * 0.5) * imh
+    if clip_bbox:
+        x1 = jnp.clip(x1, 0, imw - 1)
+        y1 = jnp.clip(y1, 0, imh - 1)
+        x2 = jnp.clip(x2, 0, imw - 1)
+        y2 = jnp.clip(y2, 0, imh - 1)
+    boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+    scores = cls.transpose(0, 1, 3, 4, 2).reshape(N, -1, class_num)
+    return Tensor(boxes), Tensor(scores)
+
+
+# ------------------------------- prior box -----------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5,
+              min_max_aspect_ratios_order=False, name=None):
+    """SSD prior boxes over a feature map (vision/ops.py:425 /
+    detection/prior_box_op.cc). Returns (boxes [H, W, P, 4], vars)."""
+    fm = _unwrap(input)
+    img = _unwrap(image)
+    H, W = fm.shape[-2:]
+    IH, IW = img.shape[-2:]
+    step_w = steps[0] or IW / W
+    step_h = steps[1] or IH / H
+
+    ars = [1.0]
+    for ar in aspect_ratios:
+        if not any(abs(ar - e) < 1e-6 for e in ars):
+            ars.append(float(ar))
+            if flip:
+                ars.append(1.0 / float(ar))
+
+    whs = []
+    for ms in min_sizes:
+        if min_max_aspect_ratios_order:
+            whs.append((ms, ms))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((float(np.sqrt(ms * mx)),) * 2)
+            for ar in ars:
+                if abs(ar - 1.0) < 1e-6:
+                    continue
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+        else:
+            for ar in ars:
+                whs.append((ms * np.sqrt(ar), ms / np.sqrt(ar)))
+            if max_sizes:
+                mx = max_sizes[min_sizes.index(ms)]
+                whs.append((float(np.sqrt(ms * mx)),) * 2)
+    whs = jnp.asarray(np.asarray(whs, np.float32))  # [P, 2]
+    P = whs.shape[0]
+
+    cx = (jnp.arange(W, dtype=jnp.float32) + offset) * step_w
+    cy = (jnp.arange(H, dtype=jnp.float32) + offset) * step_h
+    cxg, cyg = jnp.meshgrid(cx, cy)  # [H, W]
+    out = jnp.stack([
+        (cxg[..., None] - whs[None, None, :, 0] / 2) / IW,
+        (cyg[..., None] - whs[None, None, :, 1] / 2) / IH,
+        (cxg[..., None] + whs[None, None, :, 0] / 2) / IW,
+        (cyg[..., None] + whs[None, None, :, 1] / 2) / IH,
+    ], -1)  # [H, W, P, 4]
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    var = jnp.broadcast_to(jnp.asarray(variance, jnp.float32),
+                           (H, W, P, 4))
+    return Tensor(out), Tensor(var)
+
+
+# ------------------------------ RoI ops --------------------------------------
+
+def _roi_grid(box, out_h, out_w, sampling, H, W, aligned):
+    """Bilinear sample coordinates for one roi: [out_h*s, out_w*s] pairs."""
+    off = 0.5 if aligned else 0.0
+    x1, y1, x2, y2 = box[0] - off, box[1] - off, box[2] - off, box[3] - off
+    if not aligned:
+        x2 = jnp.maximum(x2, x1 + 1.0)
+        y2 = jnp.maximum(y2, y1 + 1.0)
+    bin_w = (x2 - x1) / out_w
+    bin_h = (y2 - y1) / out_h
+    sx = (jnp.arange(out_w * sampling) + 0.5) / sampling
+    sy = (jnp.arange(out_h * sampling) + 0.5) / sampling
+    xs = x1 + sx * bin_w
+    ys = y1 + sy * bin_h
+    return xs, ys
+
+
+def _bilinear(feat, xs, ys):
+    """feat [C, H, W]; xs [Nx], ys [Ny] → [C, Ny, Nx]."""
+    H, W = feat.shape[-2:]
+    x0 = jnp.clip(jnp.floor(xs), 0, W - 1)
+    y0 = jnp.clip(jnp.floor(ys), 0, H - 1)
+    x1 = jnp.clip(x0 + 1, 0, W - 1)
+    y1 = jnp.clip(y0 + 1, 0, H - 1)
+    wx = jnp.clip(xs, 0, W - 1) - x0
+    wy = jnp.clip(ys, 0, H - 1) - y0
+    x0i, x1i = x0.astype(jnp.int32), x1.astype(jnp.int32)
+    y0i, y1i = y0.astype(jnp.int32), y1.astype(jnp.int32)
+    f00 = feat[:, y0i][:, :, x0i]
+    f01 = feat[:, y0i][:, :, x1i]
+    f10 = feat[:, y1i][:, :, x0i]
+    f11 = feat[:, y1i][:, :, x1i]
+    w00 = ((1 - wy)[:, None] * (1 - wx)[None, :])[None]
+    w01 = ((1 - wy)[:, None] * wx[None, :])[None]
+    w10 = (wy[:, None] * (1 - wx)[None, :])[None]
+    w11 = (wy[:, None] * wx[None, :])[None]
+    return f00 * w00 + f01 * w01 + f10 * w10 + f11 * w11
+
+
+def _rois_to_batch(boxes_num, num_rois):
+    """Batch index per roi from per-image counts."""
+    bn = np.asarray(boxes_num)
+    return jnp.asarray(np.repeat(np.arange(len(bn)), bn).astype(np.int32))
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign (vision/ops.py:1628 / detection/roi_align_op.cc)."""
+    xv = _unwrap(x)
+    bx = _unwrap(boxes) * spatial_scale
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    s = 2 if sampling_ratio <= 0 else int(sampling_ratio)
+    batch_idx = _rois_to_batch(boxes_num, bx.shape[0])
+    H, W = xv.shape[-2:]
+
+    def one(box, bi):
+        xs, ys = _roi_grid(box, oh, ow, s, H, W, aligned)
+        samp = _bilinear(xv[bi], xs, ys)  # [C, oh*s, ow*s]
+        C = samp.shape[0]
+        return samp.reshape(C, oh, s, ow, s).mean((2, 4))
+
+    out = jax.vmap(one)(bx, batch_idx)
+    return Tensor(out)
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """RoIPool max-pooling (vision/ops.py:1504 / roi_pool_op.cc)."""
+    xv = _unwrap(x)
+    bx = _unwrap(boxes) * spatial_scale
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    batch_idx = _rois_to_batch(boxes_num, bx.shape[0])
+    H, W = xv.shape[-2:]
+    ygrid = jnp.arange(H, dtype=jnp.float32)
+    xgrid = jnp.arange(W, dtype=jnp.float32)
+
+    def one(box, bi):
+        x1 = jnp.round(box[0])
+        y1 = jnp.round(box[1])
+        x2 = jnp.maximum(jnp.round(box[2]), x1 + 1)
+        y2 = jnp.maximum(jnp.round(box[3]), y1 + 1)
+        bw = (x2 - x1) / ow
+        bh = (y2 - y1) / oh
+        # bin membership masks [oh, H], [ow, W]
+        bins_y = jnp.arange(oh, dtype=jnp.float32)
+        bins_x = jnp.arange(ow, dtype=jnp.float32)
+        ylo = jnp.floor(y1 + bins_y * bh)[:, None]
+        yhi = jnp.ceil(y1 + (bins_y + 1) * bh)[:, None]
+        xlo = jnp.floor(x1 + bins_x * bw)[:, None]
+        xhi = jnp.ceil(x1 + (bins_x + 1) * bw)[:, None]
+        my = (ygrid[None, :] >= ylo) & (ygrid[None, :] < yhi)
+        mx = (xgrid[None, :] >= xlo) & (xgrid[None, :] < xhi)
+        feat = xv[bi]  # [C, H, W]
+        m = my[None, :, None, :, None] & mx[None, None, :, None, :]
+        vals = jnp.where(m, feat[:, None, None, :, :], -jnp.inf)
+        out = vals.max((3, 4))
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    out = jax.vmap(one)(bx, batch_idx)
+    return Tensor(out)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI average pool (vision/ops.py:1384): input
+    channels C = out_c * oh * ow; bin (i, j) reads channel group (i, j)."""
+    xv = _unwrap(x)
+    bx = _unwrap(boxes) * spatial_scale
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    C = xv.shape[1]
+    out_c = C // (oh * ow)
+    batch_idx = _rois_to_batch(boxes_num, bx.shape[0])
+    H, W = xv.shape[-2:]
+    ygrid = jnp.arange(H, dtype=jnp.float32)
+    xgrid = jnp.arange(W, dtype=jnp.float32)
+
+    def one(box, bi):
+        bw = (box[2] - box[0]) / ow
+        bh = (box[3] - box[1]) / oh
+        bins_y = jnp.arange(oh, dtype=jnp.float32)
+        bins_x = jnp.arange(ow, dtype=jnp.float32)
+        ylo = jnp.floor(box[1] + bins_y * bh)[:, None]
+        yhi = jnp.ceil(box[1] + (bins_y + 1) * bh)[:, None]
+        xlo = jnp.floor(box[0] + bins_x * bw)[:, None]
+        xhi = jnp.ceil(box[0] + (bins_x + 1) * bw)[:, None]
+        my = (ygrid[None, :] >= ylo) & (ygrid[None, :] < yhi)
+        mx = (xgrid[None, :] >= xlo) & (xgrid[None, :] < xhi)
+        feat = xv[bi].reshape(out_c, oh, ow, H, W)
+        m = my[None, :, None, :, None] & mx[None, None, :, None, :]
+        s = jnp.sum(jnp.where(m, feat, 0.0), (3, 4))
+        cnt = jnp.maximum(jnp.sum(m, (3, 4)), 1)
+        return s / cnt
+
+    out = jax.vmap(one)(bx, batch_idx)
+    return Tensor(out)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Assign RoIs to FPN levels by scale (vision/ops.py:1151 /
+    distribute_fpn_proposals_op.cc). Returns (per-level roi lists,
+    restore_index, per-level counts)."""
+    rois = np.asarray(_unwrap(fpn_rois))
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+    outs, order = [], []
+    counts = []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        order.append(idx)
+        outs.append(Tensor(jnp.asarray(rois[idx])))
+        counts.append(len(idx))
+    order = np.concatenate(order) if order else np.zeros(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    return outs, Tensor(jnp.asarray(restore[:, None])), [
+        Tensor(jnp.asarray(np.asarray([c], np.int32))) for c in counts]
+
+
+class RoIAlign:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size,
+                        self.spatial_scale)
+
+
+# --------------------------- deformable conv ---------------------------------
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable conv v1/v2 (vision/ops.py:742 /
+    fluid/operators/deformable_conv_op.cu): each kernel tap samples the
+    input at a learned fractional offset (bilinear), optionally modulated
+    by a mask; the taps then contract with the weights as a dense einsum —
+    gather + MXU matmul, no custom kernel."""
+    xv = _unwrap(x)
+    off = _unwrap(offset)
+    w = _unwrap(weight)
+    mk = _unwrap(mask) if mask is not None else None
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = (padding, padding)
+    if isinstance(dilation, int):
+        dilation = (dilation, dilation)
+    N, Cin, H, W = xv.shape
+    Cout, Cg, kh, kw = w.shape
+    Ho = (H + 2 * padding[0] - dilation[0] * (kh - 1) - 1) // stride[0] + 1
+    Wo = (W + 2 * padding[1] - dilation[1] * (kw - 1) - 1) // stride[1] + 1
+    K = kh * kw
+    off = off.reshape(N, deformable_groups, K, 2, Ho, Wo)
+    if mk is not None:
+        mk = mk.reshape(N, deformable_groups, K, Ho, Wo)
+
+    base_y = (jnp.arange(Ho) * stride[0] - padding[0])[:, None]  # [Ho,1]
+    base_x = (jnp.arange(Wo) * stride[1] - padding[1])[None, :]  # [1,Wo]
+    ky = (jnp.arange(kh) * dilation[0]).repeat(kw)  # [K]
+    kx = jnp.tile(jnp.arange(kw) * dilation[1], kh)  # [K]
+
+    cg = Cin // deformable_groups
+
+    def sample_one(img, offs, msk):
+        # img [Cin, H, W]; offs [dg, K, 2, Ho, Wo]; msk [dg, K, Ho, Wo]|None
+        def tap(k):
+            ys = base_y[None, :, :] + ky[k] + offs[:, k, 0]  # [dg, Ho, Wo]
+            xs = base_x[None, :, :] + kx[k] + offs[:, k, 1]
+            y0 = jnp.floor(ys)
+            x0 = jnp.floor(xs)
+            wy = ys - y0
+            wx = xs - x0
+            res = 0.0
+            for dy, fy in ((0, 1 - wy), (1, wy)):
+                for dx, fx in ((0, 1 - wx), (1, wx)):
+                    yy = y0 + dy
+                    xx = x0 + dx
+                    inb = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                    yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+                    xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+                    # per deformable group, gather its channel slice
+                    imgg = img.reshape(deformable_groups, cg, H, W)
+                    g = jax.vmap(lambda im, a, b: im[:, a, b])(
+                        imgg, yi, xi)  # [dg, cg, Ho, Wo]
+                    res = res + g * (fy * fx * inb)[:, None]
+            if msk is not None:
+                res = res * msk[:, k][:, None]
+            return res.reshape(Cin, Ho, Wo)
+
+        return jnp.stack([tap(k) for k in range(K)], 1)  # [Cin, K, Ho, Wo]
+
+    if mk is None:
+        samp = jax.vmap(
+            lambda img, offs: sample_one(img, offs, None))(xv, off)
+    else:
+        samp = jax.vmap(sample_one)(xv, off, mk)
+    # grouped contraction: [N, Cin, K, Ho, Wo] x [Cout, Cg, kh*kw]
+    wf = w.reshape(groups, Cout // groups, Cg, K)
+    sf = samp.reshape(N, groups, Cg, K, Ho, Wo)
+    out = jnp.einsum("ngckyx,gock->ngoyx", sf, wf).reshape(N, Cout, Ho, Wo)
+    if bias is not None:
+        out = out + _unwrap(bias).reshape(1, -1, 1, 1)
+    return Tensor(out)
+
+
+# --------------------------- generate proposals ------------------------------
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True, name=None):
+    """RPN proposal generation (vision/ops.py / detection/
+    generate_proposals_v2_op.cc): decode anchors with deltas, clip to the
+    image, drop tiny boxes, take pre-NMS top-k, NMS, take post-NMS top-k.
+    Returns (rois [R, 4], scores [R, 1][, rois_num])."""
+    sc = np.asarray(_unwrap(scores))          # [N, A, H, W]
+    bd = np.asarray(_unwrap(bbox_deltas))     # [N, 4A, H, W]
+    ims = np.asarray(_unwrap(img_size))       # [N, 2]
+    anc = np.asarray(_unwrap(anchors)).reshape(-1, 4)
+    var = np.asarray(_unwrap(variances)).reshape(-1, 4)
+    N, A = sc.shape[0], sc.shape[1]
+    off = 1.0 if pixel_offset else 0.0
+
+    all_rois, all_scores, nums = [], [], []
+    for n in range(N):
+        s = sc[n].transpose(1, 2, 0).reshape(-1)
+        d = bd[n].reshape(A, 4, *bd.shape[2:]).transpose(2, 3, 0, 1
+                                                         ).reshape(-1, 4)
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, d, a, v = s[order], d[order], anc[order], var[order]
+        aw = a[:, 2] - a[:, 0] + off
+        ah = a[:, 3] - a[:, 1] + off
+        acx = a[:, 0] + aw * 0.5
+        acy = a[:, 1] + ah * 0.5
+        cx = v[:, 0] * d[:, 0] * aw + acx
+        cy = v[:, 1] * d[:, 1] * ah + acy
+        wd = np.exp(np.minimum(v[:, 2] * d[:, 2], 10.0)) * aw
+        ht = np.exp(np.minimum(v[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - wd / 2, cy - ht / 2,
+                          cx + wd / 2 - off, cy + ht / 2 - off], 1)
+        ih, iw = ims[n][0], ims[n][1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size) &
+                (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        if len(boxes):
+            kept = np.asarray(nms(Tensor(jnp.asarray(boxes)), nms_thresh,
+                                  Tensor(jnp.asarray(s))).numpy())
+            kept = kept[:post_nms_top_n]
+            boxes, s = boxes[kept], s[kept]
+        all_rois.append(boxes)
+        all_scores.append(s[:, None])
+        nums.append(len(boxes))
+
+    rois = Tensor(jnp.asarray(np.concatenate(all_rois, 0)
+                              if all_rois else np.zeros((0, 4), np.float32)))
+    rscores = Tensor(jnp.asarray(
+        np.concatenate(all_scores, 0).astype(np.float32)
+        if all_scores else np.zeros((0, 1), np.float32)))
+    if return_rois_num:
+        return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
+    return rois, rscores

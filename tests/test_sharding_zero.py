@@ -1,0 +1,95 @@
+"""ZeRO group sharding semantics (reference dygraph_group_sharded_stage3 /
+group_sharded_stage2 offload tests): stage-3 params really occupy 1/degree
+memory per device, offload keeps optimizer state on host and matches
+non-offload numerics, unsupported args raise."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+
+def _build(level=None, offload=False, sharding=4, dp=2):
+    from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
+                                   GPTPretrainingCriterion)
+
+    paddle.seed(42)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": sharding}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    cfg = GPTConfig.preset("gpt2-tiny", vocab_size=64, n_layer=2,
+                           seq_len=16, dropout=0.0, n_head=2, d_model=32)
+    model = GPTForPretraining(GPTModel(cfg))
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    if level is not None:
+        model, opt, _ = group_sharded_parallel(model, opt, level,
+                                               offload=offload)
+    engine = fleet.HybridParallelEngine(
+        model, opt, hcg, strategy, criterion=GPTPretrainingCriterion())
+    return engine
+
+
+def _batch(B=16):
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, 64, (B, 16)).astype(np.int64)
+    return [toks, np.roll(toks, -1, 1)]
+
+
+class TestStage3:
+    def test_param_memory_is_sharded(self):
+        engine = _build(level="p_g_os")
+        engine.train_batch(_batch())
+        deg = 4
+        found = 0
+        for arr, spec in zip(engine.param_arrays, engine.param_specs):
+            if "sharding" not in list(spec):
+                continue
+            shard = arr.addressable_shards[0].data
+            assert shard.nbytes * deg == arr.nbytes, (
+                f"param {arr.shape} spec {spec}: shard {shard.nbytes}B "
+                f"x{deg} != full {arr.nbytes}B")
+            found += 1
+        assert found >= 3  # embeddings + block weights actually sharded
+
+    def test_stage3_matches_unsharded(self):
+        l0 = [float(_build(level=None, sharding=1, dp=8
+                           ).train_batch(_batch()))]
+        l3 = [float(_build(level="p_g_os").train_batch(_batch()))]
+        np.testing.assert_allclose(l0, l3, rtol=1e-3)
+
+
+class TestOffload:
+    def test_offload_matches_device_update(self):
+        e0 = _build(level="os_g", offload=False)
+        e1 = _build(level="os_g", offload=True)
+        b = _batch()
+        losses0 = [float(e0.train_batch(b)) for _ in range(3)]
+        losses1 = [float(e1.train_batch(b)) for _ in range(3)]
+        np.testing.assert_allclose(losses0, losses1, rtol=1e-4, atol=1e-5)
+
+    def test_offload_states_on_host(self):
+        import jax
+
+        e = _build(level="os_g", offload=True)
+        e.train_batch(_batch())
+        host = jax.devices("cpu")[0]
+        for an in e._acc_names:
+            for a in e.acc_arrays[an]:
+                assert a.devices() == {host}
+
+
+class TestArgValidation:
+    def test_sync_comm_raises(self):
+        engine = _build()  # ensures fleet env
+        model = engine.model
+        opt = engine.optimizer
+        with pytest.raises(NotImplementedError):
+            group_sharded_parallel(model, opt, "os_g", sync_comm=True)
+
+    def test_bad_level_raises(self):
+        engine = _build()
+        with pytest.raises(ValueError):
+            group_sharded_parallel(engine.model, engine.optimizer, "zz")

@@ -1,0 +1,280 @@
+"""Detection / graph / sequence op families (reference vision/ops.py,
+geometric/, text/ op tests): numeric oracles are plain numpy
+re-implementations."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as vops
+
+
+t = paddle.to_tensor
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = t(np.array([[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]],
+                           np.float32))
+        scores = t(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = np.asarray(vops.nms(boxes, 0.5, scores).numpy())
+        assert list(keep) == [0, 2]
+
+    def test_categories_do_not_suppress(self):
+        boxes = t(np.array([[0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+        scores = t(np.array([0.9, 0.8], np.float32))
+        cats = t(np.array([0, 1]))
+        keep = np.asarray(vops.nms(boxes, 0.5, scores, category_idxs=cats,
+                                   categories=[0, 1]).numpy())
+        assert sorted(keep) == [0, 1]
+
+    def test_top_k(self):
+        boxes = t(np.array([[0, 0, 1, 1], [5, 5, 6, 6], [9, 9, 11, 11]],
+                           np.float32))
+        scores = t(np.array([0.1, 0.9, 0.5], np.float32))
+        keep = np.asarray(vops.nms(boxes, 0.5, scores, top_k=2).numpy())
+        assert list(keep) == [1, 2]
+
+
+class TestRoI:
+    def test_roi_align_constant_map(self):
+        x = t(np.full((1, 2, 8, 8), 3.0, np.float32))
+        boxes = t(np.array([[0, 0, 4, 4]], np.float32))
+        out = vops.roi_align(x, boxes, [1], output_size=2)
+        assert tuple(out.shape) == (1, 2, 2, 2)
+        np.testing.assert_allclose(np.asarray(out.numpy()), 3.0, rtol=1e-6)
+
+    def test_roi_pool_max(self):
+        fm = np.zeros((1, 1, 4, 4), np.float32)
+        fm[0, 0, 1, 1] = 7.0
+        out = vops.roi_pool(t(fm), t(np.array([[0, 0, 3, 3]], np.float32)),
+                            [1], output_size=1)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [[[[7.0]]]])
+
+    def test_psroi_pool_shapes(self):
+        x = t(np.random.default_rng(0).standard_normal(
+            (1, 8, 6, 6)).astype(np.float32))
+        out = vops.psroi_pool(x, t(np.array([[0, 0, 5, 5]], np.float32)),
+                              [1], output_size=2)
+        assert tuple(out.shape) == (1, 2, 2, 2)
+
+
+class TestBoxOps:
+    def test_box_coder_decode_identity(self):
+        priors = np.array([[10, 10, 20, 20]], np.float32)
+        var = np.array([1.0, 1.0, 1.0, 1.0], np.float32)
+        deltas = np.zeros((1, 1, 4), np.float32)
+        out = vops.box_coder(t(priors), t(var), t(deltas),
+                             code_type="decode_center_size")
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0],
+                                   priors[0], rtol=1e-5)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.default_rng(1)
+        priors = np.abs(rng.standard_normal((3, 4)).astype(np.float32))
+        priors[:, 2:] = priors[:, :2] + 1.0 + np.abs(
+            rng.standard_normal((3, 2)).astype(np.float32))
+        targets = priors + 0.25
+        var = np.ones(4, np.float32)
+        enc = np.asarray(vops.box_coder(
+            t(priors), t(var), t(targets)).numpy())  # [T, P, 4]
+        dec = np.asarray(vops.box_coder(
+            t(priors), t(var), t(enc), code_type="decode_center_size",
+            box_normalized=True).numpy())
+        for i in range(3):
+            np.testing.assert_allclose(dec[i, i], targets[i], rtol=1e-4,
+                                       atol=1e-4)
+
+    def test_yolo_box_shapes(self):
+        na, nc, H, W = 2, 3, 4, 4
+        x = t(np.random.default_rng(2).standard_normal(
+            (2, na * (5 + nc), H, W)).astype(np.float32))
+        img = t(np.array([[128, 128], [128, 128]], np.int64))
+        boxes, scores = vops.yolo_box(x, img, [10, 13, 16, 30], nc, 0.01)
+        assert tuple(boxes.shape) == (2, H * W * na, 4)
+        assert tuple(scores.shape) == (2, H * W * na, nc)
+        assert np.isfinite(np.asarray(boxes.numpy())).all()
+
+    def test_prior_box(self):
+        fm = t(np.zeros((1, 8, 4, 4), np.float32))
+        img = t(np.zeros((1, 3, 64, 64), np.float32))
+        boxes, var = vops.prior_box(fm, img, min_sizes=[16.0],
+                                    aspect_ratios=[1.0, 2.0], clip=True)
+        assert tuple(boxes.shape)[:2] == (4, 4)
+        b = np.asarray(boxes.numpy())
+        assert (b >= 0).all() and (b <= 1).all()
+        assert tuple(var.shape) == tuple(boxes.shape)
+
+    def test_distribute_fpn(self):
+        rois = np.array([[0, 0, 16, 16], [0, 0, 200, 200]], np.float32)
+        outs, restore, counts = vops.distribute_fpn_proposals(
+            t(rois), 2, 5, 4, 224)
+        sizes = [int(np.asarray(c.numpy())[0]) for c in counts]
+        assert sum(sizes) == 2
+        assert np.asarray(restore.numpy()).shape == (2, 1)
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        x = t(np.array([[1., 2], [3, 4], [5, 6]], np.float32))
+        ids = t(np.array([0, 0, 1]))
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_sum(x, ids).numpy()),
+            [[4, 6], [5, 6]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_mean(x, ids).numpy()),
+            [[2, 3], [5, 6]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_max(x, ids).numpy()),
+            [[3, 4], [5, 6]])
+        np.testing.assert_allclose(
+            np.asarray(paddle.geometric.segment_min(x, ids).numpy()),
+            [[1, 2], [5, 6]])
+
+    def test_send_u_recv(self):
+        x = t(np.array([[1.], [2.], [3.]], np.float32))
+        src = t(np.array([0, 1, 2]))
+        dst = t(np.array([1, 2, 1]))
+        out = paddle.geometric.send_u_recv(x, src, dst, "sum")
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   [[0.], [4.], [2.]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = t(np.array([[1.], [2.]], np.float32))
+        y = t(np.array([[10.], [20.]], np.float32))
+        src = t(np.array([0, 1]))
+        dst = t(np.array([1, 0]))
+        out = paddle.geometric.send_ue_recv(x, t(np.array([[5.], [5.]],
+                                                          np.float32)),
+                                            src, dst, "mul", "sum")
+        np.testing.assert_allclose(np.asarray(out.numpy()), [[10.], [5.]])
+        uv = paddle.geometric.send_uv(x, y, src, dst, "add")
+        np.testing.assert_allclose(np.asarray(uv.numpy()), [[21.], [12.]])
+
+    def test_segment_grad(self):
+        x = t(np.ones((3, 2), np.float32))
+        x.stop_gradient = False
+        ids = t(np.array([0, 1, 1]))
+        paddle.geometric.segment_sum(x, ids).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   np.ones((3, 2)))
+
+
+class TestText:
+    def _brute_viterbi(self, emis, trans, bos_eos):
+        B, T, N = emis.shape
+        best = []
+        for b in range(B):
+            import itertools
+            top, arg = -1e30, None
+            for path in itertools.product(range(N), repeat=T):
+                s = emis[b, 0, path[0]]
+                if bos_eos:
+                    s += trans[N - 2, path[0]]
+                for i in range(1, T):
+                    s += trans[path[i - 1], path[i]] + emis[b, i, path[i]]
+                if bos_eos:
+                    s += trans[path[-1], N - 1]
+                if s > top:
+                    top, arg = s, path
+            best.append((top, list(arg)))
+        return best
+
+    @pytest.mark.parametrize("bos_eos", [False, True])
+    def test_viterbi_matches_bruteforce(self, bos_eos):
+        rng = np.random.default_rng(3)
+        B, T, N = 2, 4, 4
+        emis = rng.standard_normal((B, T, N)).astype(np.float32)
+        trans = rng.standard_normal((N, N)).astype(np.float32)
+        lens = np.full(B, T, np.int64)
+        scores, paths = paddle.text.viterbi_decode(
+            t(emis), t(trans), t(lens), include_bos_eos_tag=bos_eos)
+        ref = self._brute_viterbi(emis, trans, bos_eos)
+        for b in range(B):
+            assert abs(float(np.asarray(scores.numpy())[b]) -
+                       ref[b][0]) < 1e-4
+            assert list(np.asarray(paths.numpy())[b]) == ref[b][1]
+
+    def test_gather_tree(self):
+        ids = t(np.array([[[2, 2]], [[6, 1]], [[3, 9]]], np.int64))
+        parents = t(np.array([[[0, 0]], [[1, 1]], [[0, 1]]], np.int64))
+        out = np.asarray(paddle.text.gather_tree(ids, parents).numpy())
+        assert out.shape == (3, 1, 2)
+        # beam0: t2 value 3 (parent 0) ← t1 value 6 (parent 1) ← t0 value 2
+        np.testing.assert_array_equal(out[:, 0, 0], [2, 6, 3])
+        # beam1: t2 value 9 (parent 1) ← t1 value 1 (parent 1) ← t0 value 2
+        np.testing.assert_array_equal(out[:, 0, 1], [2, 1, 9])
+
+    def test_edit_distance(self):
+        a = t(np.array([[1, 2, 3, 0]], np.int64))
+        b = t(np.array([[1, 3, 3, 0]], np.int64))
+        d, n = paddle.text.edit_distance(a, b, normalized=False)
+        assert float(np.asarray(d.numpy())[0, 0]) == 1.0
+        d2, _ = paddle.text.edit_distance(
+            a, b, normalized=True,
+            input_length=t(np.array([3])), label_length=t(np.array([3])))
+        np.testing.assert_allclose(np.asarray(d2.numpy())[0, 0], 1 / 3,
+                                   rtol=1e-6)
+
+
+class TestCoverageMathOps:
+    def test_batch(self):
+        x = t(np.array([[0.3, 0.6]], np.float32))
+        np.testing.assert_allclose(
+            np.asarray(paddle.logit(x).numpy()),
+            np.log(np.array([[0.3, 0.6]]) / (1 - np.array([[0.3, 0.6]]))),
+            rtol=1e-5)
+        a = t(np.arange(6.0, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_allclose(
+            np.asarray(paddle.diagonal(a).numpy()), [0.0, 4.0])
+        v, i = paddle.kthvalue(t(np.array([[4., 2, 9]])), 2)
+        assert float(np.asarray(v.numpy())[0]) == 4.0
+        out = paddle.add_n([t([1.0, 1]), t([2.0, 2]), t([3.0, 3])])
+        np.testing.assert_allclose(np.asarray(out.numpy()), [6, 6])
+
+    def test_grad_through_new_ops(self):
+        x = t(np.array([0.25, 0.5], np.float32))
+        x.stop_gradient = False
+        paddle.logit(x).sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                                   1 / (np.array([0.25, 0.5]) *
+                                        (1 - np.array([0.25, 0.5]))),
+                                   rtol=1e-5)
+
+
+class TestPoolIndexRegressions:
+    def test_negative_inputs_at_padded_border(self):
+        # conv patches zero-pad; pooled max of all-negative input must stay
+        # negative and indices must point at real in-plane positions
+        F = paddle.nn.functional
+        x = t(np.full((1, 1, 4, 4), -1.0, np.float32))
+        out, idx = F.max_pool2d(x, 3, stride=1, padding=1, return_mask=True)
+        np.testing.assert_allclose(np.asarray(out.numpy()), -1.0)
+        iv = np.asarray(idx.numpy())
+        assert ((iv >= 0) & (iv < 16)).all()
+
+    def test_return_mask_roundtrip(self):
+        F = paddle.nn.functional
+        x = t(np.random.default_rng(0).standard_normal(
+            (2, 3, 6, 6)).astype(np.float32))
+        out, idx = F.max_pool2d(x, 2, return_mask=True)
+        ref = F.max_pool2d(x, 2)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   np.asarray(ref.numpy()), rtol=1e-6)
+        un = F.max_unpool2d(out, idx, 2)
+        assert tuple(un.shape) == (2, 3, 6, 6)
+        np.testing.assert_allclose(np.asarray(un.numpy()).sum(),
+                                   np.asarray(out.numpy()).sum(), rtol=1e-5)
+
+    def test_box_coder_axis1_var2d(self):
+        from paddle_tpu.vision import ops as vops
+
+        priors = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+        var = np.array([[1, 1, 1, 1], [2, 2, 2, 2]], np.float32)
+        deltas = np.zeros((2, 3, 4), np.float32)
+        out = vops.box_coder(t(priors), t(var), t(deltas),
+                             code_type="decode_center_size", axis=1)
+        # zero deltas decode back to the priors regardless of variance
+        o = np.asarray(out.numpy())
+        assert o.shape == (2, 3, 4)
+        for j in range(3):
+            np.testing.assert_allclose(o[:, j], priors, rtol=1e-5)
