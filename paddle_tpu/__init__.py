@@ -107,6 +107,7 @@ from . import framework  # noqa: F401
 from . import device  # noqa: F401
 from . import geometric  # noqa: F401
 from . import text  # noqa: F401
+from . import audio  # noqa: F401
 
 
 def is_grad_enabled_():  # pragma: no cover - back-compat alias

@@ -1,0 +1,85 @@
+"""paddle.audio — audio feature extraction namespace.
+
+Reference: `python/paddle/audio/` (features/, functional/, backends/).
+Feature layers + DSP helpers are full implementations; file IO backends
+(`paddle.audio.load/save`) need an audio codec, which this zero-egress
+environment does not ship — they raise with guidance instead of silently
+misbehaving.
+"""
+from . import features  # noqa: F401
+from . import functional  # noqa: F401
+
+__all__ = ["features", "functional", "load", "save", "info",
+           "backends"]
+
+
+class backends:  # namespace shim (reference audio/backends/)
+    @staticmethod
+    def list_available_backends():
+        return []
+
+    @staticmethod
+    def get_current_backend():
+        return None
+
+    @staticmethod
+    def set_backend(backend_name):
+        raise RuntimeError(
+            "paddle_tpu.audio: no IO backend available in this build "
+            "(no soundfile/libsndfile); decode waveforms externally and "
+            "feed numpy arrays to paddle.audio.features")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True):
+    import numpy as _np
+    import wave as _wave
+
+    # WAV decoding via the stdlib — covers the reference's default test
+    # fixtures; other codecs need an external decoder.
+    with _wave.open(str(filepath), "rb") as w:
+        sr = w.getframerate()
+        n = w.getnframes() if num_frames < 0 else num_frames
+        w.setpos(frame_offset)
+        raw = w.readframes(n)
+        width = w.getsampwidth()
+        ch = w.getnchannels()
+    dt = {1: _np.int8, 2: _np.int16, 4: _np.int32}[width]
+    data = _np.frombuffer(raw, dtype=dt).reshape(-1, ch)
+    if normalize:
+        data = data.astype(_np.float32) / float(_np.iinfo(dt).max)
+    wavef = data.T if channels_first else data
+    from ..ops.creation import to_tensor
+
+    return to_tensor(wavef), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16"):
+    import numpy as _np
+    import wave as _wave
+
+    arr = _np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if channels_first:
+        arr = arr.T
+    arr16 = (_np.clip(arr, -1.0, 1.0) * 32767.0).astype(_np.int16)
+    with _wave.open(str(filepath), "wb") as w:
+        w.setnchannels(arr16.shape[1] if arr16.ndim > 1 else 1)
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(arr16.tobytes())
+
+
+def info(filepath):
+    import wave as _wave
+
+    class AudioInfo:
+        pass
+
+    with _wave.open(str(filepath), "rb") as w:
+        i = AudioInfo()
+        i.sample_rate = w.getframerate()
+        i.num_frames = w.getnframes()
+        i.num_channels = w.getnchannels()
+        i.bits_per_sample = 8 * w.getsampwidth()
+    return i

@@ -1,0 +1,106 @@
+"""paddle.audio.features — spectrogram feature layers.
+
+Reference: `python/paddle/audio/features/layers.py` (Spectrogram,
+MelSpectrogram, LogMelSpectrogram, MFCC). Each layer composes
+`paddle.signal.stft` with the functional helpers; everything jits, so a
+feature front-end fuses into the model's XLA program (the reference runs
+these as eager kernel chains).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import forward
+from ...core.tensor import Tensor
+from ...nn.layer.layers import Layer
+from ... import signal as _signal
+from .. import functional as F
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = F.get_window(window, self.win_length, fftbins=True)
+
+    def forward(self, x):
+        spec = _signal.stft(x, self.n_fft, self.hop_length, self.win_length,
+                            window=self.window, center=self.center,
+                            pad_mode=self.pad_mode)
+
+        def f(s, *, power):
+            m = jnp.abs(s)
+            return m ** power if power != 1.0 else m
+
+        return forward(f, (spec,), {"power": float(self.power)},
+                       name="spectrogram_power")
+
+
+class MelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 dtype="float32"):
+        super().__init__()
+        self.spectrogram = Spectrogram(n_fft, hop_length, win_length, window,
+                                       power, center, pad_mode, dtype)
+        self.fbank = F.compute_fbank_matrix(
+            sr, n_fft, n_mels=n_mels, f_min=f_min, f_max=f_max, htk=htk,
+            norm=norm, dtype=dtype)
+
+    def forward(self, x):
+        spec = self.spectrogram(x)
+
+        def f(s, fb):
+            return jnp.einsum("mf,...ft->...mt", fb, s)
+
+        return forward(f, (spec, self.fbank), name="mel_spectrogram")
+
+
+class LogMelSpectrogram(Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True, pad_mode="reflect",
+                 n_mels=64, f_min=50.0, f_max=None, htk=False, norm="slaney",
+                 ref_value=1.0, amin=1e-10, top_db=None, dtype="float32"):
+        super().__init__()
+        self.mel_spectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return F.power_to_db(self.mel_spectrogram(x), self.ref_value,
+                             self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self.log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct = F.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        logmel = self.log_mel(x)
+
+        def f(s, d):
+            return jnp.einsum("mk,...mt->...kt", d, s)
+
+        return forward(f, (logmel, self.dct), name="mfcc")

@@ -1,0 +1,149 @@
+"""paddle.audio.functional — mel/window DSP helpers.
+
+Reference: `python/paddle/audio/functional/{functional.py,window.py}`
+(hz_to_mel/mel_to_hz/compute_fbank_matrix/create_dct/power_to_db,
+get_window). All pure jnp — they compose with `paddle.signal.stft` into the
+feature layers and jit/shard like any other op.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..core.dispatch import forward
+from ..core.tensor import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "create_dct", "power_to_db",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk=False):
+    """Hertz → mel (reference functional.py hz_to_mel; Slaney by default)."""
+    scalar = not isinstance(freq, (Tensor, np.ndarray, jnp.ndarray))
+    f = freq._data if isinstance(freq, Tensor) else jnp.asarray(
+        freq, jnp.float32)
+    if htk:
+        mel = 2595.0 * jnp.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = jnp.where(f >= min_log_hz,
+                        min_log_mel + jnp.log(f / min_log_hz) / logstep, mel)
+    if scalar:
+        return float(mel)
+    return Tensor(mel) if isinstance(freq, Tensor) else mel
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, jnp.ndarray))
+    m = mel._data if isinstance(mel, Tensor) else jnp.asarray(
+        mel, jnp.float32)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = jnp.where(m >= min_log_mel,
+                       min_log_hz * jnp.exp(logstep * (m - min_log_mel)), hz)
+    if scalar:
+        return float(hz)
+    return Tensor(hz) if isinstance(mel, Tensor) else hz
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False,
+                    dtype="float32"):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = jnp.linspace(low, high, n_mels)
+    return Tensor(mel_to_hz(mels, htk).astype(dtype))
+
+
+def fft_frequencies(sr, n_fft, dtype="float32"):
+    return Tensor(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2,
+                               dtype=dtype))
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]
+    (reference functional.py compute_fbank_matrix)."""
+    f_max = f_max or float(sr) / 2
+    fftfreqs = jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    return Tensor(weights.astype(dtype))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """DCT-II basis [n_mels, n_mfcc] (reference functional.py create_dct)."""
+    n = jnp.arange(n_mels, dtype=jnp.float32)
+    k = jnp.arange(n_mfcc, dtype=jnp.float32)[None, :]
+    dct = jnp.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct = dct * jnp.where(k == 0, 1.0 / math.sqrt(n_mels),
+                              math.sqrt(2.0 / n_mels))
+    else:
+        dct = dct * 2.0
+    return Tensor(dct.astype(dtype))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0, name=None):
+    """10·log10(power/ref) with floor (reference functional.py power_to_db)."""
+
+    def f(x, *, ref_value, amin, top_db):
+        log_spec = 10.0 * (jnp.log10(jnp.maximum(x, amin)) -
+                           jnp.log10(jnp.maximum(ref_value, amin)))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    return forward(f, (spect,), {"ref_value": float(ref_value),
+                                 "amin": float(amin),
+                                 "top_db": top_db}, name="power_to_db")
+
+
+def _window_array(window, win_length, fftbins=True, dtype=jnp.float32):
+    n = win_length
+    sym = not fftbins
+    N = n if sym else n + 1
+    i = jnp.arange(n, dtype=jnp.float32)
+    if window in ("hann", "hanning"):
+        w = 0.5 - 0.5 * jnp.cos(2 * math.pi * i / (N - 1))
+    elif window == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2 * math.pi * i / (N - 1))
+    elif window == "blackman":
+        w = (0.42 - 0.5 * jnp.cos(2 * math.pi * i / (N - 1))
+             + 0.08 * jnp.cos(4 * math.pi * i / (N - 1)))
+    elif window in ("rect", "boxcar", "ones"):
+        w = jnp.ones(n)
+    elif window == "bartlett":
+        w = 1.0 - jnp.abs(2 * i / (N - 1) - 1.0)
+    elif window == "bohman":
+        x = jnp.abs(2 * i / (N - 1) - 1.0)
+        w = (1 - x) * jnp.cos(math.pi * x) + jnp.sin(math.pi * x) / math.pi
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return w.astype(dtype)
+
+
+def get_window(window, win_length, fftbins=True, dtype="float32"):
+    """`paddle.audio.functional.get_window` (window.py)."""
+    if isinstance(window, tuple):  # e.g. ("gaussian", std) — unsupported std
+        window = window[0]
+    return Tensor(_window_array(window, win_length, fftbins=fftbins))

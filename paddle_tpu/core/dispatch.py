@@ -24,6 +24,7 @@ import functools
 import jax
 
 from . import autograd as ag
+from . import flags as _flags
 from .tensor import Tensor
 
 # Pluggable hooks -------------------------------------------------------------
@@ -82,6 +83,29 @@ def _hashable_attrs(attrs):
         return None
 
 
+def _check_finite(out, name):
+    """FLAGS_check_nan_inf consumer (reference
+    fluid/framework/details/nan_inf_utils_detail.cc + eager
+    fluid/eager/nan_inf_utils.cc): scan float op outputs and abort with the
+    op name. Concrete arrays only — inside a jit trace the static Executor
+    switches to per-op eager replay when the flag is set, so every op is
+    still scanned there too."""
+    import jax.numpy as jnp
+
+    arrays = out if isinstance(out, (tuple, list)) else (out,)
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer) or not hasattr(a, "dtype"):
+            continue
+        if not jnp.issubdtype(a.dtype, jnp.floating):
+            continue
+        if not bool(jnp.isfinite(a).all()):
+            kind = "Nan" if bool(jnp.isnan(a).any()) else "Inf"
+            raise RuntimeError(
+                f"Operator '{name}' output contains {kind} "
+                f"(shape {tuple(a.shape)}, dtype {a.dtype}). "
+                "Triggered by FLAGS_check_nan_inf.")
+
+
 def _wrap_out(arrays, node, multi):
     if not multi:
         t = Tensor(arrays, stop_gradient=node is None)
@@ -110,7 +134,7 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
         _coverage_sink.add(name)
 
     if static_recorder is not None:
-        out = static_recorder(fn, name, inputs, attrs)
+        out = static_recorder(fn, name, inputs, attrs, nondiff)
         if out is not NotImplemented:
             return out
 
@@ -147,6 +171,8 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
             out = _jitted(fn, items)(*arrays)
         else:
             out = fn(*arrays, **attrs)
+        if _flags._FLAGS["FLAGS_check_nan_inf"]:
+            _check_finite(out, name)
         return _wrap_out(out, None, isinstance(out, (tuple, list)))
 
     f = functools.partial(fn, **attrs)
@@ -160,6 +186,8 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
             return base_f(*xs)
 
     out, vjp_fn = jax.vjp(f, *arrays)
+    if _flags._FLAGS["FLAGS_check_nan_inf"]:
+        _check_finite(out, name)
     multi = isinstance(out, (tuple, list))
     outs_flat = list(out) if multi else [out]
     out_avals = [(o.shape, o.dtype) for o in outs_flat]

@@ -59,12 +59,34 @@ class Multinomial(Distribution):
                    name="multinomial_log_prob")
 
     def entropy(self):
-        # exact entropy has no closed form; use the standard Σ-term formula
-        # over the support approximation used by the reference (n log n terms
-        # dominate) — here: MC-free upper-bound via categorical decomposition.
+        # reference multinomial.py:162: n·H(categorical) − lgamma(n+1) +
+        # Σ_k E[lgamma(X_k+1)] with the expectation taken under the
+        # per-category Binomial(n, p_k) pmf over support 0..n
         def ent(p):
+            from jax.scipy.special import gammaln
+
+            n = self.total_count
             pn = p / p.sum(-1, keepdims=True)
             cat = -(pn * jnp.where(pn > 0, jnp.log(pn), 0.0)).sum(-1)
-            return self.total_count * cat
+            k = jnp.arange(n + 1, dtype=pn.dtype)  # support
+            log_comb = (gammaln(n + 1.0) - gammaln(k + 1.0)
+                        - gammaln(n - k + 1.0))
+            # mask 0·(−inf) = nan at the degenerate p∈{0,1} endpoints: the
+            # k=0 / k=n terms are exactly log(1)=0 there
+            logp = jnp.where(pn > 0, jnp.log(pn), 0.0)[..., None]
+            log1mp = jnp.where(pn < 1, jnp.log1p(-jnp.minimum(pn, 1.0 - 1e-38)
+                                                 ), 0.0)[..., None]
+            lp_term = jnp.where(k > 0, k * logp, 0.0)
+            l1_term = jnp.where(k < n, (n - k) * log1mp, 0.0)
+            log_pmf = log_comb + lp_term + l1_term
+            # degenerate categories: pmf collapses to a point mass
+            point0 = (k == 0).astype(pn.dtype)
+            pointn = (k == n).astype(pn.dtype)
+            binom_pmf = jnp.where(
+                (pn == 0.0)[..., None], point0,
+                jnp.where((pn == 1.0)[..., None], pointn,
+                          jnp.exp(log_pmf)))  # [..., K, n+1]
+            corr = (binom_pmf * gammaln(k + 1.0)).sum((-1, -2))
+            return n * cat - gammaln(n + 1.0) + corr
 
         return _op(ent, self.probs, name="multinomial_entropy")

@@ -86,10 +86,12 @@ def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
 
 
 def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    # r2c transform (ihfft) over the LAST axis first — it requires real
+    # input — then c2c ifft over the remaining axis (reference ihfftn order)
     return forward(
-        lambda a: jnp.fft.ihfft(jnp.fft.ifft(
-            a, n=None if s is None else s[0], axis=axes[0], norm=_norm(norm)),
-            n=None if s is None else s[1], axis=axes[1], norm=_norm(norm)),
+        lambda a: jnp.fft.ifft(jnp.fft.ihfft(
+            a, n=None if s is None else s[1], axis=axes[1], norm=_norm(norm)),
+            n=None if s is None else s[0], axis=axes[0], norm=_norm(norm)),
         (x,), name="ihfft2")
 
 

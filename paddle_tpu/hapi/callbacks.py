@@ -90,35 +90,79 @@ class ModelCheckpoint(Callback):
         if self.save_dir and epoch % self.save_freq == 0:
             self.model.save(f"{self.save_dir}/{epoch}")
 
+    def on_train_end(self, logs=None):
+        if self.save_dir:
+            self.model.save(f"{self.save_dir}/final")
+
 
 class EarlyStopping(Callback):
+    """Reference hapi/callbacks.py EarlyStopping. Improvement is checked on
+    eval logs when evaluation runs (reference behavior); without eval_data
+    the train-epoch logs are used instead. `save_best_model` snapshots COPIES
+    of the weights at the best check and restores them when stopping."""
+
     def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
                  min_delta=0, baseline=None, save_best_model=True):
         self.monitor = monitor
         self.patience = patience
-        self.min_delta = min_delta
-        self.best = None
+        self.verbose = verbose
+        self.min_delta = abs(min_delta)
+        self.baseline = baseline
+        self.save_best_model = save_best_model
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        if mode == "auto" and not ("loss" in monitor or "err" in monitor):
+            self.mode = "max"
+
+    def on_train_begin(self, logs=None):
         self.wait = 0
         self.stopped = False
-        self.mode = "min" if mode in ("auto", "min") else "max"
+        self.stopped_epoch = 0
+        self.best_weights = None
+        self._saw_eval = False
+        self.best = self.baseline if self.baseline is not None else (
+            float("inf") if self.mode == "min" else -float("inf"))
+
+    def on_eval_begin(self, logs=None):
+        self._saw_eval = True
 
     def on_eval_end(self, logs=None):
+        self._check(logs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._epoch = epoch
+        # avoid double-counting: when eval runs, only eval logs are checked
+        if not self._saw_eval:
+            self._check(logs)
+
+    def _snapshot(self):
+        import numpy as np
+
+        return {k: np.asarray(v.numpy()).copy()
+                for k, v in self.model.network.state_dict().items()}
+
+    def _check(self, logs):
         cur = (logs or {}).get(self.monitor)
         if cur is None:
             return
-        if isinstance(cur, (list, tuple)):
-            cur = cur[0]
-        better = (self.best is None or
-                  (cur < self.best - self.min_delta if self.mode == "min"
-                   else cur > self.best + self.min_delta))
-        if better:
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        improved = (cur < self.best - self.min_delta if self.mode == "min"
+                    else cur > self.best + self.min_delta)
+        if improved:
             self.best = cur
             self.wait = 0
+            if self.save_best_model and getattr(self, "model", None):
+                self.best_weights = self._snapshot()
         else:
             self.wait += 1
             if self.wait > self.patience:
                 self.stopped = True
+                self.stopped_epoch = getattr(self, "_epoch", 0)
                 self.model.stop_training = True
+                if self.best_weights is not None:
+                    self.model.network.set_state_dict(self.best_weights)
+                if self.verbose:
+                    print(f"Early stopping: {self.monitor} did not improve "
+                          f"for {self.wait} checks (best {self.best:.6g})")
 
 
 class LRScheduler(Callback):
@@ -143,3 +187,92 @@ class LRScheduler(Callback):
         s = self._sched()
         if s and self.by_epoch:
             s.step()
+
+
+class VisualDL(Callback):
+    """Reference hapi VisualDL callback shape. The VisualDL writer is not
+    available in this build; scalars are appended to a JSONL file that any
+    dashboard can ingest."""
+
+    def __init__(self, log_dir="./vdl_log"):
+        self.log_dir = log_dir
+        self._step = 0
+
+    def _write(self, tag, value, step):
+        import json
+        import os
+
+        os.makedirs(self.log_dir, exist_ok=True)
+        with open(os.path.join(self.log_dir, "scalars.jsonl"), "a") as f:
+            f.write(json.dumps({"tag": tag, "value": float(value),
+                                "step": int(step)}) + "\n")
+
+    def _write_logs(self, prefix, logs):
+        for k, v in (logs or {}).items():
+            try:
+                self._write(f"{prefix}/{k}",
+                            v[0] if isinstance(v, (list, tuple)) else v,
+                            self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        self._write_logs("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write_logs("eval", logs)
+
+
+class ReduceLROnPlateau(Callback):
+    """Reference hapi ReduceLROnPlateau: scale the optimizer LR by `factor`
+    after `patience` non-improving checks; `cooldown` epochs after a
+    reduction are excluded from the patience count."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0.0):
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.mode = "min" if mode in ("auto", "min") else "max"
+        if mode == "auto" and not ("loss" in monitor or "err" in monitor):
+            self.mode = "max"
+
+    def on_train_begin(self, logs=None):
+        self.wait = 0
+        self.cooldown_counter = 0
+        self.best = float("inf") if self.mode == "min" else -float("inf")
+
+    def on_epoch_end(self, epoch, logs=None):
+        cur = (logs or {}).get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        improved = (cur < self.best - self.min_delta if self.mode == "min"
+                    else cur > self.best + self.min_delta)
+        if improved:
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter > 0:
+            # in cooldown: epochs don't count against patience
+            self.cooldown_counter -= 1
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is None:
+                    return
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if old - new > 1e-12:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:.3g} -> "
+                              f"{new:.3g}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0

@@ -16,8 +16,11 @@ __all__ = [
 
 
 def _make(name, jfn):
+    # comparisons/logicals are non-differentiable: keeping them OFF the tape
+    # (reference: no grad op registered for compare kernels) also keeps the
+    # backward engine's pending-count walk out of bool subgraphs
     def op(x, y, name=None):
-        return _binary(jfn, x, y, name=_n)
+        return _binary(jfn, x, y, name=_n, nondiff=True)
     _n = name
     op.__name__ = name
     return op

@@ -45,12 +45,15 @@ def _is_scalar(v):
     return isinstance(v, (int, float, bool, np.number))
 
 
-def _binary(jfn, x, y, name):
+def _binary(jfn, x, y, name, nondiff=False):
     if _is_scalar(y) and isinstance(x, (Tensor, jax.Array)):
-        return forward(_scalar_rhs, (x,), {"fn": jfn, "s": y}, name=name)
+        return forward(_scalar_rhs, (x,), {"fn": jfn, "s": y}, name=name,
+                       nondiff=nondiff)
     if _is_scalar(x):
-        return forward(_scalar_lhs, (y,), {"fn": jfn, "s": x}, name=name)
-    return forward(jfn, (_as_input(x), _as_input(y)), name=name)
+        return forward(_scalar_lhs, (y,), {"fn": jfn, "s": x}, name=name,
+                       nondiff=nondiff)
+    return forward(jfn, (_as_input(x), _as_input(y)), name=name,
+                   nondiff=nondiff)
 
 
 def _make_binary(name, jfn):

@@ -83,7 +83,8 @@ class _CompiledStep:
 
         for op in self.program.ops:
             ins = tuple(resolve(r) for r in op.inputs)
-            out = dispatch.forward(op.fn, ins, dict(op.attrs), name=op.name)
+            out = dispatch.forward(op.fn, ins, dict(op.attrs), name=op.name,
+                                   nondiff=getattr(op, 'nondiff', False))
             outs = out if isinstance(out, tuple) else (out,)
             for v, o in zip(op.outputs, outs):
                 env[v.vid] = o
@@ -125,12 +126,21 @@ class _CompiledStep:
 
     # ----------------------------------------------------------------- run
     def run(self, feed):
+        from ..core import flags as _flags
+
         feed_arrays = tuple(np.asarray(feed[n]) for n in self.feed_names)
         param_arrays = tuple(self.scope.vars[pv.name]
                              for pv in self.param_vars)
         opt_arrays = tuple(self.scope.vars[n] for n in self.opt_state_names)
-        fetches, new_params, new_opt = self._jitted(feed_arrays, param_arrays,
-                                                    opt_arrays)
+        if _flags._FLAGS["FLAGS_check_nan_inf"]:
+            # debug mode: replay per-op eagerly so dispatch's finite check
+            # scans every op output with its name (reference
+            # nan_inf_utils_detail.cc per-op scan semantics)
+            fetches, new_params, new_opt = self._step(
+                feed_arrays, param_arrays, opt_arrays)
+        else:
+            fetches, new_params, new_opt = self._jitted(
+                feed_arrays, param_arrays, opt_arrays)
         for pv, arr in zip(self.param_vars, new_params):
             self.scope.set(pv.name, arr)
         for n, arr in zip(self.opt_state_names, new_opt):

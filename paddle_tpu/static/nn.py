@@ -78,3 +78,142 @@ def batch_norm(input, epsilon=1e-5, momentum=0.9, param_attr=None,
     return nn_ops.batch_norm(input, mean, var, scale, bias,
                              training=not is_test, momentum=momentum,
                              epsilon=epsilon, data_format=data_layout)
+
+
+# ----------------------------- control flow ----------------------------------
+# Reference: python/paddle/fluid/layers/control_flow.py (cond, while_loop)
+# over fluid/operators/controlflow/{conditional_block,while}_op.cc — the
+# sub-block machinery collapses onto jax.lax.cond / lax.while_loop: the
+# whole construct records as ONE program op whose replay traces the user
+# callables straight into XLA control flow.
+
+def _closure_variables(*fns):
+    """Program Variables a callable closes over (the reference's sub-block
+    input discovery). These become explicit op inputs so the executor's
+    replay env supplies their live values."""
+    seen, out = set(), []
+
+    def add(v):
+        if isinstance(v, Variable) and id(v) not in seen:
+            seen.add(id(v))
+            out.append(v)
+
+    for fn in fns:
+        if fn is None or not callable(fn):
+            continue
+        for cell in fn.__closure__ or ():
+            try:
+                val = cell.cell_contents
+            except ValueError:
+                continue
+            add(val)
+            if isinstance(val, (list, tuple)):
+                for x in val:
+                    add(x)
+    return out
+
+
+def _run_subtrace(fn, captured, arrays, args=()):
+    """Call a user callable with captured Variables bound to live traced
+    values and the recorder uninstalled (ops inside trace into XLA)."""
+    from ..core import autograd, dispatch
+    from ..core.tensor import Tensor
+
+    prev = dispatch.static_recorder
+    dispatch.static_recorder = None
+    saved = [v.__dict__.get("_replay_value") for v in captured]
+    for v, a in zip(captured, arrays):
+        v.__dict__["_replay_value"] = a
+    try:
+        with autograd._scoped(False):
+            try:
+                out = fn(*[Tensor(a) for a in args])
+            except TypeError as e:
+                if "ShapeDtypeStruct" in str(e):
+                    raise TypeError(
+                        "a control-flow callable touched a Variable that "
+                        "was not captured: only Variables held directly in "
+                        "the callable's closure (or in a closed-over "
+                        "list/tuple) are discovered — pass others through "
+                        "loop_vars, or close over them directly instead of "
+                        "via functools.partial/globals/dicts") from e
+                raise
+        # unwrap INSIDE the binding scope: a callable may return a captured
+        # Variable itself, whose value dies with the binding
+        return _unwrap_tree(out)
+    finally:
+        dispatch.static_recorder = prev
+        for v, s in zip(captured, saved):
+            if s is None:
+                v.__dict__.pop("_replay_value", None)
+            else:
+                v.__dict__["_replay_value"] = s
+
+
+def _unwrap_tree(x):
+    from ..core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return x._data
+    if isinstance(x, (list, tuple)):
+        return type(x)(_unwrap_tree(v) for v in x)
+    return x
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None, return_names=None):
+    """`paddle.static.nn.cond` — one XLA conditional
+    (fluid/layers/control_flow.py cond / conditional_block_op.cc)."""
+    import jax
+
+    from ..core.dispatch import forward
+
+    captured = _closure_variables(true_fn, false_fn)
+
+    def f(pred_arr, *cap_arrays):
+        def branch(fn):
+            def run(cap):
+                return _run_subtrace(fn, captured, cap)
+
+            return run
+
+        return jax.lax.cond(pred_arr.reshape(()).astype(bool),
+                            branch(true_fn), branch(false_fn),
+                            list(cap_arrays))
+
+    return forward(f, (pred, *captured), name="cond")
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """`paddle.static.nn.while_loop` — one XLA while loop
+    (fluid/layers/control_flow.py while_loop / controlflow/while_op.cc).
+    loop_vars are explicit (reference signature); the callables may also
+    close over other program Variables."""
+    import jax
+
+    from ..core.dispatch import forward
+
+    captured = _closure_variables(cond_fn, body_fn)
+    n_loop = len(loop_vars)
+
+    def f(*arrays):
+        loop_arrays = list(arrays[:n_loop])
+        cap_arrays = list(arrays[n_loop:])
+
+        def cond_w(carry):
+            out = _run_subtrace(cond_fn, captured, cap_arrays, args=carry)
+            return out.reshape(()).astype(bool)
+
+        def body_w(carry):
+            out = _run_subtrace(body_fn, captured, cap_arrays, args=carry)
+            return list(out) if isinstance(out, (list, tuple)) else [out]
+
+        return tuple(jax.lax.while_loop(cond_w, body_w, loop_arrays))
+
+    # NOTE: XLA while has no reverse-mode transpose, so a loss that depends
+    # on while_loop output fails to differentiate — jax raises its standard
+    # "Reverse-mode differentiation does not work for lax.while_loop"
+    # message at Executor time. For training, use a fixed trip count
+    # (unrollable) or keep the loop out of the loss path. The reference
+    # backprops its While op via sub-block replay; matching that needs a
+    # bounded-trip scan formulation (future work).
+    return forward(f, (*loop_vars, *captured), name="while_loop")

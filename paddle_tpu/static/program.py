@@ -34,6 +34,11 @@ __all__ = ["Program", "Variable", "program_guard", "default_main_program",
            "default_startup_program", "data", "name_scope"]
 
 
+class UncapturedVariableError(RuntimeError):
+    """A control-flow callable touched a Variable that was not discovered
+    as a closure capture (static.nn._closure_variables)."""
+
+
 class Variable(Tensor):
     """Symbolic tensor in a Program (framework.py Variable equivalent)."""
 
@@ -76,14 +81,39 @@ class Variable(Tensor):
             tuple(placeholder if s == -1 else s for s in self._static_shape),
             self._np_dtype)
 
-    # record-time helpers: some op wrappers read x._data.shape
+    # record-time helpers: some op wrappers read x._data.shape. During a
+    # control-flow subtrace (static.nn.cond/while_loop) the Variable carries
+    # a live traced value instead (set via _replay_value by the control-flow
+    # ops, for callables that close over program Variables). Reading _data
+    # with the recorder uninstalled and no bound value is the illegal state
+    # of an UNCAPTURED Variable inside a control-flow callable — raise with
+    # guidance instead of leaking an aval into the trace.
     @property
     def _data(self):
+        rv = self.__dict__.get("_replay_value")
+        if rv is not None:
+            return rv
+        if dispatch.static_recorder is None:
+            raise UncapturedVariableError(
+                f"Variable {self.name!r} was used inside a control-flow "
+                "callable but was not captured. Only Variables held "
+                "directly in the callable's closure (or a closed-over "
+                "list/tuple) are discovered — reference it from an "
+                "enclosing function scope (module-level globals are not "
+                "closure cells), or pass it through loop_vars.")
         return self.aval()
 
     @_data.setter
     def _data(self, v):
         pass
+
+    def __bool__(self):
+        raise TypeError(
+            f"Variable {self.name!r} used in a python bool context during "
+            "static recording. Data-dependent python control flow cannot be "
+            "captured in a Program — use paddle.static.nn.cond / "
+            "paddle.static.nn.while_loop (compiled to XLA control flow) "
+            "instead of if/while on tensor values.")
 
     def numpy(self):
         scope = global_scope()
@@ -98,14 +128,15 @@ class Variable(Tensor):
 
 
 class OpRecord:
-    __slots__ = ("fn", "name", "inputs", "attrs", "outputs")
+    __slots__ = ("fn", "name", "inputs", "attrs", "outputs", "nondiff")
 
-    def __init__(self, fn, name, inputs, attrs, outputs):
+    def __init__(self, fn, name, inputs, attrs, outputs, nondiff=False):
         self.fn = fn
         self.name = name
         self.inputs = inputs  # list of Variable | concrete jax/np array
         self.attrs = attrs
         self.outputs = outputs  # list of Variable
+        self.nondiff = nondiff  # replay must keep bool/index ops off the tape
 
 
 class Program:
@@ -203,7 +234,7 @@ class name_scope:
 
 # -- the recorder hook (installed into core.dispatch) -------------------------
 
-def _recorder(fn, name, inputs, attrs):
+def _recorder(fn, name, inputs, attrs, nondiff=False):
     prog = _main_program
     in_refs = []
     for x in inputs:
@@ -241,6 +272,8 @@ def _recorder(fn, name, inputs, attrs):
     try:
         out_a = _eval(2)
         out_b = _eval(3) if has_dynamic else out_a
+    except UncapturedVariableError:
+        raise  # control-flow capture bug: surface at record time
     except Exception:
         out_a = out_b = None
 
@@ -264,15 +297,15 @@ def _recorder(fn, name, inputs, attrs):
         outs = [mk_var(out_a, out_b)]
         multi = False
 
-    prog.ops.append(OpRecord(fn, name, in_refs, attrs, outs))
+    prog.ops.append(OpRecord(fn, name, in_refs, attrs, outs, nondiff))
     return tuple(outs) if multi else outs[0]
 
 
 class _Recorder:
     """Bound as dispatch.static_recorder; also carries optimizer hooks."""
 
-    def __call__(self, fn, name, inputs, attrs):
-        return _recorder(fn, name, inputs, attrs)
+    def __call__(self, fn, name, inputs, attrs, nondiff=False):
+        return _recorder(fn, name, inputs, attrs, nondiff)
 
     def minimize(self, optimizer, loss):
         _main_program.minimize_reqs.append((optimizer, loss))

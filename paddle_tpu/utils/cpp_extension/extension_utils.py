@@ -190,8 +190,20 @@ def load(name, sources, extra_cflags=None, extra_cuda_cflags=None,
          verbose=False):
     """`paddle.utils.cpp_extension.load` (cpp_extension.py:800): JIT-build
     the sources and return a module-like object exposing each exported op."""
-    build_dir = build_directory or os.path.join(
-        tempfile.gettempdir(), "paddle_tpu_extensions")
+    if build_directory:
+        build_dir = build_directory
+    else:
+        # per-user 0700 cache dir: a shared predictable /tmp path would let
+        # another local user plant a poisoned cached .so + .hash pair
+        build_dir = os.path.join(
+            os.path.expanduser("~"), ".cache", "paddle_tpu", "extensions")
+        os.makedirs(build_dir, mode=0o700, exist_ok=True)
+        st = os.stat(build_dir)
+        if st.st_uid != os.getuid():
+            raise RuntimeError(
+                f"extension cache dir {build_dir} is owned by uid "
+                f"{st.st_uid}, not the current user; refusing to trust "
+                "cached builds (pass build_directory= explicitly)")
     so_path = _build_so(name, sources, extra_cflags,
                         extra_include_paths or [], build_dir)
     lib = ctypes.CDLL(so_path)

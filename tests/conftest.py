@@ -29,3 +29,14 @@ except RuntimeError:  # a backend already initialized — reset, then retry
 
     clear_backends()
     jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _clear_jax_caches_per_module():
+    """Drop compiled executables between test modules: a full-suite process
+    otherwise accumulates every jitted step (the hybrid-engine ones are
+    large) and the XLA CPU compiler can abort under the memory pressure."""
+    yield
+    jax.clear_caches()

@@ -234,3 +234,72 @@ class TestProfiler:
             prof.step()
         prof.stop()
         assert "steps=" in prof.summary()
+
+
+class TestHapiCallbacks:
+    """Reference hapi/callbacks tests: EarlyStopping / ReduceLROnPlateau /
+    ModelCheckpoint / VisualDL drive Model.fit."""
+
+    def _model(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi import Model
+
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8), paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 2))
+        m = Model(net)
+        m.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss())
+        return m
+
+    def _data(self):
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((64, 4)).astype(np.float32)
+        y = (X.sum(1) > 0).astype(np.int64)
+        return [(X[i:i + 8], y[i:i + 8]) for i in range(0, 64, 8)]
+
+    def test_early_stopping_stops(self):
+        from paddle_tpu.hapi.callbacks import EarlyStopping
+
+        m = self._model()
+        es = EarlyStopping(monitor="loss", patience=1, baseline=-1e9,
+                           verbose=0, save_best_model=False)
+        m.fit(self._data(), epochs=10, callbacks=[es], verbose=0)
+        # baseline -inf means no improvement is ever possible -> stop early
+        assert m.stop_training
+
+    def test_reduce_lr_on_plateau(self):
+        from paddle_tpu.hapi.callbacks import ReduceLROnPlateau
+
+        m = self._model()
+        cb = ReduceLROnPlateau(monitor="loss", factor=0.5, patience=0,
+                               verbose=0)
+        cb.set_model(m)
+        cb.on_train_begin()
+        cb.on_epoch_end(0, {"loss": 1.0})   # sets best
+        lr0 = float(m._optimizer.get_lr())
+        cb.on_epoch_end(1, {"loss": 2.0})   # worse -> reduce
+        assert float(m._optimizer.get_lr()) == pytest.approx(lr0 * 0.5)
+
+    def test_model_checkpoint(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import ModelCheckpoint
+
+        m = self._model()
+        m.fit(self._data(), epochs=2,
+              callbacks=[ModelCheckpoint(save_freq=1,
+                                         save_dir=str(tmp_path))],
+              verbose=0)
+        import os
+
+        assert os.path.exists(str(tmp_path / "final.pdparams")) or \
+            os.path.exists(str(tmp_path / "final"))
+
+    def test_visualdl_writes_scalars(self, tmp_path):
+        from paddle_tpu.hapi.callbacks import VisualDL
+
+        m = self._model()
+        m.fit(self._data(), epochs=1,
+              callbacks=[VisualDL(log_dir=str(tmp_path))], verbose=0)
+        import json
+
+        lines = open(str(tmp_path / "scalars.jsonl")).read().splitlines()
+        assert lines and all("tag" in json.loads(ln) for ln in lines)

@@ -135,3 +135,93 @@ class TestVisionModels:
             opt.clear_grad()
             losses.append(float(loss))
         assert losses[-1] < losses[0]
+
+
+class TestNewVisionModels:
+    """densenet/googlenet/inceptionv3/shufflenetv2 (reference
+    python/paddle/vision/models/) — forward shape + one grad step."""
+
+    def _check(self, model, size=64, n_out=10, tuple_out=False):
+        x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+            (2, 3, size, size)).astype(np.float32))
+        model.train()
+        out = model(x)
+        main = out[0] if tuple_out else out
+        assert tuple(main.shape) == (2, n_out)
+        loss = main.sum() if not tuple_out else sum(
+            o.sum() for o in out if o is not None)
+        loss.backward()
+        g = model.parameters()[0].grad
+        assert g is not None and np.isfinite(np.asarray(g.numpy())).all()
+
+    def test_densenet121(self):
+        from paddle_tpu.vision.models import densenet121
+
+        self._check(densenet121(num_classes=10))
+
+    def test_googlenet(self):
+        from paddle_tpu.vision.models import googlenet
+
+        self._check(googlenet(num_classes=10), tuple_out=True)
+
+    def test_inception_v3(self):
+        from paddle_tpu.vision.models import inception_v3
+
+        self._check(inception_v3(num_classes=10), size=96)
+
+    def test_shufflenet_v2(self):
+        from paddle_tpu.vision.models import shufflenet_v2_x0_25
+
+        self._check(shufflenet_v2_x0_25(num_classes=10))
+
+
+class TestAudio:
+    def test_feature_pipeline(self):
+        sr = 8000
+        tt = np.arange(sr, dtype=np.float32) / sr
+        wave = np.sin(2 * np.pi * 440 * tt)[None]
+        x = paddle.to_tensor(wave)
+        mel = paddle.audio.features.MelSpectrogram(sr=sr, n_fft=256,
+                                                   n_mels=32)(x)
+        assert tuple(mel.shape)[:2] == (1, 32)
+        mfcc = paddle.audio.features.MFCC(sr=sr, n_mfcc=13, n_fft=256,
+                                          n_mels=32)(x)
+        assert tuple(mfcc.shape)[:2] == (1, 13)
+
+    def test_fbank_rows_normalized(self):
+        fb = np.asarray(paddle.audio.functional.compute_fbank_matrix(
+            8000, 256, n_mels=20).numpy())
+        assert fb.shape == (20, 129)
+        assert (fb >= 0).all() and fb.sum(-1).min() > 0
+
+    def test_wav_roundtrip(self, tmp_path):
+        sr = 8000
+        wave = np.sin(np.linspace(0, 100, sr)).astype(np.float32)[None]
+        p = str(tmp_path / "t.wav")
+        paddle.audio.save(p, paddle.to_tensor(wave), sr)
+        w2, sr2 = paddle.audio.load(p)
+        assert sr2 == sr
+        np.testing.assert_allclose(np.asarray(w2.numpy()).squeeze(),
+                                   wave[0], atol=1e-3)
+        inf = paddle.audio.info(p)
+        assert inf.sample_rate == sr and inf.num_channels == 1
+
+
+def test_ihfft2_regression():
+    # ADVICE: ihfft2 previously compressed ifft->ihfft in the wrong order
+    # and raised for every input
+    x = np.random.default_rng(0).standard_normal((4, 6)).astype(np.float32)
+    out = paddle.fft.ihfft2(paddle.to_tensor(x))
+    ref = np.fft.ifft(np.fft.ihfft(x, axis=-1), axis=-2)
+    np.testing.assert_allclose(np.asarray(out.numpy()), ref, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_multinomial_entropy_regression():
+    # ADVICE: entropy lacked the combinatorial correction terms
+    from paddle_tpu.distribution import Multinomial
+
+    m = Multinomial(10, paddle.to_tensor(
+        np.array([0.2, 0.3, 0.5], np.float32)))
+    ent = float(m.entropy())
+    assert 3.30 < ent < 3.38  # MC reference 3.3412

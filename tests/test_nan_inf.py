@@ -1,0 +1,60 @@
+"""FLAGS_check_nan_inf consumer (reference check_nan_inf_base_dygraph.py /
+nan_inf_utils_detail.cc tests): a seeded NaN/Inf aborts with the op name."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+@pytest.fixture
+def nan_flag():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    yield
+    paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+def test_eager_nan_raises_with_op_name(nan_flag):
+    x = paddle.to_tensor(np.zeros(4, np.float32))
+    with pytest.raises(RuntimeError, match="divide.*Nan"):
+        x / x
+
+
+def test_eager_inf_raises(nan_flag):
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    z = paddle.to_tensor(np.zeros(4, np.float32))
+    with pytest.raises(RuntimeError, match="divide.*Inf"):
+        x / z
+
+
+def test_grad_path_checked(nan_flag):
+    x = paddle.to_tensor(np.array([-1.0, 4.0], np.float32))
+    x.stop_gradient = False
+    with pytest.raises(RuntimeError, match="sqrt.*Nan"):
+        paddle.sqrt(x)
+
+
+def test_clean_ops_pass(nan_flag):
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    y = (x * 2 + 1).sum()
+    assert float(y) == 12.0
+
+
+def test_static_executor_debug_mode(nan_flag):
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            x = paddle.static.data("x", [None, 2], "float32")
+            y = paddle.log(x)  # log(-1) = nan
+        exe = paddle.static.Executor()
+        with pytest.raises(RuntimeError, match="log.*Nan"):
+            exe.run(prog, feed={"x": -np.ones((2, 2), np.float32)},
+                    fetch_list=[y])
+    finally:
+        paddle.disable_static()
+
+
+def test_flag_off_no_check():
+    x = paddle.to_tensor(np.zeros(2, np.float32))
+    out = x / x  # quietly NaN, like the reference default
+    assert np.isnan(np.asarray(out.numpy())).all()

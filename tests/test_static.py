@@ -78,3 +78,75 @@ def test_ernie_static_inference(static_mode):
     out, = exe.run(prog, feed={"input_ids": ids}, fetch_list=[fetch])
     assert out.shape == (2, 128)  # pooled hidden
     paddle.enable_static()  # fixture symmetry
+
+
+def test_while_loop_counter_model(static_mode):
+    # VERDICT item 8 done-criterion: a while-loop counter model runs in
+    # static mode (reference fluid/layers/control_flow.py while_loop)
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [None, 4], "float32")
+        limit = paddle.static.data("limit", [1], "float32")
+
+        def cond_fn(i, acc):
+            return i < limit
+
+        def body_fn(i, acc):
+            return [i + 1.0, acc + x.sum()]
+
+        i0 = paddle.zeros([1], "float32")
+        acc0 = paddle.zeros([1], "float32")
+        i_out, acc_out = paddle.static.nn.while_loop(
+            cond_fn, body_fn, [i0, acc0])
+    exe = paddle.static.Executor()
+    xs = np.ones((2, 4), np.float32)
+    iv, av = exe.run(prog, feed={"x": xs,
+                                 "limit": np.array([5.0], np.float32)},
+                     fetch_list=[i_out, acc_out])
+    assert float(iv[0]) == 5.0
+    assert float(av[0]) == 5 * 8.0
+
+
+def test_cond_with_closure(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [None, 3], "float32")
+        pred = x.sum() > 0.0
+        out = paddle.static.nn.cond(pred,
+                                    lambda: x * 2.0,
+                                    lambda: x - 10.0)
+    exe = paddle.static.Executor()
+    pos = np.ones((2, 3), np.float32)
+    neg = -np.ones((2, 3), np.float32)
+    o1, = exe.run(prog, feed={"x": pos}, fetch_list=[out])
+    o2, = exe.run(prog, feed={"x": neg}, fetch_list=[out])
+    np.testing.assert_allclose(o1, pos * 2)
+    np.testing.assert_allclose(o2, neg - 10.0)
+
+
+def test_cond_grad_in_training(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [None, 2], "float32")
+        h = paddle.static.nn.fc(x, 4)
+        pred = h.sum() > 1e9  # always false -> scaled branch
+        out = paddle.static.nn.cond(pred, lambda: h, lambda: h * 0.5)
+        loss = (out * out).mean()
+        opt = paddle.optimizer.SGD(0.1)
+        opt.minimize(loss)
+    exe = paddle.static.Executor()
+    exe.run(paddle.static.default_startup_program())
+    xs = np.random.default_rng(0).standard_normal((8, 2)).astype(np.float32)
+    l0 = float(exe.run(prog, feed={"x": xs}, fetch_list=[loss])[0])
+    for _ in range(20):
+        lN = float(exe.run(prog, feed={"x": xs}, fetch_list=[loss])[0])
+    assert lN < l0  # gradients flowed through the conditional
+
+
+def test_python_bool_on_variable_raises(static_mode):
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        x = paddle.static.data("x", [None, 2], "float32")
+        with pytest.raises(TypeError, match="cond"):
+            if x.sum() > 0:  # data-dependent python branch
+                pass
