@@ -72,3 +72,81 @@ def check_grad(op_fn, inputs, wrt_list=None, attrs=None, rtol=1e-2, atol=1e-3,
         numeric = numeric_grad(op_fn, inputs, w, attrs, delta=delta)
         np.testing.assert_allclose(analytic, numeric, rtol=rtol, atol=atol,
                                    err_msg=f"grad mismatch wrt input {w}")
+
+
+# ---------------- dtype parametrization + dual-mode checks -------------------
+# (reference eager_op_test.py:2007 check_output runs static AND dygraph and
+# compares both against the numpy reference; :2164 check_grad is
+# dtype-parameterized with wider fp16/bf16 tolerances)
+
+BF16_RTOL = 2e-2
+BF16_ATOL = 2e-2
+
+
+def check_output_dtypes(op_fn, np_fn, inputs, attrs=None,
+                        dtypes=("float32", "bfloat16"), rtol=1e-5,
+                        atol=1e-6):
+    """check_output for each compute dtype; float inputs are cast, the
+    numpy reference always runs in float64 and the comparison tolerance
+    widens for bf16 (reference's place/dtype parametrization)."""
+    attrs = attrs or {}
+    for dt in dtypes:
+        cast_in = []
+        for a in inputs:
+            a = np.asarray(a)
+            if np.issubdtype(a.dtype, np.floating):
+                import jax.numpy as jnp
+
+                t = paddle.to_tensor(a.astype(np.float32))
+                if dt == "bfloat16":
+                    t = paddle.cast(t, "bfloat16")
+                cast_in.append(t)
+            else:
+                cast_in.append(paddle.to_tensor(a))
+        out = op_fn(*cast_in, **attrs)
+        ref = np_fn(*[np.asarray(a, np.float64)
+                      if np.issubdtype(np.asarray(a).dtype, np.floating)
+                      else np.asarray(a) for a in inputs], **attrs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        refs = ref if isinstance(ref, (tuple, list)) else [ref]
+        r, at = (BF16_RTOL, BF16_ATOL) if dt == "bfloat16" else (rtol, atol)
+        for o, expect in zip(outs, refs):
+            got = np.asarray(o.numpy(), np.float64)
+            np.testing.assert_allclose(
+                got, np.asarray(expect, np.float64), rtol=r, atol=at,
+                err_msg=f"dtype={dt}")
+
+
+def check_dygraph_static(op_fn, inputs, attrs=None, rtol=1e-5, atol=1e-6):
+    """Run the op eagerly AND as a recorded static Program through the
+    Executor; both must agree (reference dual-mode check,
+    eager_op_test.py:2007/1504)."""
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(np.asarray(a)) for a in inputs]
+    with paddle.no_grad():
+        eager = op_fn(*tensors, **attrs)
+    eager_outs = eager if isinstance(eager, (tuple, list)) else [eager]
+    eager_np = [np.asarray(o.numpy()) for o in eager_outs]
+
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            feeds = []
+            feed_dict = {}
+            for i, a in enumerate(inputs):
+                a = np.asarray(a)
+                v = paddle.static.data(f"in{i}", list(a.shape),
+                                       str(a.dtype))
+                feeds.append(v)
+                feed_dict[f"in{i}"] = a
+            out = op_fn(*feeds, **attrs)
+            fetch = list(out) if isinstance(out, (tuple, list)) else [out]
+        exe = paddle.static.Executor()
+        static_np = exe.run(prog, feed=feed_dict, fetch_list=fetch)
+    finally:
+        paddle.disable_static()
+    for e, s in zip(eager_np, static_np):
+        np.testing.assert_allclose(
+            np.asarray(s, np.float64), np.asarray(e, np.float64),
+            rtol=rtol, atol=atol, err_msg="static vs dygraph mismatch")
