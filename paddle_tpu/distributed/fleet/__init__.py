@@ -61,24 +61,9 @@ def is_server():
     return rm.is_server() if rm is not None else False
 
 
-def is_first_worker():
-    rm = _fleet_state.get("role_maker")
-    return rm.is_first_worker() if rm is not None else True
-
-
-def worker_num():
-    rm = _fleet_state.get("role_maker")
-    return rm.worker_num() if rm is not None else 1
-
-
 def server_num():
     rm = _fleet_state.get("role_maker")
     return rm.server_num() if rm is not None else 0
-
-
-def worker_index():
-    rm = _fleet_state.get("role_maker")
-    return rm.worker_index() if rm is not None else 0
 
 
 def init_worker():
@@ -157,22 +142,58 @@ def distributed_optimizer(optimizer, strategy=None):
 
 
 class UserDefinedRoleMaker:
-    def __init__(self, *a, **k):
-        pass
+    """Reference fleet/base/role_maker.py UserDefinedRoleMaker: explicit
+    role/rank instead of env parsing."""
+
+    def __init__(self, is_collective=False, current_id=0, role=1,
+                 worker_num=1, server_endpoints=(), **kwargs):
+        from ..ps import Role
+
+        self._current_id = current_id
+        self._role = role
+        self._worker_num = worker_num
+        self._server_endpoints = list(server_endpoints)
+        self._Role = Role
+
+    def is_worker(self):
+        return self._role == self._Role.WORKER
+
+    def is_server(self):
+        return self._role == self._Role.SERVER
+
+    def is_first_worker(self):
+        return self.is_worker() and self._current_id == 0
+
+    def worker_index(self):
+        return self._current_id
+
+    def server_index(self):
+        return self._current_id
+
+    def worker_num(self):
+        return self._worker_num
+
+    def server_num(self):
+        return len(self._server_endpoints)
 
 
-class PaddleCloudRoleMaker:
-    def __init__(self, *a, **k):
-        pass
+# the real env-parsing role maker lives in distributed.ps
+from ..ps import PaddleCloudRoleMaker  # noqa: F401,E402
 
 
 def worker_index():
+    rm = _fleet_state.get("role_maker")
+    if rm is not None:
+        return rm.worker_index()
     from .. import parallel_env
 
     return parallel_env.get_rank()
 
 
 def worker_num():
+    rm = _fleet_state.get("role_maker")
+    if rm is not None:
+        return rm.worker_num()
     from .. import parallel_env
 
     return parallel_env.get_world_size()
