@@ -13,6 +13,41 @@ def get_available_device():
     return ["tpu:0"] if is_compiled_with_tpu() else ["cpu"]
 
 
+def memory_stats(device=None):
+    """Device memory statistics (reference `fluid/memory/stats.cc` /
+    `DeviceManager::MemoryStats`, device_manager.h:169): PJRT owns the
+    allocator, so stats come from the device's live view rather than a
+    framework-side ledger. Returns a dict with bytes_in_use /
+    bytes_limit / peak_bytes_in_use (keys present when the backend
+    reports them; XLA-CPU reports none)."""
+    from .core.place import jax_device
+
+    dev = jax_device(device if isinstance(device, Place) else None)
+    try:
+        stats = dev.memory_stats()
+    except Exception:
+        stats = None
+    return dict(stats) if stats else {}
+
+
+def max_memory_allocated(device=None):
+    return memory_stats(device).get("peak_bytes_in_use", 0)
+
+
+def memory_allocated(device=None):
+    return memory_stats(device).get("bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    s = memory_stats(device)
+    return s.get("peak_pool_bytes", s.get("peak_bytes_in_use", 0))
+
+
+def memory_reserved(device=None):
+    s = memory_stats(device)
+    return s.get("pool_bytes", s.get("bytes_in_use", 0))
+
+
 class cuda:  # namespace shim: paddle.device.cuda.*
     @staticmethod
     def device_count():
@@ -23,6 +58,20 @@ class cuda:  # namespace shim: paddle.device.cuda.*
         import jax
 
         (jax.device_put(0) + 0).block_until_ready()
+
+    # reference paddle.device.cuda.memory_* surface → PJRT stats
+    memory_allocated = staticmethod(memory_allocated)
+    max_memory_allocated = staticmethod(max_memory_allocated)
+    memory_reserved = staticmethod(memory_reserved)
+    max_memory_reserved = staticmethod(max_memory_reserved)
+
+    @staticmethod
+    def empty_cache():
+        # PJRT's allocator has no user-facing cache-drop; jax's live-array
+        # deletion happens via GC. Provided for API parity.
+        import gc
+
+        gc.collect()
 
 
 def synchronize(device=None):

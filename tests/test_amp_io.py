@@ -303,3 +303,16 @@ class TestHapiCallbacks:
 
         lines = open(str(tmp_path / "scalars.jsonl")).read().splitlines()
         assert lines and all("tag" in json.loads(ln) for ln in lines)
+
+
+class TestMemoryStats:
+    """Reference fluid/memory/stats.cc surface over PJRT device stats."""
+
+    def test_memory_stats_shape(self):
+        import paddle_tpu as paddle
+
+        s = paddle.device.memory_stats()
+        assert isinstance(s, dict)  # XLA-CPU may report no counters
+        assert paddle.device.memory_allocated() >= 0
+        assert paddle.device.max_memory_allocated() >= 0
+        paddle.device.cuda.empty_cache()
