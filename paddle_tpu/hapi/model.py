@@ -25,10 +25,19 @@ class Model:
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
+        """Reference model.py prepare: bind optimizer/loss/metrics and the
+        AMP mode. amp_configs: "O1"/"O2" or {"level": ...} — the auto_cast
+        context wraps the compiled train step (bf16 compute on TPU)."""
         self._optimizer = optimizer
         self._loss = loss
         self._metrics = metrics if isinstance(metrics, (list, tuple)) else (
             [metrics] if metrics else [])
+        if isinstance(amp_configs, str):
+            self._amp_level = amp_configs
+        elif isinstance(amp_configs, dict):
+            self._amp_level = amp_configs.get("level", "O1")
+        else:
+            self._amp_level = None
 
     # -- single-batch ops ------------------------------------------------------
     def _compute_loss(self, outputs, labels):
@@ -44,11 +53,21 @@ class Model:
             from .. import jit
 
             def step(*args):
+                import contextlib
+
                 n_in = self._n_inputs
                 ins, labs = args[:n_in], args[n_in:]
-                out = self.network(*ins)
-                loss = self._compute_loss(out, list(labs) if len(labs) > 1
-                                          else labs[0])
+                amp = getattr(self, "_amp_level", None)
+                ctx = contextlib.nullcontext()
+                if amp:
+                    from ..amp import auto_cast
+
+                    ctx = auto_cast(enable=True, level=amp,
+                                    dtype="bfloat16")  # TPU-first default
+                with ctx:
+                    out = self.network(*ins)
+                    loss = self._compute_loss(out, list(labs)
+                                              if len(labs) > 1 else labs[0])
                 loss.backward()
                 self._optimizer.step()
                 self._optimizer.clear_grad()
@@ -105,16 +124,25 @@ class Model:
                 break
             cbs.on_epoch_begin(epoch)
             for step, batch in enumerate(loader):
+                cbs.on_train_batch_begin(step)
                 *xs, y = batch if isinstance(batch, (list, tuple)) else [batch]
                 loss = self.train_batch(xs, y)
                 logs = {"loss": loss[0]}
+                if self._optimizer is not None:
+                    try:
+                        logs["lr"] = float(self._optimizer.get_lr())
+                    except Exception:
+                        pass
                 cbs.on_train_batch_end(step, logs)
-            history.append(logs)
+            history.append(dict(logs))
             cbs.on_epoch_end(epoch, logs)
             if eval_data is not None and (epoch + 1) % eval_freq == 0:
                 cbs.on_eval_begin()
                 eval_logs = self.evaluate(eval_data, batch_size=batch_size,
-                                          verbose=0)
+                                          verbose=0, callbacks=cbs)
+                history[-1].update({f"eval_{k}": v
+                                    for k, v in eval_logs.items()
+                                    if v is not None})
                 cbs.on_eval_end(eval_logs)
             if save_dir and (epoch + 1) % save_freq == 0:
                 self.save(f"{save_dir}/{epoch}")
@@ -125,19 +153,31 @@ class Model:
                  num_workers=0, callbacks=None):
         loader = eval_data if isinstance(eval_data, DataLoader) else \
             DataLoader(eval_data, batch_size=batch_size)
+        cbs = callbacks
         for m in self._metrics:
             m.reset()
         losses = []
-        for batch in loader:
+        for step, batch in enumerate(loader):
+            if cbs is not None:
+                cbs.on_eval_batch_begin(step)
             *xs, y = batch if isinstance(batch, (list, tuple)) else [batch]
             out, res = self.eval_batch(xs, y)
             if res["loss"] is not None:
                 losses.append(res["loss"])
             for m in self._metrics:
                 m.update(m.compute(out, y) if hasattr(m, "compute") else out)
+            if cbs is not None:
+                cbs.on_eval_batch_end(step, res)
         logs = {"loss": float(np.mean(losses)) if losses else None}
         for m in self._metrics:
-            logs[m.name()] = m.accumulate()
+            name = m.name()
+            acc = m.accumulate()
+            if isinstance(name, (list, tuple)):
+                for n, a in zip(name, acc if isinstance(
+                        acc, (list, tuple)) else [acc]):
+                    logs[n] = a
+            else:
+                logs[name] = acc
         return logs
 
     def predict(self, test_data, batch_size=1, num_workers=0,

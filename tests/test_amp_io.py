@@ -316,3 +316,32 @@ class TestMemoryStats:
         assert paddle.device.memory_allocated() >= 0
         assert paddle.device.max_memory_allocated() >= 0
         paddle.device.cuda.empty_cache()
+
+
+class TestHapiModelDepth:
+    def test_fit_with_eval_and_amp(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.metric import Accuracy
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((64, 4)).astype(np.float32)
+        y = (X.sum(1) > 0).astype(np.int64)
+        data = [(X[i], y[i]) for i in range(48)]       # per-sample dataset
+        ev = [(X[i], y[i]) for i in range(48, 64)]
+
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 16),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(16, 2))
+        m = Model(net)
+        m.prepare(paddle.optimizer.Adam(0.05, parameters=net.parameters()),
+                  paddle.nn.CrossEntropyLoss(), metrics=Accuracy(),
+                  amp_configs="O1")
+        hist = m.fit(data, eval_data=ev, batch_size=8, epochs=3, verbose=0)
+        assert len(hist) == 3
+        assert "lr" in hist[0] and "eval_loss" in hist[-1]
+        assert hist[-1]["loss"] < hist[0]["loss"]
+        ev_logs = m.evaluate(ev, batch_size=8, verbose=0)
+        assert ev_logs["loss"] is not None
+        acc_key = [k for k in ev_logs if k != "loss"][0]
+        assert 0.0 <= float(np.asarray(ev_logs[acc_key]).reshape(-1)[0]) <= 1.0
