@@ -12,6 +12,7 @@ from .. import auto_parallel as auto  # noqa: F401  (fleet.auto namespace)
 from .hybrid_engine import HybridParallelEngine  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from . import utils  # noqa: F401
+from . import metrics  # noqa: F401
 
 _fleet_state = {"initialized": False, "hcg": None, "strategy": None}
 

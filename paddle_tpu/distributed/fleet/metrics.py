@@ -1,0 +1,92 @@
+"""Cross-rank metric aggregation.
+
+Reference: `python/paddle/distributed/fleet/metrics/metric.py` — sum/max/
+min/auc/acc helpers that allreduce locally-computed metric counters across
+the data-parallel group before deriving the final value.
+
+TPU re-design: a "local metric" is whatever slice of the batch this shard
+scored. For sharded arrays the aggregation is the eager compiled
+collective (`distributed.collective`); replicated values pass through
+(single-controller SPMD already holds the global value). The derived
+metrics (acc, auc) aggregate their COUNTERS, not their ratios — same
+pitfall the reference API exists to avoid.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...core.tensor import Tensor
+from .. import collective
+
+__all__ = ["sum", "max", "min", "mean", "acc", "auc"]
+
+_builtin_sum = sum
+_builtin_max = max
+_builtin_min = min
+
+
+def _to_tensor(x):
+    if isinstance(x, Tensor):
+        return x
+    import jax.numpy as jnp
+
+    return Tensor(jnp.asarray(np.asarray(x, np.float64).astype(np.float32)))
+
+
+def _reduce(local, op, group):
+    t = _to_tensor(local)
+    group = group or collective._default_group()
+    if group.nranks <= 1:
+        return np.asarray(t.numpy())
+    collective.all_reduce(t, op=op, group=group)
+    return np.asarray(t.numpy())
+
+
+def sum(local_value, group=None):  # noqa: A001 — reference API name
+    """Global sum of a local counter (metric.py sum)."""
+    return _reduce(local_value, collective.ReduceOp.SUM, group)
+
+
+def max(local_value, group=None):  # noqa: A001
+    return _reduce(local_value, collective.ReduceOp.MAX, group)
+
+
+def min(local_value, group=None):  # noqa: A001
+    return _reduce(local_value, collective.ReduceOp.MIN, group)
+
+
+def mean(local_value, group=None):
+    group = group or collective._default_group()
+    total = sum(local_value, group)
+    return total / _builtin_max(group.nranks, 1)
+
+
+def acc(correct, total, group=None):
+    """Global accuracy from per-rank (correct, total) counters
+    (metric.py acc): allreduce both counters, then divide."""
+    c = sum(correct, group)
+    t = sum(total, group)
+    return float(np.asarray(c).reshape(-1)[0] /
+                 _builtin_max(float(np.asarray(t).reshape(-1)[0]), 1.0))
+
+
+def auc(stat_pos, stat_neg, group=None):
+    """Global AUC from per-rank positive/negative prediction histograms
+    (metric.py auc): allreduce the histograms, then integrate."""
+    pos = np.asarray(sum(stat_pos, group), np.float64).reshape(-1)
+    neg = np.asarray(sum(stat_neg, group), np.float64).reshape(-1)
+    # walk thresholds from high to low accumulating TPR/FPR increments
+    tot_pos = pos.sum()
+    tot_neg = neg.sum()
+    if tot_pos == 0 or tot_neg == 0:
+        return 0.5
+    area = 0.0
+    cum_pos = 0.0
+    cum_neg = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_pos = cum_pos + pos[i]
+        new_neg = cum_neg + neg[i]
+        # trapezoid on the ROC curve segment this bucket contributes
+        area += (new_neg - cum_neg) * (cum_pos + new_pos) / 2.0
+        cum_pos, cum_neg = new_pos, new_neg
+    return float(area / (tot_pos * tot_neg))

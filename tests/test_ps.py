@@ -77,3 +77,29 @@ def test_runtime_tables():
     s = rt.create_sparse_table("emb", 4)
     assert rt.get_table("w") is d and rt.get_table("emb") is s
     rt.barrier()
+
+
+class TestFleetMetrics:
+    """Reference fleet/metrics/metric.py: aggregate counters, not ratios."""
+
+    def test_acc_counters(self):
+        from paddle_tpu.distributed.fleet import metrics
+
+        # single-controller: values are already global; acc = c/t
+        assert metrics.acc(np.array([30.0]), np.array([40.0])) == 0.75
+
+    def test_auc_from_histograms(self):
+        from paddle_tpu.distributed.fleet import metrics
+
+        # perfect separation: all negatives in low bucket, positives high
+        pos = np.array([0.0, 0.0, 0.0, 10.0])
+        neg = np.array([10.0, 0.0, 0.0, 0.0])
+        assert metrics.auc(pos, neg) == pytest.approx(1.0)
+        # random: identical histograms -> 0.5
+        both = np.array([5.0, 5.0, 5.0, 5.0])
+        assert metrics.auc(both, both) == pytest.approx(0.5)
+
+    def test_sum_mean(self):
+        from paddle_tpu.distributed.fleet import metrics
+
+        np.testing.assert_allclose(metrics.sum(np.array([3.0])), [3.0])
