@@ -34,12 +34,23 @@ def _to_tensor(x):
 
 
 def _reduce(local, op, group):
+    """Single-controller SPMD: a replicated metric value is ALREADY the
+    global value (one logical copy), so reduction only applies to an
+    explicit per-rank stack (leading dim == group size — the layout the
+    reference's per-process locals correspond to)."""
     t = _to_tensor(local)
     group = group or collective._default_group()
-    if group.nranks <= 1:
-        return np.asarray(t.numpy())
-    collective.all_reduce(t, op=op, group=group)
-    return np.asarray(t.numpy())
+    arr = np.asarray(t.numpy())
+    if group.nranks <= 1 or arr.ndim == 0 or \
+            arr.shape[0] != group.nranks:
+        return arr
+    if op == collective.ReduceOp.SUM:
+        return arr.sum(0)
+    if op == collective.ReduceOp.MAX:
+        return arr.max(0)
+    if op == collective.ReduceOp.MIN:
+        return arr.min(0)
+    return arr.sum(0)
 
 
 def sum(local_value, group=None):  # noqa: A001 — reference API name
