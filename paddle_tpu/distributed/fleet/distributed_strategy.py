@@ -2,12 +2,29 @@
 
 Reference: `python/paddle/distributed/fleet/base/distributed_strategy.py:117`
 (protobuf-backed). Plain attributes here — the strategy surface that maps to
-TPU concepts is kept; GPU-only toggles (dgc, localsgd, fp16_allreduce) are
-accepted and ignored with the same defaults so reference configs parse.
+TPU concepts is kept. Toggles whose semantics this build does NOT implement
+warn loudly when enabled (rather than silently dropping reference
+behavior); toggles that are satisfied by the architecture itself
+(fuse_all_reduce_ops → XLA fusion, find_unused_parameters → tape only
+grads touched params) stay silent because enabling them IS honored.
 """
 from __future__ import annotations
 
+import warnings
+
 __all__ = ["DistributedStrategy"]
+
+# field -> why it is inert here / what to use instead
+_INERT_TOGGLES = {
+    "dgc": "deep gradient compression has no XLA collective equivalent",
+    "localsgd": "use dp_degree with a larger batch instead",
+    "fp16_allreduce": "grads already reduce in the compute dtype (bf16)",
+    "lars": "pass a LARS-wrapped optimizer explicitly",
+    "lamb": "use paddle_tpu.optimizer.Lamb as the inner optimizer",
+    "gradient_merge": "use pipeline_configs['accumulate_steps']",
+    "a_sync": "async PS mode is out of scope (see distributed/ps)",
+    "heter_ccl_mode": "heterogeneous collectives are not supported",
+}
 
 
 class DistributedStrategy:
@@ -46,6 +63,13 @@ class DistributedStrategy:
         self.heter_ccl_mode = False
         self.a_sync = False
         self.a_sync_configs = {}
+
+    def __setattr__(self, key, value):
+        if value and key in _INERT_TOGGLES:
+            warnings.warn(
+                f"DistributedStrategy.{key} has no effect in this build: "
+                f"{_INERT_TOGGLES[key]}", stacklevel=2)
+        object.__setattr__(self, key, value)
 
     def __repr__(self):
         keys = ["hybrid_configs", "amp", "recompute", "sharding", "pipeline"]

@@ -165,3 +165,34 @@ def test_recompute_matches_plain():
     np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-5)
     np.testing.assert_allclose(g_rc, lin.weight.grad.numpy(), rtol=1e-5)
     np.testing.assert_allclose(gx_rc, x.grad.numpy(), rtol=1e-5)
+
+
+def test_inert_strategy_toggles_warn():
+    import warnings
+
+    s = fleet.DistributedStrategy()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        s.dgc = True
+        s.gradient_merge = True
+        s.recompute = True  # implemented: must NOT warn
+    msgs = [str(x.message) for x in w]
+    assert any("dgc" in m for m in msgs)
+    assert any("gradient_merge" in m for m in msgs)
+    assert not any("recompute" in m for m in msgs)
+
+
+def test_collective_task_semantics():
+    """ProcessGroup task handles (reference process_group.h:114-226): XLA
+    dispatch is async; wait() is the device sync."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.core.tensor import Tensor
+    from paddle_tpu.distributed import collective
+
+    fleet.init(is_collective=True)
+    g = collective.get_group(0)
+    t = Tensor(jnp.arange(8.0))
+    task = collective.all_reduce(t, group=g)
+    assert task.wait() is True
+    assert task.is_completed()
