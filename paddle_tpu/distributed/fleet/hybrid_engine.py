@@ -299,7 +299,17 @@ class HybridParallelEngine:
         Stage s therefore holds at most 2(pp-1-s)+1 ≤ 2·pp−1 in-flight
         microbatch INPUTS (not full activations: backward recomputes the
         stage forward from its saved input under jax.vjp, the recompute
-        trade the reference makes via recompute_hybrid.py). Activations and
+        trade the reference makes via recompute_hybrid.py).
+
+        Interleaved virtual stages (reference pipeline_parallel.py:461)
+        are deliberately NOT implemented: their benefit is bubble/V at the
+        cost of V× stage-transfer traffic, and in a lockstep SPMD scan the
+        naive depth-V·pp schedule would not reduce the bubble at all
+        (Megatron's fill-phase multi-chunk scheduling needs per-device
+        divergent control flow, which the XLA partitioner rejects — see
+        the lax.cond note below). The memory benefit interleave shares
+        with 1F1B is already delivered by this schedule; raise
+        accumulate_steps M to shrink the (pp−1)/M bubble instead. Activations and
         cotangents move stage-to-stage via p2p ppermute only; the sole
         collectives are the final scalar-loss/shared-weight-grad psums over
         'pp' (the reference's tied-embedding allreduce,

@@ -62,10 +62,15 @@ class TCPStore:
 
     def __init__(self, host="127.0.0.1", port=0, is_master=False,
                  world_size=1, timeout=30.0):
+        import threading
+
         lib = _load()
         self._lib = lib
         self._server = None
         self.timeout = timeout
+        # one request/response socket per client: serialize access so a
+        # heartbeat thread can't consume another thread's response
+        self._lock = threading.Lock()
         if is_master:
             self._server = lib.tcpstore_server_start(port)
             if not self._server:
@@ -86,8 +91,9 @@ class TCPStore:
     def set(self, key, value):
         if isinstance(value, str):
             value = value.encode()
-        rc = self._lib.tcpstore_set(self._client, key.encode(), value,
-                                    len(value))
+        with self._lock:
+            rc = self._lib.tcpstore_set(self._client, key.encode(), value,
+                                        len(value))
         if rc != 0:
             raise RuntimeError("TCPStore.set failed")
 
@@ -96,13 +102,14 @@ class TCPStore:
         deadline = time.time() + self.timeout
         buf = ctypes.create_string_buffer(1 << 20)
         while True:
-            n = self._lib.tcpstore_get(self._client, key.encode(), buf,
-                                       len(buf))
-            if n >= 0:
+            with self._lock:
+                n = self._lib.tcpstore_get(self._client, key.encode(), buf,
+                                           len(buf))
                 if n > len(buf):
                     buf = ctypes.create_string_buffer(int(n))
                     n = self._lib.tcpstore_get(self._client, key.encode(),
                                                buf, len(buf))
+            if n >= 0:
                 return buf.raw[:n]
             if n == -2:
                 raise RuntimeError("TCPStore.get transport error")
@@ -111,7 +118,8 @@ class TCPStore:
             time.sleep(0.02)
 
     def add(self, key, amount=1):
-        v = self._lib.tcpstore_add(self._client, key.encode(), amount)
+        with self._lock:
+            v = self._lib.tcpstore_add(self._client, key.encode(), amount)
         if v == -(2 ** 63):
             raise RuntimeError("TCPStore.add failed")
         return v
@@ -121,13 +129,18 @@ class TCPStore:
             keys = [keys]
         deadline = time.time() + (timeout or self.timeout)
         for k in keys:
-            while self._lib.tcpstore_check(self._client, k.encode()) != 1:
+            while self._check_locked(k) != 1:
                 if time.time() > deadline:
                     raise TimeoutError(f"TCPStore.wait({k!r}) timed out")
                 time.sleep(0.02)
 
+    def _check_locked(self, k):
+        with self._lock:
+            return self._lib.tcpstore_check(self._client, k.encode())
+
     def num_keys(self):
-        return self._lib.tcpstore_num_keys(self._client)
+        with self._lock:
+            return self._lib.tcpstore_num_keys(self._client)
 
     def __del__(self):
         try:
