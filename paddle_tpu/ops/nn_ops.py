@@ -1182,3 +1182,144 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
                    "m3": float(margin3), "s": float(scale),
                    "reduction": reduction}, name="margin_cross_entropy")
     return out if return_softmax else out[0]
+
+
+@_export
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid loss (reference
+    phi/kernels/cpu/hsigmoid_loss_kernel.cc): classify via a binary tree —
+    the default tree is the complete binary tree over num_classes leaves
+    (Huffman-style custom trees via path_table/path_code). Per sample:
+    loss = Σ_d softplus((1-2·code_d)·(w_{node_d}·x + b_{node_d}))."""
+    if path_table is None:
+        # complete-binary-tree paths: leaf = label + num_classes - 1 in a
+        # heap-ordered tree with num_classes-1 internal nodes
+        depth = int(np.ceil(np.log2(max(num_classes, 2))))
+        tables, codes = [], []
+        for c in range(num_classes):
+            node = c + num_classes - 1
+            t, k = [], []
+            while node > 0:
+                parent = (node - 1) // 2
+                t.append(parent)
+                k.append(node % 2)  # 1 if left child (odd index)
+                node = parent
+            t = t[::-1][:depth] + [-1] * max(0, depth - len(t))
+            k = k[::-1][:depth] + [0] * max(0, depth - len(k))
+            tables.append(t[:depth])
+            codes.append(k[:depth])
+        path_table = jnp.asarray(np.asarray(tables, np.int64))
+        path_code = jnp.asarray(np.asarray(codes, np.int64))
+    else:
+        path_table = path_table._data if hasattr(path_table, "_data") \
+            else jnp.asarray(path_table)
+        path_code = path_code._data if hasattr(path_code, "_data") \
+            else jnp.asarray(path_code)
+
+    def f(x, lab, w, *rest):
+        lab = lab.reshape(-1)
+        nodes = jnp.take(path_table, lab, axis=0)      # [B, D]
+        codes = jnp.take(path_code, lab, axis=0)       # [B, D]
+        valid = nodes >= 0
+        ni = jnp.clip(nodes, 0, w.shape[0] - 1)
+        wn = jnp.take(w, ni, axis=0)                   # [B, D, F]
+        logits = jnp.einsum("bdf,bf->bd", wn, x)
+        if rest:
+            logits = logits + jnp.take(rest[0].reshape(-1), ni, axis=0)
+        sgn = 1.0 - 2.0 * codes.astype(logits.dtype)
+        per_node = jax.nn.softplus(sgn * logits)
+        return jnp.sum(jnp.where(valid, per_node, 0.0), -1,
+                       keepdims=True)
+
+    ins = (input, label, weight) if bias is None else (input, label,
+                                                      weight, bias)
+    return forward(f, ins, name="hsigmoid_loss")
+
+
+@_export
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers for margin-based losses (reference
+    phi/kernels/gpu/class_center_sample_kernel.cu): keep all positive
+    classes, pad with sampled negatives to num_samples, return the labels
+    remapped into the sampled index space."""
+    lab = np.asarray(jax.device_get(
+        label._data if hasattr(label, "_data") else label)).reshape(-1)
+    pos = np.unique(lab)
+    if len(pos) >= num_samples:
+        sampled = pos[:num_samples]
+    else:
+        rng = np.random.default_rng(abs(hash(tuple(lab.tolist()))) % 2**32)
+        neg_pool = np.setdiff1d(np.arange(num_classes), pos)
+        extra = rng.choice(neg_pool, num_samples - len(pos), replace=False)
+        sampled = np.concatenate([pos, extra])
+    remap = -np.ones(num_classes, np.int64)
+    remap[sampled] = np.arange(len(sampled))
+    remapped = remap[lab]
+    from ..core.tensor import Tensor
+
+    return (Tensor(jnp.asarray(remapped)), Tensor(jnp.asarray(sampled)))
+
+
+@_export
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T transducer loss (reference phi/kernels/warprnnt — dynloaded
+    warprnnt): forward-variable DP over the (T, U) lattice in log space,
+    as a lax.scan over time with an in-row scan over the label axis.
+    input: [B, T, U+1, V] log-probs (or logits — normalized here)."""
+
+    def f(logits, lab, in_len, lab_len, *, blank):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        B, T, U1, V = logp.shape
+        blank_lp = logp[..., blank]                       # [B, T, U+1]
+        lab_c = jnp.clip(lab, 0, V - 1)
+        lab_lp = jnp.take_along_axis(
+            logp[:, :, :-1, :], jnp.broadcast_to(
+                lab_c[:, None, :, None], (B, T, U1 - 1, 1)), -1)[..., 0]
+        neg_inf = jnp.float32(-1e30)
+
+        def row_scan(alpha_prev_t, t):
+            # emit transitions within the row: alpha[t, u] from alpha[t,u-1]
+            blank_t = blank_lp[:, t]                      # [B, U+1]
+            lab_t = lab_lp[:, t]                          # [B, U]
+            from_top = jnp.where(
+                t > 0, alpha_prev_t + blank_lp[:, jnp.maximum(t - 1, 0)],
+                jnp.where(jnp.arange(U1)[None, :] == 0, 0.0, neg_inf))
+
+            def emit(carry, u):
+                cur = jnp.logaddexp(
+                    from_top[:, u],
+                    jnp.where(u > 0, carry + lab_t[:, jnp.maximum(u - 1, 0)],
+                              neg_inf))
+                # t==0 row: alpha[0,0]=0; alpha[0,u]=prefix label emits
+                cur = jnp.where(
+                    t == 0,
+                    jnp.where(u == 0, 0.0,
+                              carry + lab_t[:, jnp.maximum(u - 1, 0)]),
+                    cur)
+                return cur, cur
+
+            _, rows = jax.lax.scan(emit, jnp.full((B,), neg_inf),
+                                   jnp.arange(U1))
+            alpha_t = rows.T                              # [B, U+1]
+            return alpha_t, alpha_t
+
+        _, alphas = jax.lax.scan(row_scan,
+                                 jnp.full((B, U1), neg_inf),
+                                 jnp.arange(T))           # [T, B, U+1]
+        alphas = alphas.transpose(1, 0, 2)                # [B, T, U+1]
+        bi = jnp.arange(B)
+        t_last = jnp.clip(in_len - 1, 0, T - 1)
+        u_last = jnp.clip(lab_len, 0, U1 - 1)
+        final = alphas[bi, t_last, u_last] + blank_lp[bi, t_last, u_last]
+        loss = -final
+        if reduction == "mean":
+            return loss.mean()
+        if reduction == "sum":
+            return loss.sum()
+        return loss
+
+    return forward(f, (input, label, input_lengths, label_lengths),
+                   {"blank": blank}, name="rnnt_loss")

@@ -21,7 +21,8 @@ from ..core.tensor import Tensor
 
 __all__ = ["yolo_box", "prior_box", "box_coder", "nms", "roi_align",
            "roi_pool", "psroi_pool", "distribute_fpn_proposals",
-           "deform_conv2d", "generate_proposals", "RoIAlign", "RoIPool"]
+           "deform_conv2d", "generate_proposals", "yolo_loss", "RoIAlign",
+           "RoIPool"]
 
 
 def _unwrap(x):
@@ -572,3 +573,117 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     if return_rois_num:
         return rois, rscores, Tensor(jnp.asarray(np.asarray(nums, np.int32)))
     return rois, rscores
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 training loss (vision/ops.py yolo_loss /
+    detection/yolov3_loss_op.h): per-cell anchor matching by wh-IoU,
+    box SSE + objectness/class BCE, negatives ignored above
+    ignore_thresh. x: [N, na*(5+C), H, W]; gt_box: [N, G, 4] (cx cy w h,
+    image units); gt_label: [N, G]."""
+    xv = _unwrap(x).astype(jnp.float32)
+    gb = _unwrap(gt_box).astype(jnp.float32)
+    gl = _unwrap(gt_label)
+    na = len(anchor_mask)
+    N, C_, H, W = xv.shape
+    in_w = downsample_ratio * W
+    in_h = downsample_ratio * H
+    anc_all = np.asarray(anchors, np.float32).reshape(-1, 2)
+    anc = jnp.asarray(anc_all[np.asarray(anchor_mask)])  # [na, 2]
+    feat = xv.reshape(N, na, 5 + class_num, H, W)
+    tx, ty, tw, th, tobj = (feat[:, :, 0], feat[:, :, 1], feat[:, :, 2],
+                            feat[:, :, 3], feat[:, :, 4])
+    tcls = feat[:, :, 5:]                      # [N, na, C, H, W]
+
+    # normalized gt (0..1 in image space)
+    gx = gb[..., 0] / in_w
+    gy = gb[..., 1] / in_h
+    gw = gb[..., 2] / in_w
+    gh = gb[..., 3] / in_h
+    valid = (gw > 0) & (gh > 0)                # [N, G]
+
+    # best anchor per gt by wh-IoU against ALL anchors (reference matches
+    # across every scale's anchors, then trains only those in anchor_mask)
+    aw = jnp.asarray(anc_all[:, 0]) / in_w     # [A]
+    ah = jnp.asarray(anc_all[:, 1]) / in_h
+    inter = jnp.minimum(gw[..., None], aw) * jnp.minimum(gh[..., None], ah)
+    union = gw[..., None] * gh[..., None] + aw * ah - inter
+    best_anchor = jnp.argmax(inter / jnp.maximum(union, 1e-10), -1)  # [N,G]
+    mask_ids = jnp.asarray(np.asarray(anchor_mask))
+    matched = (best_anchor[..., None] == mask_ids)       # [N, G, na]
+
+    gi = jnp.clip((gx * W).astype(jnp.int32), 0, W - 1)  # [N, G]
+    gj = jnp.clip((gy * H).astype(jnp.int32), 0, H - 1)
+
+    # scatter gt targets onto the [na, H, W] grid
+    def one_image(args):
+        (txi, tyi, twi, thi, tobji, tclsi, gxi, gyi, gwi, ghi, gli, vi,
+         mi, gii, gji) = args
+        obj_target = jnp.zeros((na, H, W))
+        # per-gt one-hot grids accumulated
+        G = gxi.shape[0]
+        a_idx = jnp.argmax(mi, -1)             # [G] anchor slot (if any)
+        sel = vi & mi.any(-1)
+        cell = jnp.stack([a_idx, gji, gii], 1)  # [G, 3]
+        obj_target = obj_target.at[cell[:, 0], cell[:, 1], cell[:, 2]].max(
+            jnp.where(sel, 1.0, 0.0))
+        # box loss per matched gt, read pred at its cell
+        px = jax.nn.sigmoid(txi[cell[:, 0], cell[:, 1], cell[:, 2]])
+        py = jax.nn.sigmoid(tyi[cell[:, 0], cell[:, 1], cell[:, 2]])
+        pw = twi[cell[:, 0], cell[:, 1], cell[:, 2]]
+        ph = thi[cell[:, 0], cell[:, 1], cell[:, 2]]
+        tx_t = gxi * W - gii
+        ty_t = gyi * H - gji
+        tw_t = jnp.log(jnp.maximum(
+            gwi * in_w / jnp.take(anc[:, 0], a_idx), 1e-9))
+        th_t = jnp.log(jnp.maximum(
+            ghi * in_h / jnp.take(anc[:, 1], a_idx), 1e-9))
+        box_scale = 2.0 - gwi * ghi            # small boxes weigh more
+        box_loss = jnp.where(
+            sel, box_scale * ((px - tx_t) ** 2 + (py - ty_t) ** 2 +
+                              (pw - tw_t) ** 2 + (ph - th_t) ** 2), 0.0
+        ).sum()
+        # class BCE at matched cells
+        smooth = 1.0 / max(class_num, 1) if use_label_smooth else 0.0
+        cls_pred = tclsi[cell[:, 0][:, None],
+                         jnp.arange(class_num)[None, :],
+                         cell[:, 1][:, None],
+                         cell[:, 2][:, None]]  # [G, C]
+        onehot = jax.nn.one_hot(jnp.clip(gli, 0, class_num - 1), class_num)
+        cls_t = onehot * (1 - smooth) + smooth * (1 - onehot) \
+            if use_label_smooth else onehot
+        bce = jnp.maximum(cls_pred, 0) - cls_pred * cls_t + \
+            jnp.log1p(jnp.exp(-jnp.abs(cls_pred)))
+        cls_loss = jnp.where(sel[:, None], bce, 0.0).sum()
+        # objectness: positives BCE to 1; negatives BCE to 0 unless best
+        # IoU with any gt exceeds ignore_thresh
+        bx = (jax.nn.sigmoid(txi) + jnp.arange(W)) / W       # [na, H, W]
+        by = (jax.nn.sigmoid(tyi) + jnp.arange(H)[:, None]) / H
+        bw = jnp.exp(jnp.clip(twi, -10, 10)) * anc[:, 0, None, None] / in_w
+        bh = jnp.exp(jnp.clip(thi, -10, 10)) * anc[:, 1, None, None] / in_h
+        px1, px2 = bx - bw / 2, bx + bw / 2
+        py1, py2 = by - bh / 2, by + bh / 2
+        gx1 = (gxi - gwi / 2)[:, None, None, None]
+        gx2 = (gxi + gwi / 2)[:, None, None, None]
+        gy1 = (gyi - ghi / 2)[:, None, None, None]
+        gy2 = (gyi + ghi / 2)[:, None, None, None]
+        iw = jnp.maximum(jnp.minimum(px2, gx2) - jnp.maximum(px1, gx1), 0)
+        ih = jnp.maximum(jnp.minimum(py2, gy2) - jnp.maximum(py1, gy1), 0)
+        inter_ = iw * ih
+        uni = bw * bh + (gwi * ghi)[:, None, None, None] - inter_
+        iou = jnp.where(vi[:, None, None, None],
+                        inter_ / jnp.maximum(uni, 1e-10), 0.0)
+        best_iou = iou.max(0)                                # [na, H, W]
+        noobj_mask = (best_iou < ignore_thresh) & (obj_target < 0.5)
+        obj_bce = jnp.maximum(tobji, 0) - tobji * obj_target + \
+            jnp.log1p(jnp.exp(-jnp.abs(tobji)))
+        obj_loss = jnp.where((obj_target > 0.5) | noobj_mask, obj_bce,
+                             0.0).sum()
+        return box_loss + cls_loss + obj_loss
+
+    losses = jax.vmap(lambda *a: one_image(a))(
+        tx, ty, tw, th, tobj, tcls, gx, gy, gw, gh, gl, valid, matched,
+        gi, gj)
+    return Tensor(losses)

@@ -278,3 +278,79 @@ class TestPoolIndexRegressions:
         assert o.shape == (2, 3, 4)
         for j in range(3):
             np.testing.assert_allclose(o[:, j], priors, rtol=1e-5)
+
+
+class TestFinalCoverageOps:
+    """The last reference-registry ops: hsigmoid_loss, class_center_sample,
+    rnnt_loss (warprnnt), yolo_loss."""
+
+    def test_hsigmoid_loss_custom_path(self):
+        F = paddle.nn.functional
+        rng = np.random.default_rng(0)
+        x = t(rng.standard_normal((4, 6)).astype(np.float32))
+        label = t(np.array([0, 1, 2, 3], np.int64))
+        w = t(rng.standard_normal((3, 6)).astype(np.float32))
+        # explicit 2-level tree over 4 classes: root=0, internals 1,2
+        path_table = t(np.array([[0, 1], [0, 1], [0, 2], [0, 2]], np.int64))
+        path_code = t(np.array([[1, 1], [1, 0], [0, 1], [0, 0]], np.int64))
+        out = F.hsigmoid_loss(x, label, 4, w, path_table=path_table,
+                              path_code=path_code)
+        v = np.asarray(out.numpy())
+        assert v.shape == (4, 1) and (v > 0).all()
+        # oracle for sample 0: softplus(-(w0 x)) + softplus(-(w1 x))
+        xs = np.asarray(x.numpy())[0]
+        ws = np.asarray(w.numpy())
+        ref = np.log1p(np.exp(-(ws[0] @ xs))) + np.log1p(np.exp(-(ws[1] @ xs)))
+        np.testing.assert_allclose(v[0, 0], ref, rtol=1e-5)
+
+    def test_hsigmoid_default_tree(self):
+        F = paddle.nn.functional
+        rng = np.random.default_rng(1)
+        x = t(rng.standard_normal((3, 5)).astype(np.float32))
+        label = t(np.array([0, 3, 7], np.int64))
+        w = t(rng.standard_normal((7, 5)).astype(np.float32))  # C-1 nodes
+        out = F.hsigmoid_loss(x, label, 8, w)
+        v = np.asarray(out.numpy())
+        assert v.shape == (3, 1) and np.isfinite(v).all() and (v > 0).all()
+
+    def test_class_center_sample(self):
+        F = paddle.nn.functional
+        label = t(np.array([3, 9, 3, 17], np.int64))
+        remapped, sampled = F.class_center_sample(label, 20, 6)
+        r = np.asarray(remapped.numpy())
+        s = np.asarray(sampled.numpy())
+        assert len(s) == 6
+        assert set([3, 9, 17]).issubset(set(s.tolist()))
+        for orig, new in zip([3, 9, 3, 17], r.tolist()):
+            assert s[new] == orig
+
+    def test_rnnt_loss_reductions(self):
+        F = paddle.nn.functional
+        rng = np.random.default_rng(2)
+        logits = t(rng.standard_normal((2, 5, 3, 6)).astype(np.float32))
+        labels = t(rng.integers(1, 6, (2, 2)))
+        il = t(np.array([5, 4])); ll = t(np.array([2, 2]))
+        none = np.asarray(F.rnnt_loss(logits, labels, il, ll,
+                                      reduction="none").numpy())
+        mean = float(F.rnnt_loss(logits, labels, il, ll, reduction="mean"))
+        assert none.shape == (2,) and (none > 0).all()
+        np.testing.assert_allclose(mean, none.mean(), rtol=1e-6)
+
+    def test_yolo_loss_positive_and_sensitive(self):
+        from paddle_tpu.vision import ops as vops
+
+        rng = np.random.default_rng(3)
+        na, C, H, W = 3, 4, 8, 8
+        x = rng.standard_normal((1, na * (5 + C), H, W)).astype(np.float32)
+        gt_box = t(np.array([[[64., 64, 40, 40]]], np.float32))
+        gt_label = t(np.array([[1]], np.int64))
+        kw = dict(anchors=[116, 90, 156, 198, 373, 326],
+                  anchor_mask=[0, 1, 2], class_num=C,
+                  ignore_thresh=0.7, downsample_ratio=32)
+        l1 = float(np.asarray(vops.yolo_loss(t(x), gt_box, gt_label,
+                                             **kw).numpy())[0])
+        assert np.isfinite(l1) and l1 > 0
+        # moving predictions toward the target must change the loss
+        l2 = float(np.asarray(vops.yolo_loss(t(x * 0.5), gt_box, gt_label,
+                                             **kw).numpy())[0])
+        assert l1 != l2

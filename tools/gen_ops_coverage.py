@@ -107,6 +107,7 @@ ALIASES = {
     "matrix_rank_tol": "matrix_rank",
     "auc": "Auc",
     "dirichlet": "Dirichlet",
+    "warprnnt": "rnnt_loss",
 }
 
 # reference op name -> capability that covers it outside the flat-op surface
