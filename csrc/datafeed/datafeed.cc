@@ -1,0 +1,240 @@
+// Native multithreaded slot-file DataFeed — the TPU-build equivalent of
+// the reference's C++ Dataset/DataFeed stack
+// (paddle/fluid/framework/{data_feed.cc,data_set.cc}: MultiSlotDataFeed
+// parsing count-prefixed slot records with reader threads, InMemoryDataset
+// channels + local shuffle).
+//
+// Wire format (MultiSlotDataFeed line format): per line, for each slot in
+// declared order: "<count> <v0> <v1> ... ".  Float slots parse doubles,
+// id slots parse int64.  Variable-length slots batch as (concatenated
+// values, lod offsets) pairs — the LoDTensor layout.
+//
+// C ABI only (ctypes-bound from python, no pybind11 in this image).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fstream>
+#include <mutex>
+#include <random>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SlotData {
+  // one record's payload for one slot
+  std::vector<double> fvals;
+  std::vector<int64_t> ivals;
+};
+
+struct Record {
+  std::vector<SlotData> slots;
+};
+
+struct DataFeed {
+  int num_slots = 0;
+  std::vector<int> slot_is_float;  // 1 = float slot, 0 = int64 slot
+  int batch_size = 1;
+  std::vector<std::string> files;
+  std::vector<Record> records;
+  size_t cursor = 0;  // next record for batching
+  std::string error;
+  std::mutex mu;
+
+  // staging for the current batch
+  std::vector<std::vector<double>> batch_f;
+  std::vector<std::vector<int64_t>> batch_i;
+  std::vector<std::vector<int64_t>> batch_lod;
+};
+
+bool parse_line(const std::string& line, int num_slots,
+                const std::vector<int>& is_float, Record* rec,
+                std::string* err) {
+  std::istringstream is(line);
+  rec->slots.resize(num_slots);
+  for (int s = 0; s < num_slots; ++s) {
+    long long count = 0;
+    if (!(is >> count) || count < 0) {
+      *err = "bad slot count in line: " + line.substr(0, 80);
+      return false;
+    }
+    SlotData& sd = rec->slots[s];
+    if (is_float[s]) {
+      sd.fvals.resize(count);
+      for (long long i = 0; i < count; ++i) {
+        if (!(is >> sd.fvals[i])) {
+          *err = "short float slot in line: " + line.substr(0, 80);
+          return false;
+        }
+      }
+    } else {
+      sd.ivals.resize(count);
+      for (long long i = 0; i < count; ++i) {
+        if (!(is >> sd.ivals[i])) {
+          *err = "short id slot in line: " + line.substr(0, 80);
+          return false;
+        }
+      }
+    }
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ptdf_create(int num_slots, const int* slot_is_float, int batch_size) {
+  if (num_slots <= 0 || batch_size <= 0) return nullptr;
+  auto* df = new DataFeed();
+  df->num_slots = num_slots;
+  df->slot_is_float.assign(slot_is_float, slot_is_float + num_slots);
+  df->batch_size = batch_size;
+  return df;
+}
+
+void ptdf_destroy(void* h) { delete static_cast<DataFeed*>(h); }
+
+int ptdf_set_files(void* h, const char** paths, int n) {
+  auto* df = static_cast<DataFeed*>(h);
+  df->files.assign(paths, paths + n);
+  return 0;
+}
+
+// Parse all files with `nthreads` reader threads (reference
+// data_set.cc LoadIntoMemory -> per-thread DataFeed::LoadIntoMemory).
+int64_t ptdf_load_into_memory(void* h, int nthreads) {
+  auto* df = static_cast<DataFeed*>(h);
+  df->records.clear();
+  df->cursor = 0;
+  df->error.clear();
+  if (df->files.empty()) return 0;
+  nthreads = std::max(1, std::min<int>(nthreads, (int)df->files.size()));
+
+  std::vector<std::vector<Record>> partial(df->files.size());
+  std::atomic<size_t> next_file{0};
+  std::atomic<bool> failed{false};
+  auto worker = [&] {
+    for (;;) {
+      size_t fi = next_file.fetch_add(1);
+      if (fi >= df->files.size() || failed.load()) return;
+      std::ifstream in(df->files[fi]);
+      if (!in) {
+        std::lock_guard<std::mutex> g(df->mu);
+        df->error = "cannot open " + df->files[fi];
+        failed.store(true);
+        return;
+      }
+      std::string line;
+      while (std::getline(in, line)) {
+        if (line.empty()) continue;
+        Record rec;
+        std::string err;
+        if (!parse_line(line, df->num_slots, df->slot_is_float, &rec,
+                        &err)) {
+          std::lock_guard<std::mutex> g(df->mu);
+          df->error = df->files[fi] + ": " + err;
+          failed.store(true);
+          return;
+        }
+        partial[fi].push_back(std::move(rec));
+      }
+    }
+  };
+  std::vector<std::thread> threads;
+  for (int t = 0; t < nthreads; ++t) threads.emplace_back(worker);
+  for (auto& t : threads) t.join();
+  if (failed.load()) return -1;
+  for (auto& p : partial) {
+    for (auto& r : p) df->records.push_back(std::move(r));
+  }
+  return (int64_t)df->records.size();
+}
+
+void ptdf_local_shuffle(void* h, uint64_t seed) {
+  auto* df = static_cast<DataFeed*>(h);
+  std::mt19937_64 rng(seed);
+  std::shuffle(df->records.begin(), df->records.end(), rng);
+  df->cursor = 0;
+}
+
+int64_t ptdf_memory_size(void* h) {
+  return (int64_t)static_cast<DataFeed*>(h)->records.size();
+}
+
+void ptdf_rewind(void* h) { static_cast<DataFeed*>(h)->cursor = 0; }
+
+const char* ptdf_last_error(void* h) {
+  return static_cast<DataFeed*>(h)->error.c_str();
+}
+
+// Stage the next batch; returns number of records in it (0 = exhausted).
+int ptdf_batch_begin(void* h) {
+  auto* df = static_cast<DataFeed*>(h);
+  size_t n = std::min<size_t>(df->batch_size,
+                              df->records.size() - df->cursor);
+  df->batch_f.assign(df->num_slots, {});
+  df->batch_i.assign(df->num_slots, {});
+  df->batch_lod.assign(df->num_slots, {});
+  if (n == 0) return 0;
+  for (int s = 0; s < df->num_slots; ++s) {
+    df->batch_lod[s].push_back(0);
+  }
+  for (size_t r = df->cursor; r < df->cursor + n; ++r) {
+    const Record& rec = df->records[r];
+    for (int s = 0; s < df->num_slots; ++s) {
+      const SlotData& sd = rec.slots[s];
+      if (df->slot_is_float[s]) {
+        df->batch_f[s].insert(df->batch_f[s].end(), sd.fvals.begin(),
+                              sd.fvals.end());
+        df->batch_lod[s].push_back((int64_t)df->batch_f[s].size());
+      } else {
+        df->batch_i[s].insert(df->batch_i[s].end(), sd.ivals.begin(),
+                              sd.ivals.end());
+        df->batch_lod[s].push_back((int64_t)df->batch_i[s].size());
+      }
+    }
+  }
+  df->cursor += n;
+  return (int)n;
+}
+
+int64_t ptdf_batch_slot_values(void* h, int slot) {
+  auto* df = static_cast<DataFeed*>(h);
+  return df->slot_is_float[slot] ? (int64_t)df->batch_f[slot].size()
+                                 : (int64_t)df->batch_i[slot].size();
+}
+
+int64_t ptdf_batch_lod_size(void* h, int slot) {
+  return (int64_t)static_cast<DataFeed*>(h)->batch_lod[slot].size();
+}
+
+int ptdf_batch_copy_float(void* h, int slot, double* dst) {
+  auto* df = static_cast<DataFeed*>(h);
+  if (!df->slot_is_float[slot]) return -1;
+  std::memcpy(dst, df->batch_f[slot].data(),
+              df->batch_f[slot].size() * sizeof(double));
+  return 0;
+}
+
+int ptdf_batch_copy_int(void* h, int slot, int64_t* dst) {
+  auto* df = static_cast<DataFeed*>(h);
+  if (df->slot_is_float[slot]) return -1;
+  std::memcpy(dst, df->batch_i[slot].data(),
+              df->batch_i[slot].size() * sizeof(int64_t));
+  return 0;
+}
+
+int ptdf_batch_copy_lod(void* h, int slot, int64_t* dst) {
+  auto* df = static_cast<DataFeed*>(h);
+  std::memcpy(dst, df->batch_lod[slot].data(),
+              df->batch_lod[slot].size() * sizeof(int64_t));
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,65 @@
+/* C inference API (reference paddle/fluid/inference/capi_exp/
+ * pd_inference_api.h surface: Config -> Predictor -> tensor handles ->
+ * Run). The TPU build's predictor core is the XLA/StableHLO runtime driven
+ * through an embedded CPython bridge (see inference_capi.cc) — the C
+ * surface below is what a deployment integrates against and is stable
+ * regardless of how the core executes.
+ *
+ * Thread-safety: calls lock the embedded interpreter (GIL); one predictor
+ * may be used from one thread at a time. */
+#ifndef PT_INFERENCE_C_H
+#define PT_INFERENCE_C_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct PD_Config PD_Config;
+typedef struct PD_Predictor PD_Predictor;
+
+typedef enum {
+  PD_DTYPE_FLOAT32 = 0,
+  PD_DTYPE_INT64 = 1,
+  PD_DTYPE_INT32 = 2,
+} PD_DataType;
+
+/* ---- config ---- */
+PD_Config* PD_ConfigCreate(void);
+void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
+                       const char* params_file /* nullable */);
+void PD_ConfigDestroy(PD_Config* c);
+
+/* ---- predictor ---- */
+PD_Predictor* PD_PredictorCreate(PD_Config* c); /* NULL on failure */
+void PD_PredictorDestroy(PD_Predictor* p);
+
+size_t PD_PredictorGetInputNum(PD_Predictor* p);
+size_t PD_PredictorGetOutputNum(PD_Predictor* p);
+/* returned strings are owned by the predictor; valid until destroy */
+const char* PD_PredictorGetInputName(PD_Predictor* p, size_t i);
+const char* PD_PredictorGetOutputName(PD_Predictor* p, size_t i);
+
+/* stage one input; data is copied out immediately */
+int PD_PredictorSetInput(PD_Predictor* p, const char* name,
+                         const void* data, const int64_t* shape,
+                         size_t ndim, PD_DataType dtype);
+
+int PD_PredictorRun(PD_Predictor* p); /* 0 on success */
+
+/* query an output produced by the last Run */
+int PD_PredictorGetOutputShape(PD_Predictor* p, const char* name,
+                               int64_t* shape /* cap ndim_cap */,
+                               size_t ndim_cap, size_t* ndim_out);
+int PD_PredictorCopyOutput(PD_Predictor* p, const char* name, void* dst,
+                           size_t dst_bytes);
+
+/* last error message for this thread ("" if none) */
+const char* PD_GetLastError(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* PT_INFERENCE_C_H */

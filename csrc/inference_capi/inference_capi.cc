@@ -1,0 +1,308 @@
+// C inference API implementation — embedded-CPython bridge onto the
+// paddle_tpu.inference Predictor (which executes serialized StableHLO via
+// the XLA runtime).
+//
+// Reference analogue: paddle/fluid/inference/capi_exp/pd_config.cc +
+// pd_predictor.cc wrap the C++ AnalysisPredictor; here the predictor core
+// is Python-hosted XLA, so the shim embeds libpython (Py_Initialize) and
+// drives a tiny helper module (PT_HELPER below) with plain
+// bytes/ints/strings at the boundary. No numpy C API dependency: buffers
+// cross as PyBytes and are reassembled with np.frombuffer helper-side.
+
+#include "../include/pt_inference_c.h"
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_error(const std::string& msg) { g_last_error = msg; }
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_error(msg);
+}
+
+// Helper module: keeps all Python-object juggling in Python.
+const char* PT_HELPER = R"PY(
+import numpy as np
+
+_DTYPES = {0: np.float32, 1: np.int64, 2: np.int32}
+_DTYPE_IDS = {np.dtype(np.float32): 0, np.dtype(np.int64): 1,
+              np.dtype(np.int32): 2}
+
+
+def create(prefix, params):
+    from paddle_tpu.inference import Config, create_predictor
+
+    cfg = Config(prefix, params or None)
+    return create_predictor(cfg)
+
+
+def input_names(pred):
+    return list(pred.get_input_names())
+
+
+def output_names(pred):
+    return list(pred.get_output_names())
+
+
+def set_input(pred, name, raw, shape, dtype_id):
+    arr = np.frombuffer(raw, dtype=_DTYPES[dtype_id]).reshape(shape).copy()
+    pred.get_input_handle(name).copy_from_cpu(arr)
+
+
+def run(pred):
+    pred.run()
+
+
+def output_shape(pred, name):
+    return list(pred.get_output_handle(name).copy_to_cpu().shape)
+
+
+def output_bytes(pred, name):
+    arr = np.ascontiguousarray(pred.get_output_handle(name).copy_to_cpu())
+    return arr.tobytes()
+)PY";
+
+std::once_flag g_init_once;
+PyObject* g_helper = nullptr;  // helper module namespace (dict)
+
+void ensure_python() {
+  std::call_once(g_init_once, [] {
+    bool we_initialized = false;
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      we_initialized = true;
+    }
+    PyGILState_STATE gil = PyGILState_Ensure();
+    PyObject* mod = PyModule_New("pt_capi_helper");
+    PyObject* globals = PyModule_GetDict(mod);
+    PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+    PyObject* r = PyRun_String(PT_HELPER, Py_file_input, globals, globals);
+    if (!r) {
+      set_error_from_python();
+    } else {
+      Py_DECREF(r);
+      g_helper = mod;  // keep the module (and its dict) alive forever
+    }
+    PyGILState_Release(gil);
+    if (we_initialized) {
+      // Py_InitializeEx leaves THIS thread holding the GIL via its thread
+      // state; release it so PyGILState_Ensure works from any thread
+      // (otherwise a second thread's first API call deadlocks).
+      PyEval_SaveThread();
+    }
+  });
+}
+
+PyObject* helper_call(const char* fn, PyObject* args /* stolen */) {
+  PyObject* f = PyDict_GetItemString(PyModule_GetDict(g_helper), fn);
+  if (!f) {
+    Py_XDECREF(args);
+    set_error(std::string("helper fn missing: ") + fn);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_XDECREF(args);
+  if (!out) set_error_from_python();
+  return out;
+}
+
+}  // namespace
+
+struct PD_Config {
+  std::string prog_file;
+  std::string params_file;
+};
+
+struct PD_Predictor {
+  PyObject* pred = nullptr;  // paddle_tpu.inference.Predictor
+  std::vector<std::string> in_names;
+  std::vector<std::string> out_names;
+};
+
+extern "C" {
+
+PD_Config* PD_ConfigCreate(void) { return new PD_Config(); }
+
+void PD_ConfigSetModel(PD_Config* c, const char* prog_file,
+                       const char* params_file) {
+  if (!c) return;
+  // accept either the ".pdmodel" path or the artifact prefix
+  std::string p = prog_file ? prog_file : "";
+  const std::string suffix = ".pdmodel";
+  if (p.size() > suffix.size() &&
+      p.compare(p.size() - suffix.size(), suffix.size(), suffix) == 0) {
+    p = p.substr(0, p.size() - suffix.size());
+  }
+  c->prog_file = p;
+  c->params_file = params_file ? params_file : "";
+}
+
+void PD_ConfigDestroy(PD_Config* c) { delete c; }
+
+PD_Predictor* PD_PredictorCreate(PD_Config* c) {
+  if (!c || c->prog_file.empty()) {
+    set_error("config has no model set");
+    return nullptr;
+  }
+  ensure_python();
+  if (!g_helper) return nullptr;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PD_Predictor* p = nullptr;
+  PyObject* pred = helper_call(
+      "create", Py_BuildValue("(ss)", c->prog_file.c_str(),
+                              c->params_file.c_str()));
+  if (pred) {
+    p = new PD_Predictor();
+    p->pred = pred;
+    for (const char* fn : {"input_names", "output_names"}) {
+      PyObject* names = helper_call(fn, Py_BuildValue("(O)", pred));
+      if (names) {
+        Py_ssize_t n = PyList_Size(names);
+        for (Py_ssize_t i = 0; i < n; ++i) {
+          const char* s = PyUnicode_AsUTF8(PyList_GetItem(names, i));
+          (std::strcmp(fn, "input_names") == 0 ? p->in_names
+                                               : p->out_names)
+              .push_back(s ? s : "");
+        }
+        Py_DECREF(names);
+      }
+    }
+  }
+  PyGILState_Release(gil);
+  return p;
+}
+
+void PD_PredictorDestroy(PD_Predictor* p) {
+  if (!p) return;
+  if (p->pred && Py_IsInitialized()) {
+    PyGILState_STATE gil = PyGILState_Ensure();
+    Py_DECREF(p->pred);
+    PyGILState_Release(gil);
+  }
+  delete p;
+}
+
+size_t PD_PredictorGetInputNum(PD_Predictor* p) {
+  return p ? p->in_names.size() : 0;
+}
+
+size_t PD_PredictorGetOutputNum(PD_Predictor* p) {
+  return p ? p->out_names.size() : 0;
+}
+
+const char* PD_PredictorGetInputName(PD_Predictor* p, size_t i) {
+  return (p && i < p->in_names.size()) ? p->in_names[i].c_str() : "";
+}
+
+const char* PD_PredictorGetOutputName(PD_Predictor* p, size_t i) {
+  return (p && i < p->out_names.size()) ? p->out_names[i].c_str() : "";
+}
+
+int PD_PredictorSetInput(PD_Predictor* p, const char* name,
+                         const void* data, const int64_t* shape,
+                         size_t ndim, PD_DataType dtype) {
+  if (!p || !p->pred) return -1;
+  size_t elems = 1;
+  for (size_t i = 0; i < ndim; ++i) elems *= (size_t)shape[i];
+  size_t elem_size = dtype == PD_DTYPE_FLOAT32 ? 4
+                     : dtype == PD_DTYPE_INT32 ? 4
+                                               : 8;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* shp = PyTuple_New((Py_ssize_t)ndim);
+  for (size_t i = 0; i < ndim; ++i) {
+    PyTuple_SetItem(shp, (Py_ssize_t)i, PyLong_FromLongLong(shape[i]));
+  }
+  PyObject* out = helper_call(
+      "set_input",
+      Py_BuildValue("(Osy#Ni)", p->pred, name, (const char*)data,
+                    (Py_ssize_t)(elems * elem_size), shp, (int)dtype));
+  int rc = out ? 0 : -1;
+  Py_XDECREF(out);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int PD_PredictorRun(PD_Predictor* p) {
+  if (!p || !p->pred) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* out = helper_call("run", Py_BuildValue("(O)", p->pred));
+  int rc = out ? 0 : -1;
+  Py_XDECREF(out);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int PD_PredictorGetOutputShape(PD_Predictor* p, const char* name,
+                               int64_t* shape, size_t ndim_cap,
+                               size_t* ndim_out) {
+  if (!p || !p->pred) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* out =
+      helper_call("output_shape", Py_BuildValue("(Os)", p->pred, name));
+  int rc = -1;
+  if (out) {
+    size_t n = (size_t)PyList_Size(out);
+    *ndim_out = n;
+    if (n <= ndim_cap) {
+      for (size_t i = 0; i < n; ++i) {
+        shape[i] = PyLong_AsLongLong(PyList_GetItem(out, (Py_ssize_t)i));
+      }
+      rc = 0;
+    } else {
+      set_error("ndim_cap too small");
+    }
+    Py_DECREF(out);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int PD_PredictorCopyOutput(PD_Predictor* p, const char* name, void* dst,
+                           size_t dst_bytes) {
+  if (!p || !p->pred) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* out =
+      helper_call("output_bytes", Py_BuildValue("(Os)", p->pred, name));
+  int rc = -1;
+  if (out) {
+    char* buf = nullptr;
+    Py_ssize_t len = 0;
+    if (PyBytes_AsStringAndSize(out, &buf, &len) == 0) {
+      if ((size_t)len <= dst_bytes) {
+        std::memcpy(dst, buf, (size_t)len);
+        rc = 0;
+      } else {
+        set_error("dst_bytes too small for output");
+      }
+    }
+    Py_DECREF(out);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+const char* PD_GetLastError(void) { return g_last_error.c_str(); }
+
+}  // extern "C"

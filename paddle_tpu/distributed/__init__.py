@@ -17,6 +17,8 @@ from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from . import collective  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
+from . import rpc  # noqa: F401
+from . import passes  # noqa: F401
 from .sharding import group_sharded_parallel, save_group_sharded_model  # noqa: F401
 
 

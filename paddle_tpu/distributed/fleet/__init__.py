@@ -13,6 +13,8 @@ from .hybrid_engine import HybridParallelEngine  # noqa: F401
 from .topology import CommunicateTopology, HybridCommunicateGroup  # noqa: F401
 from . import utils  # noqa: F401
 from . import metrics  # noqa: F401
+from . import dataset  # noqa: F401
+from .dataset import InMemoryDataset, QueueDataset  # noqa: F401
 
 _fleet_state = {"initialized": False, "hcg": None, "strategy": None}
 

@@ -1,0 +1,213 @@
+"""fleet.dataset — file-based datasets over the native DataFeed
+(reference `python/paddle/distributed/fleet/dataset/dataset.py`
+DatasetBase/InMemoryDataset/QueueDataset over C++
+`fluid/framework/{data_feed.cc,data_set.cc}`).
+
+The native core (csrc/datafeed/datafeed.cc) parses MultiSlotDataFeed-format
+text files ("<count> <values...>" per slot per line) with reader threads
+and serves LoD batches; this wrapper binds it with ctypes and yields
+(values, lod) numpy pairs per slot — the same payload the reference's
+trainer pulls from its DataFeed channels.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+__all__ = ["DatasetBase", "InMemoryDataset", "QueueDataset"]
+
+_LIB = None
+
+
+def _load():
+    global _LIB
+    if _LIB is not None:
+        return _LIB
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    path = os.path.join(here, "lib", "libptdatafeed.so")
+    if not os.path.exists(path):
+        import subprocess
+
+        src = os.path.join(os.path.dirname(here), "csrc")
+        subprocess.run(["make", "-C", src], check=True, capture_output=True)
+    lib = ctypes.CDLL(path)
+    lib.ptdf_create.restype = ctypes.c_void_p
+    lib.ptdf_create.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+                                ctypes.c_int]
+    lib.ptdf_destroy.argtypes = [ctypes.c_void_p]
+    lib.ptdf_set_files.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_char_p),
+                                   ctypes.c_int]
+    lib.ptdf_load_into_memory.restype = ctypes.c_int64
+    lib.ptdf_load_into_memory.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptdf_local_shuffle.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.ptdf_memory_size.restype = ctypes.c_int64
+    lib.ptdf_memory_size.argtypes = [ctypes.c_void_p]
+    lib.ptdf_rewind.argtypes = [ctypes.c_void_p]
+    lib.ptdf_last_error.restype = ctypes.c_char_p
+    lib.ptdf_last_error.argtypes = [ctypes.c_void_p]
+    lib.ptdf_batch_begin.restype = ctypes.c_int
+    lib.ptdf_batch_begin.argtypes = [ctypes.c_void_p]
+    lib.ptdf_batch_slot_values.restype = ctypes.c_int64
+    lib.ptdf_batch_slot_values.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.ptdf_batch_lod_size.restype = ctypes.c_int64
+    lib.ptdf_batch_lod_size.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    for name in ("ptdf_batch_copy_float", "ptdf_batch_copy_int",
+                 "ptdf_batch_copy_lod"):
+        fn = getattr(lib, name)
+        fn.restype = ctypes.c_int
+        fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p]
+    _LIB = lib
+    return lib
+
+
+class DatasetBase:
+    """Reference dataset.py DatasetBase: slot declaration + filelist."""
+
+    def __init__(self):
+        self._batch_size = 1
+        self._thread_num = 1
+        self._use_var_names: list[str] = []
+        self._slot_is_float: list[bool] = []
+        self._filelist: list[str] = []
+        self._handle = None
+
+    def init(self, batch_size=1, thread_num=1, use_var=None, **kwargs):
+        """use_var: list of (name, dtype) pairs, names (float assumed), or
+        Variables with .name/.dtype (the reference passes static Vars)."""
+        self._batch_size = int(batch_size)
+        self._thread_num = int(thread_num)
+        self._use_var_names, self._slot_is_float = [], []
+        for v in use_var or []:
+            if isinstance(v, tuple):
+                name, dtype = v
+            elif isinstance(v, str):
+                name, dtype = v, "float32"
+            else:  # Variable-like
+                name = v.name
+                dtype = str(getattr(v, "dtype", "float32"))
+            self._use_var_names.append(name)
+            self._slot_is_float.append("int" not in str(dtype))
+        return self
+
+    def set_batch_size(self, batch_size):
+        self._batch_size = int(batch_size)
+
+    def set_thread(self, thread_num):
+        self._thread_num = int(thread_num)
+
+    def set_use_var(self, var_list):
+        self.init(batch_size=self._batch_size, thread_num=self._thread_num,
+                  use_var=var_list)
+
+    def set_filelist(self, filelist):
+        self._filelist = list(filelist)
+
+    def get_filelist(self):
+        return list(self._filelist)
+
+    # ------------------------------------------------------------- native
+    def _ensure_handle(self):
+        if self._handle is not None:
+            return
+        if not self._use_var_names:
+            raise ValueError("call init(use_var=[...]) before loading data")
+        lib = _load()
+        flags = (ctypes.c_int * len(self._slot_is_float))(
+            *[1 if f else 0 for f in self._slot_is_float])
+        self._handle = lib.ptdf_create(len(self._slot_is_float), flags,
+                                       self._batch_size)
+        if not self._handle:
+            raise RuntimeError("ptdf_create failed")
+
+    def _load(self):
+        self._ensure_handle()
+        lib = _load()
+        arr = (ctypes.c_char_p * len(self._filelist))(
+            *[f.encode() for f in self._filelist])
+        lib.ptdf_set_files(self._handle, arr, len(self._filelist))
+        n = lib.ptdf_load_into_memory(self._handle, self._thread_num)
+        if n < 0:
+            raise RuntimeError(
+                lib.ptdf_last_error(self._handle).decode() or "load failed")
+        return int(n)
+
+    def _iter_batches(self):
+        """Yield {slot_name: (values ndarray, lod offsets int64 ndarray)}."""
+        self._ensure_handle()
+        lib = _load()
+        lib.ptdf_rewind(self._handle)
+        while True:
+            n = lib.ptdf_batch_begin(self._handle)
+            if n == 0:
+                return
+            batch = {}
+            for s, name in enumerate(self._use_var_names):
+                nvals = lib.ptdf_batch_slot_values(self._handle, s)
+                nlod = lib.ptdf_batch_lod_size(self._handle, s)
+                lod = np.empty(nlod, np.int64)
+                lib.ptdf_batch_copy_lod(
+                    self._handle, s, lod.ctypes.data_as(ctypes.c_void_p))
+                if self._slot_is_float[s]:
+                    vals = np.empty(nvals, np.float64)
+                    lib.ptdf_batch_copy_float(
+                        self._handle, s,
+                        vals.ctypes.data_as(ctypes.c_void_p))
+                    vals = vals.astype(np.float32)
+                else:
+                    vals = np.empty(nvals, np.int64)
+                    lib.ptdf_batch_copy_int(
+                        self._handle, s,
+                        vals.ctypes.data_as(ctypes.c_void_p))
+                batch[name] = (vals, lod)
+            yield batch
+
+    def __del__(self):
+        if self._handle is not None and _LIB is not None:
+            _LIB.ptdf_destroy(self._handle)
+            self._handle = None
+
+
+class InMemoryDataset(DatasetBase):
+    """Reference InMemoryDataset: load files fully, shuffle locally, then
+    iterate (dataset.py:350)."""
+
+    def load_into_memory(self):
+        self._loaded = self._load()
+
+    def local_shuffle(self, seed=0):
+        self._ensure_handle()
+        _load().ptdf_local_shuffle(self._handle, int(seed))
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        # single-host build: global == local (multi-host would exchange
+        # records over the collective backend first)
+        self.local_shuffle()
+
+    def get_memory_data_size(self, fleet=None):
+        self._ensure_handle()
+        return int(_load().ptdf_memory_size(self._handle))
+
+    def get_shuffle_data_size(self, fleet=None):
+        return self.get_memory_data_size(fleet)
+
+    def release_memory(self):
+        if self._handle is not None:
+            _load().ptdf_destroy(self._handle)
+            self._handle = None
+
+    def __iter__(self):
+        return self._iter_batches()
+
+
+class QueueDataset(DatasetBase):
+    """Reference QueueDataset: streaming iteration, no shuffle. The native
+    core parses eagerly per `load`; iteration order is file order."""
+
+    def __iter__(self):
+        if self._handle is None:
+            self._load()
+        return self._iter_batches()

@@ -1,0 +1,281 @@
+"""paddle.distributed.rpc parity — point-to-point remote python calls.
+
+Reference: `python/paddle/distributed/rpc/rpc.py` (init_rpc:74, rpc_sync:141,
+rpc_async:180, shutdown, get_worker_info) over a C++ brpc agent
+(`paddle/fluid/distributed/rpc/`). The TPU build keeps the exact user API and
+wire semantics (named workers, sync/async python-func invocation, store-backed
+rendezvous + never-timeout barrier) but replaces the brpc agent with a
+thread-pooled TCP server speaking length-prefixed pickle frames; rendezvous
+rides the native TCPStore (csrc/tcpstore) exactly like `core.TCPStore` does in
+the reference. RPC here is control-plane only — tensor traffic belongs to the
+compiled ICI collectives, so a brpc-scale data plane would be dead weight.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from collections import namedtuple
+from concurrent.futures import Future, ThreadPoolExecutor
+
+from ..store import TCPStore
+
+__all__ = [
+    "init_rpc", "shutdown", "rpc_sync", "rpc_async",
+    "get_worker_info", "get_all_worker_infos", "get_current_worker_info",
+    "WorkerInfo",
+]
+
+WorkerInfo = namedtuple("WorkerInfo", ["name", "rank", "ip", "port"])
+
+_DEFAULT_RPC_TIMEOUT = -1
+
+_state = None
+_state_lock = threading.Lock()
+
+
+class _PythonFunc(namedtuple("_PythonFunc", ["func", "args", "kwargs"])):
+    """Reference rpc/internal.py PythonFunc — a pickled callable + arguments."""
+
+
+def _send_frame(sock, payload: bytes):
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_exact(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("rpc peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock) -> bytes:
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return _recv_exact(sock, n)
+
+
+class _Agent:
+    """Per-process RPC agent: a listening server + a client connection pool.
+
+    Mirrors the responsibilities of the reference's RpcAgent
+    (fluid/distributed/rpc/rpc_agent.cc): one server for inbound calls, one
+    lazily-created channel per peer for outbound calls.
+    """
+
+    def __init__(self, name, rank, world_size, infos):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.infos = {i.name: i for i in infos}
+        self.infos_by_rank = {i.rank: i for i in infos}
+        self.me = self.infos_by_rank[rank]
+        self._pool = ThreadPoolExecutor(max_workers=8)
+        self._conns = {}
+        self._conn_lock = threading.Lock()
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((self.me.ip, self.me.port))
+        self._server.listen(64)
+        self._stopping = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    # ---------------------------------------------------------------- server
+    def _accept_loop(self):
+        while not self._stopping.is_set():
+            try:
+                conn, _ = self._server.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True).start()
+
+    def _serve_conn(self, conn):
+        try:
+            while True:
+                try:
+                    req = _recv_frame(conn)
+                except (ConnectionError, OSError):
+                    return
+                try:
+                    call = pickle.loads(req)
+                    result = call.func(*call.args, **call.kwargs)
+                    reply = pickle.dumps(("ok", result))
+                except BaseException as exc:  # ship the error to the caller
+                    reply = pickle.dumps(("err", exc))
+                try:
+                    _send_frame(conn, reply)
+                except OSError:
+                    return
+        finally:
+            conn.close()
+
+    # ---------------------------------------------------------------- client
+    def _connection(self, to: str):
+        info = self.infos.get(to)
+        if info is None:
+            raise ValueError(
+                f"unknown rpc worker {to!r}; known: {sorted(self.infos)}")
+        with self._conn_lock:
+            entry = self._conns.get(to)
+            if entry is None:
+                sock = socket.create_connection((info.ip, info.port))
+                entry = (sock, threading.Lock())
+                self._conns[to] = entry
+        return entry
+
+    def invoke(self, to, fn, args, kwargs, timeout):
+        payload = pickle.dumps(_PythonFunc(fn, tuple(args or ()),
+                                           dict(kwargs or {})))
+
+        def _call():
+            sock, lock = self._connection(to)
+            with lock:  # one in-flight frame per channel, like brpc channels
+                try:
+                    sock.settimeout(
+                        timeout if timeout and timeout > 0 else None)
+                    _send_frame(sock, payload)
+                    status, value = pickle.loads(_recv_frame(sock))
+                except Exception:
+                    # a timeout/short read leaves a reply (or half-frame) in
+                    # flight — the channel is desynchronized; drop it so the
+                    # next call opens a fresh one instead of reading stale
+                    # bytes as its reply
+                    with self._conn_lock:
+                        if self._conns.get(to, (None,))[0] is sock:
+                            del self._conns[to]
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
+                    raise
+            if status == "err":
+                raise value
+            return value
+
+        return self._pool.submit(_call)
+
+    def stop(self):
+        self._stopping.set()
+        try:
+            self._server.close()
+        except OSError:
+            pass
+        with self._conn_lock:
+            for sock, _ in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()
+        self._pool.shutdown(wait=False)
+
+
+def _free_endpoint():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("127.0.0.1", 0))
+    ip, port = s.getsockname()
+    s.close()
+    return f"{ip}:{port}"
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this process's RPC agent and rendezvous with the other workers.
+
+    Reference: rpc.py:74 — same env-var fallbacks (PADDLE_TRAINER_ID,
+    PADDLE_TRAINERS_NUM, PADDLE_WORKER_ENDPOINT, PADDLE_MASTER_ENDPOINT),
+    same store-keyed info exchange, same all-started barrier.
+    """
+    global _state
+    rank = int(os.environ["PADDLE_TRAINER_ID"]) if rank is None else rank
+    world_size = (int(os.environ["PADDLE_TRAINERS_NUM"])
+                  if world_size is None else world_size)
+    worker_endpoint = os.environ.get("PADDLE_WORKER_ENDPOINT") or \
+        _free_endpoint()
+    master_endpoint = master_endpoint or os.environ["PADDLE_MASTER_ENDPOINT"]
+    master_addr, master_port = master_endpoint.rsplit(":", 1)
+
+    store = TCPStore(master_addr, int(master_port), is_master=(rank == 0),
+                     world_size=world_size)
+    ip, port = worker_endpoint.rsplit(":", 1)
+    store.set(f"rpc/info/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, ip, int(port))))
+    infos, seen = [], set()
+    for r in range(world_size):
+        store.wait([f"rpc/info/{r}"])
+        info = pickle.loads(store.get(f"rpc/info/{r}"))
+        if info.name in seen:
+            raise ValueError(f"worker name {info.name!r} is not unique")
+        seen.add(info.name)
+        infos.append(info)
+
+    with _state_lock:
+        if _state is not None:
+            raise RuntimeError("init_rpc called twice without shutdown")
+        agent = _Agent(name, rank, world_size, infos)
+        _state = {"agent": agent, "store": store}
+    # all-started barrier (reference _barrier_never_timeout)
+    import time
+    store.add("rpc/start_barrier", 1)
+    if rank == 0:
+        while store.add("rpc/start_barrier", 0) < world_size:
+            time.sleep(0.01)
+        store.set("rpc/start_done", b"1")
+    else:
+        store.wait(["rpc/start_done"])
+
+
+def _agent() -> _Agent:
+    if _state is None:
+        raise RuntimeError("rpc is not initialized; call init_rpc first")
+    return _state["agent"]
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Blocking remote call; returns fn's result. Reference rpc.py:141."""
+    return _agent().invoke(to, fn, args, kwargs, timeout).result(
+        timeout=None if timeout is None or timeout <= 0 else timeout)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=_DEFAULT_RPC_TIMEOUT):
+    """Non-blocking remote call; returns a Future whose .wait() (alias of
+    .result()) yields fn's result. Reference rpc.py:180."""
+    fut = _agent().invoke(to, fn, args, kwargs, timeout)
+    if not hasattr(Future, "wait"):
+        Future.wait = Future.result  # reference futures expose .wait()
+    return fut
+
+
+def get_worker_info(name):
+    """Reference rpc.py get_worker_info — info for a named worker."""
+    return _agent().infos[name]
+
+
+def get_all_worker_infos():
+    return [_agent().infos_by_rank[r] for r in sorted(_agent().infos_by_rank)]
+
+
+def get_current_worker_info():
+    return _agent().me
+
+
+def shutdown():
+    """Graceful stop: barrier so no worker exits while peers still call it
+    (reference rpc.py shutdown's _barrier_never_timeout), then close."""
+    global _state
+    with _state_lock:
+        if _state is None:
+            return
+        agent, store = _state["agent"], _state["store"]
+        _state = None
+    store.add("rpc/stop_barrier", 1)
+    import time
+    while store.add("rpc/stop_barrier", 0) < agent.world_size:
+        time.sleep(0.01)
+    agent.stop()

@@ -2,3 +2,9 @@
 from . import autograd  # noqa: F401
 from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
+from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
+# NOTE: incubate.multiprocessing is intentionally NOT imported eagerly —
+# importing it registers ForkingPickler reducers that change how Tensors
+# pickle across processes (single-consumer shm segments). Like the
+# reference, `import paddle.incubate.multiprocessing` is the opt-in.

@@ -1,0 +1,314 @@
+"""ASP — automatic n:m structured sparsity (reference
+`python/paddle/incubate/asp/{asp.py,utils.py,supported_layer_list.py}`).
+
+Workflow parity: `prune_model` computes n:m magnitude masks for every
+supported layer's weight, applies them in place and remembers them;
+`decorate(optimizer)` wraps the optimizer so each `step()` re-applies the
+masks (the reference's OptimizerWithSparsityGuarantee inserts mask-mul ops
+after the update, asp.py:216). Mask algebra (`get_mask_1d`,
+`get_mask_2d_greedy/best`, `check_*`, `create_mask`, `check_sparsity`)
+matches reference utils.py:81-549 semantics.
+
+TPU note: 2:4 sparse tensor cores are an NVIDIA-Ampere feature; the TPU MXU
+executes the pruned weights dense. ASP here is the *training-workflow*
+component — produce and maintain hardware-agnostic n:m masks so exported
+models can deploy on sparse-capable targets — not a TPU kernel switch.
+Masks are applied as jnp multiplies, which XLA fuses into the weight load.
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import warnings
+from enum import Enum
+
+import numpy as np
+
+__all__ = [
+    "calculate_density", "create_mask", "check_sparsity",
+    "get_mask_1d", "check_mask_1d", "get_mask_2d_greedy",
+    "get_mask_2d_best", "check_mask_2d", "MaskAlgo", "CheckMethod",
+    "prune_model", "decorate", "set_excluded_layers",
+    "reset_excluded_layers", "add_supported_layer",
+]
+
+
+class MaskAlgo(Enum):
+    MASK_1D = "get_mask_1d"
+    MASK_2D_GREEDY = "get_mask_2d_greedy"
+    MASK_2D_BEST = "get_mask_2d_best"
+
+
+class CheckMethod(Enum):
+    CHECK_1D = "check_mask_1d"
+    CHECK_2D = "check_mask_2d"
+
+    @staticmethod
+    def get_checking_method(mask_algo):
+        return (CheckMethod.CHECK_1D if mask_algo == MaskAlgo.MASK_1D
+                else CheckMethod.CHECK_2D)
+
+
+def calculate_density(x) -> float:
+    """Fraction of non-zeros (reference utils.py:81)."""
+    x = np.asarray(x)
+    return float(np.count_nonzero(x)) / x.size
+
+
+def _group_rows(mat, m):
+    """View a 2-D matrix as rows of m-element groups (pad cols to m)."""
+    h, w = mat.shape
+    pad = (-w) % m
+    if pad:
+        mat = np.concatenate([mat, np.zeros((h, pad), mat.dtype)], axis=1)
+    return mat.reshape(-1, m), pad, (h, w)
+
+
+def get_mask_1d(mat, n, m):
+    """Keep the n largest-|.| entries of every m-wide row group."""
+    mat = np.asarray(mat, dtype=float)
+    groups, pad, (h, w) = _group_rows(mat, m)
+    order = np.argsort(np.abs(groups), axis=1)  # ascending
+    mask = np.zeros_like(groups)
+    np.put_along_axis(mask, order[:, m - n:], 1.0, axis=1)
+    mask = mask.reshape(h, -1)[:, :w]
+    return mask
+
+
+def check_mask_1d(mat, n, m):
+    """True iff every m-wide row group has at most n non-zeros."""
+    mat = np.asarray(mat)
+    groups, _, _ = _group_rows(mat, m)
+    return bool(np.all(np.count_nonzero(groups, axis=1) <= n))
+
+
+def _iter_blocks(mat, m):
+    h, w = mat.shape
+    ph, pw = (-h) % m, (-w) % m
+    if ph or pw:
+        mat = np.pad(mat, ((0, ph), (0, pw)))
+    H, W = mat.shape
+    blocks = (mat.reshape(H // m, m, W // m, m)
+                 .transpose(0, 2, 1, 3)
+                 .reshape(-1, m, m))
+    return blocks, (h, w), (H, W)
+
+
+def _blocks_to_mat(blocks, hw, HW, m):
+    H, W = HW
+    out = (blocks.reshape(H // m, W // m, m, m)
+                 .transpose(0, 2, 1, 3)
+                 .reshape(H, W))
+    return out[:hw[0], :hw[1]]
+
+
+def get_mask_2d_greedy(mat, n, m):
+    """Per m×m block: greedily pick the largest-|.| entries subject to at
+    most n kept per row AND per column (reference utils.py:313)."""
+    mat = np.asarray(mat, dtype=float)
+    blocks, hw, HW = _iter_blocks(mat, m)
+    masks = np.zeros_like(blocks)
+    absb = np.abs(blocks)
+    for b in range(blocks.shape[0]):
+        row_cnt = np.zeros(m, int)
+        col_cnt = np.zeros(m, int)
+        order = np.argsort(-absb[b], axis=None)
+        for flat in order:
+            r, c = divmod(int(flat), m)
+            if row_cnt[r] < n and col_cnt[c] < n:
+                masks[b, r, c] = 1.0
+                row_cnt[r] += 1
+                col_cnt[c] += 1
+    return _blocks_to_mat(masks, hw, HW, m)
+
+
+_patterns_cache = {}
+
+
+def _valid_2d_patterns(n, m):
+    """All m×m 0/1 matrices with exactly n ones per row and per column
+    (reference utils.py:385 _compute_valid_2d_patterns)."""
+    key = (n, m)
+    if key not in _patterns_cache:
+        rows = [np.array([1.0 if i in combo else 0.0 for i in range(m)])
+                for combo in itertools.combinations(range(m), n)]
+        pats = []
+        for choice in itertools.product(range(len(rows)), repeat=m):
+            p = np.stack([rows[i] for i in choice])
+            if np.all(p.sum(0) == n):
+                pats.append(p)
+        _patterns_cache[key] = np.stack(pats)
+    return _patterns_cache[key]
+
+
+def get_mask_2d_best(mat, n, m):
+    """Per m×m block: the valid n-per-row-and-column pattern maximizing the
+    kept |magnitude| (reference utils.py:426)."""
+    mat = np.asarray(mat, dtype=float)
+    pats = _valid_2d_patterns(n, m)           # [P, m, m]
+    blocks, hw, HW = _iter_blocks(mat, m)     # [B, m, m]
+    scores = np.einsum("bij,pij->bp", np.abs(blocks), pats)
+    best = pats[np.argmax(scores, axis=1)]
+    return _blocks_to_mat(best, hw, HW, m)
+
+
+def check_mask_2d(mat, n, m):
+    """True iff every m×m block keeps ≤ n per row and ≤ n per column."""
+    mat = np.asarray(mat)
+    blocks, _, _ = _iter_blocks(mat != 0, m)
+    return bool(np.all(blocks.sum(axis=2) <= n)
+                and np.all(blocks.sum(axis=1) <= n))
+
+
+def _fold(tensor):
+    """Fold 1-4D tensors to 2-D the way the reference create_mask does
+    (utils.py:480): conv NCHW kernels view as (N*H*W, C) row-major."""
+    shape = tensor.shape
+    if tensor.ndim == 1:
+        return tensor.reshape(1, -1), lambda m: m.reshape(shape)
+    if tensor.ndim == 2:
+        return tensor, lambda m: m
+    if tensor.ndim == 3:
+        return (tensor.reshape(shape[0] * shape[1], shape[2]),
+                lambda m: m.reshape(shape))
+    if tensor.ndim == 4:
+        t = tensor.transpose(0, 1, 3, 2).reshape(-1, shape[2])
+        return t, lambda m: (m.reshape(shape[0], shape[1], shape[3],
+                                       shape[2]).transpose(0, 1, 3, 2))
+    raise ValueError(f"create_mask supports ndim<=4, got {tensor.ndim}")
+
+
+def create_mask(tensor, func_name=MaskAlgo.MASK_1D, n=2, m=4):
+    if not isinstance(func_name, MaskAlgo):
+        raise TypeError(f"func_name must be MaskAlgo, got {type(func_name)}")
+    tensor = np.asarray(tensor)
+    t2d, unfold = _fold(tensor.astype(float))
+    mask = globals()[func_name.value](t2d, n=n, m=m)
+    return unfold(mask).astype(tensor.dtype)
+
+
+def check_sparsity(tensor, func_name=CheckMethod.CHECK_1D, n=2, m=4):
+    if not isinstance(func_name, CheckMethod):
+        raise TypeError(f"func_name must be CheckMethod, "
+                        f"got {type(func_name)}")
+    t2d, _ = _fold(np.asarray(tensor).astype(float))
+    return globals()[func_name.value](t2d, n=n, m=m)
+
+
+# --------------------------------------------------------------------- model
+_excluded = set()
+_supported_layers = {}
+_masks = {}  # param name -> np mask
+_lock = threading.Lock()
+
+
+def _default_pruning(weight, m, n, mask_algo, param_name):
+    """Reference supported_layer_list.py:33 — prune along the reduction
+    dimension (transpose, mask, transpose back); skip tensors whose pruned
+    dim is shorter than m."""
+    shape = weight.shape
+    if (len(shape) == 2 and shape[0] < m) or \
+            (len(shape) == 4 and shape[1] < m):
+        warnings.warn(f"{param_name} not pruned: shape {shape} too small "
+                      f"for {n}:{m} pattern")
+        return weight, np.ones_like(weight)
+    mask = create_mask(weight.T if weight.ndim == 2 else weight,
+                       func_name=mask_algo, n=n, m=m)
+    if weight.ndim == 2:
+        mask = mask.T
+    return weight * mask, mask
+
+
+def add_supported_layer(layer, pruning_func=None):
+    """Register a layer class (or name) as prunable."""
+    name = layer if isinstance(layer, str) else layer.__name__
+    with _lock:
+        _supported_layers[name] = pruning_func or _default_pruning
+
+
+def set_excluded_layers(param_names, main_program=None):
+    with _lock:
+        _excluded.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    with _lock:
+        _excluded.clear()
+
+
+def _supported(sublayer):
+    for klass in type(sublayer).__mro__:
+        if klass.__name__ in _supported_layers:
+            return _supported_layers[klass.__name__]
+    return None
+
+
+def _ensure_defaults():
+    if not _supported_layers:
+        add_supported_layer("Linear")
+        add_supported_layer("Conv2D")
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Prune every supported sublayer's weight to n:m sparsity in place and
+    (with_mask) record masks for decorate() to maintain. Returns the masks.
+
+    Reference asp.py:302 (mask_algo names mask_1d/mask_2d_greedy/mask_2d_best).
+    """
+    _ensure_defaults()
+    with _lock:
+        _masks.clear()  # masks track the latest prune_model call
+    algo = {"mask_1d": MaskAlgo.MASK_1D,
+            "mask_2d_greedy": MaskAlgo.MASK_2D_GREEDY,
+            "mask_2d_best": MaskAlgo.MASK_2D_BEST}[mask_algo]
+    from ...core.tensor import Tensor
+
+    sublayer_by_path = {"": model}
+    sublayer_by_path.update(dict(model.named_sublayers()))
+    for pname, param in model.named_parameters():
+        if pname in _excluded or not pname.endswith("weight"):
+            continue
+        owner = sublayer_by_path.get(pname.rsplit(".", 1)[0]
+                                     if "." in pname else "")
+        if owner is None:
+            continue
+        fn = _supported(owner)
+        if fn is None:
+            continue
+        w = np.asarray(param.numpy())
+        pruned, mask = fn(w, m, n, algo, pname)
+        param._data = Tensor(pruned.astype(w.dtype))._data
+        if with_mask:
+            with _lock:
+                _masks[pname] = (param, mask)
+    return {k: v[1] for k, v in _masks.items()}
+
+
+class OptimizerWithSparsityGuarantee:
+    """Reference asp.py ASPHelper.decorate: after every optimizer step,
+    multiply each pruned param by its saved mask so updates cannot
+    resurrect pruned weights."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def step(self, *args, **kwargs):
+        out = self._optimizer.step(*args, **kwargs)
+        self._apply_masks()
+        return out
+
+    def _apply_masks(self):
+        from ...core.tensor import Tensor
+
+        with _lock:
+            items = list(_masks.values())
+        for p, mask in items:
+            arr = np.asarray(p.numpy())
+            p._data = Tensor((arr * mask).astype(arr.dtype))._data
+
+
+def decorate(optimizer):
+    return OptimizerWithSparsityGuarantee(optimizer)

@@ -1,0 +1,67 @@
+"""paddle.incubate.multiprocessing parity — share Tensors across Python
+processes through shared memory instead of pickling payload bytes through
+pipes.
+
+Reference: `python/paddle/incubate/multiprocessing/{__init__,reductions}.py`
+(ForkingPickler reducers over mmap'd file_system storage backed by
+`fluid/memory/allocation/mmap_allocator.cc`). TPU re-design: device (TPU)
+buffers are not host-shareable, so a Tensor is snapshotted to host memory
+once into a POSIX `multiprocessing.shared_memory` segment; the receiving
+process re-materializes it (device placement re-applies lazily on first
+use, same as the reference custom-device path). The segment is reference
+counted by the OS: the producer closes its mapping after pickling, the
+consumer unlinks after rebuilding — single-consumer semantics, matching
+the reference's file_system strategy caveats.
+
+Usage matches the reference: `import paddle_tpu.incubate.multiprocessing
+as mp` then use mp.Process/Queue/Pipe as normal; Tensors put on queues
+travel via shm automatically.
+"""
+from __future__ import annotations
+
+import multiprocessing
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing import reduction, shared_memory
+
+import numpy as np
+
+__all__ = []  # namespace mirrors stdlib multiprocessing (reference does too)
+
+
+def _rebuild_tensor(shm_name, shape, dtype_str):
+    from ...core.tensor import Tensor
+
+    seg = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.ndarray(shape, dtype=np.dtype(dtype_str),
+                         buffer=seg.buf).copy()
+    finally:
+        seg.close()
+        try:
+            seg.unlink()  # consumer owns cleanup (single-consumer strategy)
+        except FileNotFoundError:
+            pass
+    return Tensor(arr)
+
+
+def _reduce_tensor(t):
+    arr = np.asarray(t.numpy())
+    seg = shared_memory.SharedMemory(create=True, size=max(1, arr.nbytes))
+    try:
+        np.ndarray(arr.shape, dtype=arr.dtype, buffer=seg.buf)[...] = arr
+        name = seg.name
+    finally:
+        seg.close()  # mapping closed; segment lives until consumer unlinks
+    return _rebuild_tensor, (name, arr.shape, arr.dtype.str)
+
+
+def init_reductions():
+    """Register shm reducers with ForkingPickler (reference
+    reductions.py init_reductions)."""
+    from ...core.tensor import Parameter, Tensor
+
+    reduction.ForkingPickler.register(Tensor, _reduce_tensor)
+    reduction.ForkingPickler.register(Parameter, _reduce_tensor)
+
+
+init_reductions()

@@ -1,0 +1,193 @@
+"""Incubate optimizers: DGC momentum + DistributedFusedLamb.
+
+Reference:
+  * DGCMomentumOptimizer — `python/paddle/fluid/optimizer.py` (class
+    DGCMomentumOptimizer) over CUDA `fluid/operators/dgc_op.cc` +
+    `fleet/meta_optimizers/dgc_optimizer.py` (strategy.dgc wiring).
+  * DistributedFusedLamb — `python/paddle/incubate/optimizer/
+    distributed_fused_lamb.py:95` over
+    `fluid/operators/optimizers/distributed_fused_lamb_op.cu`.
+
+TPU redesign: both are pure-jnp updates compiled by XLA. DGC's top-k
+select/encode becomes a jnp threshold mask (no custom CUDA encode/decode —
+the "sparse allreduce" of the reference is a bandwidth optimization for
+NCCL rings; on TPU the compressed gradient is still exchanged as a dense
+masked tensor and the win is the *semantics*: momentum correction + local
+residual accumulation, which changes convergence identically to the paper).
+FusedLamb's multi-tensor fusion is a single flat fp32 master buffer with
+segment-reduced per-param trust ratios — one XLA executable updates every
+parameter at once, matching the reference's one-CUDA-kernel design goal.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["DGCMomentumOptimizer", "DistributedFusedLamb"]
+
+
+class DGCMomentumOptimizer(Optimizer):
+    """Deep Gradient Compression (Lin et al.; reference
+    fluid/optimizer.py:DGCMomentumOptimizer).
+
+    Per step, per parameter (dgc_op.cc semantics):
+      u = momentum * u + g          (momentum correction)
+      v = v + u                     (residual accumulation)
+      mask = |v| in top-(1-sparsity) fraction
+      encoded = v * mask;  v -= encoded;  u *= (1 - mask)
+      param -= lr * encoded         (after dp allreduce of `encoded`)
+
+    Ramp-up: before `rampup_begin_step` plain momentum runs; then sparsity
+    walks through `sparsity` over `rampup_step` steps. Params smaller than
+    512 elements are never compressed (reference skips FP16/small params).
+    """
+
+    def __init__(self, learning_rate, momentum=0.9, parameters=None,
+                 rampup_begin_step=0, rampup_step=1,
+                 sparsity=(0.999,), use_nesterov=False,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._momentum = float(momentum)
+        self._use_nesterov = bool(use_nesterov)
+        self._rampup_begin_step = int(rampup_begin_step)
+        self._rampup_step = max(1, int(rampup_step))
+        self._sparsity = [float(s) for s in sparsity]
+        self._min_numel = 512
+
+    def current_sparsity(self):
+        step = self._opt_step
+        if not isinstance(step, int):
+            # static mode threads a traced step counter through the
+            # compiled program; the data-dependent schedule below cannot
+            # trace. Match the reference: DGC is a dygraph optimizer.
+            raise RuntimeError(
+                "DGCMomentumOptimizer supports dygraph mode only (the "
+                "sparsity ramp-up is data-dependent python control flow)")
+        if step < self._rampup_begin_step:
+            return 0.0
+        i = (step - self._rampup_begin_step) * len(self._sparsity) \
+            // self._rampup_step
+        return self._sparsity[min(i, len(self._sparsity) - 1)]
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        u = self._acc("dgc_u", p)
+        g_arr = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+        sparsity = self.current_sparsity()
+        if sparsity <= 0.0 or p._data.size < self._min_numel:
+            new_u = self._momentum * u._data + g_arr
+            delta = (g_arr + self._momentum * new_u if self._use_nesterov
+                     else new_u)
+            u._data = new_u
+            p._data = p._data - lr * delta
+            return
+        v = self._acc("dgc_v", p)
+        new_u = self._momentum * u._data + g_arr
+        new_v = v._data + new_u
+        flat = jnp.abs(new_v).ravel()
+        k = max(1, int(flat.size * (1.0 - sparsity)))
+        thr = jax.lax.top_k(flat, k)[0][-1]
+        mask = (jnp.abs(new_v) >= thr).astype(new_v.dtype)
+        encoded = new_v * mask
+        v._data = new_v - encoded
+        u._data = new_u * (1.0 - mask)
+        p._data = p._data - lr * encoded
+
+
+class DistributedFusedLamb(Optimizer):
+    """Fused multi-tensor LAMB (reference
+    incubate/optimizer/distributed_fused_lamb.py:95).
+
+    All trainable params flatten into ONE fp32 master vector with segment
+    ids; moments live as flat vectors; one jitted function performs the
+    whole LAMB update (adam moments → per-param trust ratio via
+    segment_sum norms → scaled step). The reference shards the flat
+    buffers across dp ranks (its CUDA kernel gathers after update); under
+    this framework that role is played by HybridParallelEngine's ZeRO
+    stage-1 moment sharding — eagerly the buffers are process-local.
+    """
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 exclude_from_weight_decay_fn=None, clip_after_allreduce=True,
+                 grad_clip=None, name=None, **_ignored):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._wd = float(lamb_weight_decay)
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._flat = None  # lazy: (offsets, shapes, dtypes, seg_ids, wd_mask)
+        self._flat_ids = ()
+        self._m = self._v = None
+        self._update = jax.jit(self._fused_update, static_argnums=(6,))
+
+    # ------------------------------------------------------------- flattening
+    def _build_flat(self, pg):
+        offsets, shapes, dtypes, seg, wd = [], [], [], [], []
+        off = 0
+        for i, (p, _) in enumerate(pg):
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            offsets.append(off)
+            shapes.append(tuple(p._data.shape))
+            dtypes.append(p._data.dtype)
+            seg.append(np.full(n, i, np.int32))
+            use_wd = True
+            if self._exclude_fn is not None and self._exclude_fn(p):
+                use_wd = False
+            wd.append(np.full(n, self._wd if use_wd else 0.0, np.float32))
+            off += n
+        self._flat = (offsets, shapes, dtypes,
+                      jnp.concatenate([jnp.asarray(s) for s in seg]),
+                      jnp.concatenate([jnp.asarray(w) for w in wd]),
+                      len(pg))
+        self._m = jnp.zeros(off, jnp.float32)
+        self._v = jnp.zeros(off, jnp.float32)
+
+    def _fused_update(self, master, grad, m, v, seg, wd_vec, n_seg, lr, t):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        m = b1 * m + (1 - b1) * grad
+        v = b2 * v + (1 - b2) * grad * grad
+        m_hat = m / (1 - b1 ** t)
+        v_hat = v / (1 - b2 ** t)
+        r = m_hat / (jnp.sqrt(v_hat) + eps) + wd_vec * master
+        # per-param trust ratio ||w|| / ||r|| via segment reductions
+        w_nrm = jnp.sqrt(jax.ops.segment_sum(master * master, seg, n_seg))
+        r_nrm = jnp.sqrt(jax.ops.segment_sum(r * r, seg, n_seg))
+        trust = jnp.where((w_nrm > 0) & (r_nrm > 0), w_nrm / r_nrm, 1.0)
+        master = master - lr * trust[seg] * r
+        return master, m, v
+
+    def step(self):
+        pg = self._params_grads()
+        if not pg:
+            return
+        if self._grad_clip is not None:
+            pg = self._grad_clip(pg)
+        ids = tuple(id(p) for p, _ in pg)
+        if self._flat is None:
+            self._build_flat(pg)
+            self._flat_ids = ids
+        elif ids != self._flat_ids:
+            # rebuilding would silently zero the Adam moments mid-training;
+            # the fused flat layout requires a stable trainable set (the
+            # reference's DistributedFusedLamb has the same contract)
+            raise RuntimeError(
+                "DistributedFusedLamb requires the same parameter/grad set "
+                "every step; the set changed since the first step()")
+        offsets, shapes, dtypes, seg, wd_vec, n_seg = self._flat
+        master = jnp.concatenate(
+            [jnp.asarray(p._data, jnp.float32).ravel() for p, _ in pg])
+        grad = jnp.concatenate(
+            [jnp.asarray(g._data if isinstance(g, Tensor) else g,
+                         jnp.float32).ravel() for _, g in pg])
+        self._opt_step += 1
+        master, self._m, self._v = self._update(
+            master, grad, self._m, self._v, seg, wd_vec, n_seg,
+            jnp.float32(self.get_lr()), jnp.float32(self._opt_step))
+        for (p, _), off, shape, dt in zip(pg, offsets, shapes, dtypes):
+            n = int(np.prod(shape)) if shape else 1
+            p._data = master[off:off + n].reshape(shape).astype(dt)

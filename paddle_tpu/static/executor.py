@@ -55,6 +55,23 @@ class _CompiledStep:
 
     # ---------------------------------------------------------------- state
     def _init_opt_state(self):
+        k = getattr(self.program, "grad_merge_k", 1)
+        if k > 1:
+            if len(self.program.minimize_reqs) != 1:
+                raise ValueError(
+                    "gradient merge supports exactly one optimizer per "
+                    f"program; got {len(self.program.minimize_reqs)}")
+            if "@gm@runs" not in self.scope.vars:
+                self.scope.set("@gm@runs", jnp.zeros((), jnp.float32))
+            self.opt_state_names.append("@gm@runs")
+            for pv in self.param_vars:
+                if pv.stop_gradient:
+                    continue
+                name = f"@gm@acc@{pv.name}"
+                if name not in self.scope.vars:
+                    init = self.scope.vars.get(pv.name)
+                    self.scope.set(name, jnp.zeros(init.shape, jnp.float32))
+                self.opt_state_names.append(name)
         for oi, (opt, loss_var) in enumerate(self.program.minimize_reqs):
             tname = f"@opt{oi}@step"
             if tname not in self.scope.vars:
@@ -105,20 +122,70 @@ class _CompiledStep:
             self._replay(env)
 
         new_opt = dict(zip(self.opt_state_names, opt_arrays))
+        gm_k = getattr(self.program, "grad_merge_k", 1)
         if train:
             for oi, (opt, loss_var) in enumerate(self.program.minimize_reqs):
                 loss_t = env[loss_var.vid]
                 loss_t.backward()
-                step_arr = new_opt[f"@opt{oi}@step"] + 1.0
-                new_opt[f"@opt{oi}@step"] = step_arr
                 trainables = [pv for pv in self.param_vars
                               if not pv.stop_gradient]
+                if gm_k > 1:
+                    self._grad_merge_apply(oi, opt, trainables,
+                                           param_tensors, new_opt, gm_k)
+                    continue
+                step_arr = new_opt[f"@opt{oi}@step"] + 1.0
+                new_opt[f"@opt{oi}@step"] = step_arr
                 opt._static_apply(
                     oi, step_arr,
                     [(pv, param_tensors[pv.name]) for pv in trainables],
                     new_opt)
 
         fetches = tuple(env[v.vid]._data for v in self.fetch_vars)
+        return self._finish_step(env, param_tensors, new_opt, fetches)
+
+    def _grad_merge_apply(self, oi, opt, trainables, param_tensors, new_opt,
+                          k):
+        """k-step gradient accumulation inside the compiled step
+        (auto_parallel_gradient_merge pass; reference
+        distributed/passes/auto_parallel_gradient_merge.py's conditional
+        optimize block). Grads accumulate into @gm@acc buffers every run;
+        every k-th run the optimizer applies the (averaged) merged grad —
+        non-applying runs compute the update too and discard it with a
+        jnp.where select, which XLA turns into a cheap predicated update."""
+        avg = getattr(self.program, "grad_merge_avg", True)
+        runs = new_opt["@gm@runs"] + 1.0
+        new_opt["@gm@runs"] = jnp.where(
+            jnp.equal(jnp.mod(runs, float(k)), 0.0),
+            jnp.zeros_like(runs), runs)
+        apply_flag = jnp.equal(jnp.mod(runs, float(k)), 0.0)
+        pairs = []
+        for pv in trainables:
+            pt = param_tensors[pv.name]
+            if pt.grad is None:
+                continue
+            g = pt.grad._data if isinstance(pt.grad, Tensor) else \
+                jnp.asarray(pt.grad)
+            acc = new_opt[f"@gm@acc@{pv.name}"] + g.astype(jnp.float32)
+            new_opt[f"@gm@acc@{pv.name}"] = jnp.where(
+                apply_flag, jnp.zeros_like(acc), acc)
+            merged = (acc / float(k)) if avg else acc
+            pt.grad = Tensor(merged.astype(g.dtype))
+            pairs.append((pv, pt))
+        pre_params = {pv.name: param_tensors[pv.name]._data
+                      for pv, _ in pairs}
+        opt_keys = [n for n in self.opt_state_names
+                    if n.startswith(f"@opt{oi}@")]
+        pre_state = {n: new_opt[n] for n in opt_keys}
+        step_arr = new_opt[f"@opt{oi}@step"] + \
+            jnp.where(apply_flag, 1.0, 0.0)
+        new_opt[f"@opt{oi}@step"] = step_arr
+        opt._static_apply(oi, step_arr, pairs, new_opt)
+        for pv, pt in pairs:
+            pt._data = jnp.where(apply_flag, pt._data, pre_params[pv.name])
+        for n in opt_keys:
+            new_opt[n] = jnp.where(apply_flag, new_opt[n], pre_state[n])
+
+    def _finish_step(self, env, param_tensors, new_opt, fetches):
         new_params = tuple(param_tensors[pv.name]._data
                            for pv in self.param_vars)
         new_opt_tuple = tuple(new_opt[n] for n in self.opt_state_names)

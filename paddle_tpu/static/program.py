@@ -128,7 +128,8 @@ class Variable(Tensor):
 
 
 class OpRecord:
-    __slots__ = ("fn", "name", "inputs", "attrs", "outputs", "nondiff")
+    __slots__ = ("fn", "name", "inputs", "attrs", "outputs", "nondiff",
+                 "_amp_wrapped", "_remat_wrapped")  # pass-rewrite markers
 
     def __init__(self, fn, name, inputs, attrs, outputs, nondiff=False):
         self.fn = fn

@@ -147,3 +147,6 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
         out[b, 0] = d / max(n, 1) if normalized else d
     return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(
         np.asarray([iv.shape[0]], np.int64)))
+
+
+from . import datasets  # noqa: E402,F401

@@ -1,0 +1,506 @@
+"""paddle.text.datasets parity (reference `python/paddle/text/datasets/`:
+imdb.py, imikolov.py, movielens.py, uci_housing.py, wmt14.py, wmt16.py,
+conll05.py).
+
+Same archive formats and sample semantics as the reference, rebuilt for a
+zero-egress environment: `data_file` is required (the reference's
+`download=True` fetched from bcebos; here a missing file raises a clear
+error naming the expected archive instead of hanging on a dead network).
+Vocabularies are built in memory rather than cached under DATA_HOME."""
+from __future__ import annotations
+
+import collections
+import re
+import string
+import tarfile
+import zipfile
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["Imdb", "Imikolov", "Movielens", "UCIHousing", "WMT14", "WMT16",
+           "Conll05st"]
+
+
+def _require_file(data_file, name, archive_hint):
+    if data_file is None:
+        raise ValueError(
+            f"{name}: data_file is required (this build runs without "
+            f"network access; place the reference archive {archive_hint} "
+            "locally and pass its path)")
+    return data_file
+
+
+class Imdb(Dataset):
+    """IMDB sentiment corpus from the aclImdb tar (reference imdb.py:31).
+
+    Samples: (np.int64 doc word-ids, np.int64 label) with label 0=pos,
+    1=neg; vocabulary built from both splits keeping words with
+    frequency > cutoff, '<unk>' appended last."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise ValueError(f"mode should be 'train' or 'test', got {mode}")
+        self.mode = mode
+        self.data_file = _require_file(data_file, "Imdb", "aclImdb_v1.tar.gz")
+        self.word_idx = self._build_dict(cutoff)
+        self._load(mode)
+
+    def _docs(self, pattern):
+        drop = str.maketrans("", "", string.punctuation)
+        with tarfile.open(self.data_file) as tf:
+            for member in tf:
+                if pattern.match(member.name):
+                    text = tf.extractfile(member).read().decode(
+                        "latin-1").rstrip("\n\r")
+                    yield text.translate(drop).lower().split()
+
+    def _build_dict(self, cutoff):
+        freq = collections.defaultdict(int)
+        pat = re.compile(r"aclImdb/(train|test)/(pos|neg)/.*\.txt$")
+        for doc in self._docs(pat):
+            for w in doc:
+                freq[w] += 1
+        kept = sorted(((w, c) for w, c in freq.items() if c > cutoff),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self, mode):
+        unk = self.word_idx["<unk>"]
+        self.docs, self.labels = [], []
+        for label, sub in ((0, "pos"), (1, "neg")):
+            pat = re.compile(rf"aclImdb/{mode}/{sub}/.*\.txt$")
+            for doc in self._docs(pat):
+                self.docs.append(np.array(
+                    [self.word_idx.get(w, unk) for w in doc], np.int64))
+                self.labels.append(np.array([label], np.int64))
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB language-model corpus from the simple-examples tar (reference
+    imikolov.py:29). data_type='NGRAM' yields window_size-grams;
+    'SEQ' yields (src, trg) shifted sequences with <s>/<e> marks."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=-1,
+                 mode="train", min_word_freq=50, download=True):
+        mode = mode.lower()
+        if mode not in ("train", "valid", "test"):
+            raise ValueError(f"bad mode {mode}")
+        data_type = data_type.upper()
+        if data_type not in ("NGRAM", "SEQ"):
+            raise ValueError(f"data_type should be NGRAM or SEQ, "
+                             f"got {data_type}")
+        self.mode = mode  # loads ptb.{mode}.txt (reference _load_anno)
+        self.data_type = data_type
+        self.window_size = window_size
+        self.data_file = _require_file(data_file, "Imikolov",
+                                       "simple-examples.tgz")
+        self.word_idx = self._build_dict(min_word_freq)
+        self._load()
+
+    def _member(self, tf, split):
+        name = f"./simple-examples/data/ptb.{split}.txt"
+        try:
+            return tf.extractfile(name)
+        except KeyError:
+            return tf.extractfile(name[2:])
+
+    def _build_dict(self, min_word_freq):
+        freq = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            for split in ("train", "valid"):
+                for line in self._member(tf, split):
+                    for w in line.decode().strip().split():
+                        freq[w] += 1
+                    freq["<s>"] += 1
+                    freq["<e>"] += 1
+        freq.pop("<unk>", None)
+        kept = sorted(((w, c) for w, c in freq.items() if c > min_word_freq),
+                      key=lambda x: (-x[1], x[0]))
+        word_idx = {w: i for i, (w, _) in enumerate(kept)}
+        word_idx["<unk>"] = len(word_idx)
+        return word_idx
+
+    def _load(self):
+        unk = self.word_idx["<unk>"]
+        self.data = []
+        with tarfile.open(self.data_file) as tf:
+            for line in self._member(tf, self.mode):
+                words = ["<s>"] + line.decode().strip().split() + ["<e>"]
+                ids = [self.word_idx.get(w, unk) for w in words]
+                if self.data_type == "NGRAM":
+                    if self.window_size <= 0:
+                        raise ValueError("NGRAM mode needs window_size > 0")
+                    if len(ids) >= self.window_size:
+                        for i in range(self.window_size, len(ids) + 1):
+                            self.data.append(
+                                tuple(ids[i - self.window_size:i]))
+                else:
+                    self.data.append((ids[:-1], ids[1:]))
+
+    def __getitem__(self, idx):
+        return tuple(np.array(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M ratings from ml-1m.zip (reference movielens.py:96).
+    Samples: (user_id, gender, age, job, movie_id, categories, title,
+    rating) feature arrays."""
+
+    MAX_TITLE = 10
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1,
+                 rand_seed=0, download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise ValueError(f"bad mode {mode}")
+        self.mode = mode
+        self.data_file = _require_file(data_file, "Movielens", "ml-1m.zip")
+        self._load_meta()
+        self._load_ratings(test_ratio, rand_seed)
+
+    def _read(self, zf, name):
+        for member in zf.namelist():
+            if member.endswith(name):
+                return zf.read(member).decode("latin-1").splitlines()
+        raise FileNotFoundError(f"{name} not inside {self.data_file}")
+
+    def _load_meta(self):
+        categories, titles = {}, {}
+        self.movies = {}
+        with zipfile.ZipFile(self.data_file) as zf:
+            for line in self._read(zf, "movies.dat"):
+                mid, title, cats = line.split("::")
+                title = re.sub(r"\(\d{4}\)$", "", title).strip()
+                for c in cats.split("|"):
+                    categories.setdefault(c, len(categories))
+                for w in title.lower().split():
+                    titles.setdefault(w, len(titles) + 1)  # 0 = pad
+                self.movies[int(mid)] = (
+                    [categories[c] for c in cats.split("|")],
+                    [titles[w] for w in title.lower().split()])
+            self.users = {}
+            for line in self._read(zf, "users.dat"):
+                uid, gender, age, job = line.split("::")[:4]
+                self.users[int(uid)] = (0 if gender == "M" else 1,
+                                        int(age), int(job))
+        self.categories_dict = categories
+        self.movie_title_dict = titles
+
+    def _load_ratings(self, test_ratio, rand_seed):
+        rng = np.random.default_rng(rand_seed)
+        self.data = []
+        with zipfile.ZipFile(self.data_file) as zf:
+            for line in self._read(zf, "ratings.dat"):
+                uid, mid, rating, _ = line.split("::")
+                uid, mid = int(uid), int(mid)
+                if mid not in self.movies or uid not in self.users:
+                    continue
+                is_test = rng.random() < test_ratio
+                if (self.mode == "test") != is_test:
+                    continue
+                gender, age, job = self.users[uid]
+                cats, title = self.movies[mid]
+                title = (title + [0] * self.MAX_TITLE)[:self.MAX_TITLE]
+                self.data.append((
+                    np.array(uid, np.int64), np.array(gender, np.int64),
+                    np.array(age, np.int64), np.array(job, np.int64),
+                    np.array(mid, np.int64), np.array(cats, np.int64),
+                    np.array(title, np.int64),
+                    np.array([float(rating)], np.float32)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression table (reference uci_housing.py:42):
+    whitespace-separated floats, 14 per row; features normalized by
+    (x - mean) / (max - min); 80/20 train/test split."""
+
+    def __init__(self, data_file=None, mode="train", download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test"):
+            raise ValueError(f"bad mode {mode}")
+        self.mode = mode
+        self.data_file = _require_file(data_file, "UCIHousing",
+                                       "housing.data")
+        self._load()
+
+    def _load(self, feature_num=14, ratio=0.8):
+        raw = np.fromfile(self.data_file, sep=" ")
+        data = raw.reshape(raw.shape[0] // feature_num, feature_num)
+        maxs, mins, avgs = data.max(0), data.min(0), data.mean(0)
+        for i in range(feature_num - 1):
+            data[:, i] = (data[:, i] - avgs[i]) / (maxs[i] - mins[i])
+        offset = int(data.shape[0] * ratio)
+        self.data = data[:offset] if self.mode == "train" else data[offset:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:-1].astype(np.float32), row[-1:].astype(np.float32)
+
+    def __len__(self):
+        return len(self.data)
+
+
+_WMT_UNK_IDX = 2
+_WMT_START, _WMT_END, _WMT_UNK = "<s>", "<e>", "<unk>"
+
+
+class WMT14(Dataset):
+    """WMT14 en→fr subset tar (reference wmt14.py): members `*src.dict`,
+    `*trg.dict` (one word per line, id = line number) and `{mode}/{mode}`
+    parallel files with 'src\\ttrg' lines. Samples: (src_ids, trg_ids,
+    trg_ids_next); sequences longer than 80 are dropped."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=-1,
+                 download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test", "gen"):
+            raise ValueError(f"bad mode {mode}")
+        if dict_size <= 0:
+            raise ValueError("dict_size must be positive")
+        self.mode = mode
+        self.dict_size = dict_size
+        self.data_file = _require_file(data_file, "WMT14",
+                                       "wmt14 tar archive")
+        self._load()
+
+    def _read_dict(self, tf, suffix):
+        names = [m.name for m in tf if m.name.endswith(suffix)]
+        if len(names) != 1:
+            raise ValueError(f"expected exactly one *{suffix} in archive")
+        d = {}
+        for i, line in enumerate(tf.extractfile(names[0])):
+            if i >= self.dict_size:
+                break
+            d[line.strip().decode()] = i
+        return d
+
+    def _load(self):
+        self.src_ids, self.trg_ids, self.trg_ids_next = [], [], []
+        with tarfile.open(self.data_file) as tf:
+            self.src_dict = self._read_dict(tf, "src.dict")
+            self.trg_dict = self._read_dict(tf, "trg.dict")
+            wanted = f"{self.mode}/{self.mode}"
+            for m in tf:
+                if not m.name.endswith(wanted):
+                    continue
+                for line in tf.extractfile(m):
+                    parts = line.decode().strip().split("\t")
+                    if len(parts) != 2:
+                        continue
+                    src = [self.src_dict.get(w, _WMT_UNK_IDX) for w in
+                           [_WMT_START] + parts[0].split() + [_WMT_END]]
+                    trg = [self.trg_dict.get(w, _WMT_UNK_IDX)
+                           for w in parts[1].split()]
+                    if len(src) > 80 or len(trg) > 80:
+                        continue
+                    self.trg_ids_next.append(trg + [self.trg_dict[_WMT_END]])
+                    self.trg_ids.append([self.trg_dict[_WMT_START]] + trg)
+                    self.src_ids.append(src)
+
+    def __getitem__(self, idx):
+        return (np.array(self.src_ids[idx]), np.array(self.trg_ids[idx]),
+                np.array(self.trg_ids_next[idx]))
+
+    def __len__(self):
+        return len(self.src_ids)
+
+
+class WMT16(Dataset):
+    """WMT16 en↔de multimodal subset (reference wmt16.py): tar members
+    `wmt16/{train,val,test}` with 'en\\tde' lines. Vocabularies are built
+    from the train split in memory (<s>=0, <e>=1, <unk>=2)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=-1,
+                 trg_dict_size=-1, lang="en", download=True):
+        mode = mode.lower()
+        if mode not in ("train", "test", "val"):
+            raise ValueError(f"bad mode {mode}")
+        if src_dict_size <= 0 or trg_dict_size <= 0:
+            raise ValueError("dict sizes must be positive")
+        self.mode = mode
+        self.lang = lang
+        self.data_file = _require_file(data_file, "WMT16", "wmt16.tar.gz")
+        src_col = 0 if lang == "en" else 1
+        self.src_dict = self._build_dict(src_col, src_dict_size)
+        self.trg_dict = self._build_dict(1 - src_col, trg_dict_size)
+        self._load(src_col)
+
+    def _member(self, tf, split):
+        for name in (f"wmt16/{split}", f"./wmt16/{split}"):
+            try:
+                return tf.extractfile(name)
+            except KeyError:
+                continue
+        raise FileNotFoundError(f"wmt16/{split} not in {self.data_file}")
+
+    def _build_dict(self, col, size):
+        freq = collections.defaultdict(int)
+        with tarfile.open(self.data_file) as tf:
+            for line in self._member(tf, "train"):
+                parts = line.decode().strip().split("\t")
+                if len(parts) == 2:
+                    for w in parts[col].split():
+                        freq[w] += 1
+        words = [w for w, _ in sorted(freq.items(),
+                                      key=lambda x: (-x[1], x[0]))]
+        vocab = [_WMT_START, _WMT_END, _WMT_UNK] + words[:size - 3]
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _load(self, src_col):
+        unk = self.src_dict[_WMT_UNK]
+        self.data = []
+        with tarfile.open(self.data_file) as tf:
+            for line in self._member(tf, self.mode):
+                parts = line.decode().strip().split("\t")
+                if len(parts) != 2:
+                    continue
+                src = [self.src_dict.get(w, unk)
+                       for w in parts[src_col].split()]
+                trg_words = parts[1 - src_col].split()
+                trg = [self.trg_dict[_WMT_START]] + \
+                    [self.trg_dict.get(w, self.trg_dict[_WMT_UNK])
+                     for w in trg_words]
+                trg_next = trg[1:] + [self.trg_dict[_WMT_END]]
+                self.data.append((src, trg, trg_next))
+
+    def get_dict(self, lang, reverse=False):
+        d = self.src_dict if lang == self.lang else self.trg_dict
+        return {v: k for k, v in d.items()} if reverse else dict(d)
+
+    def __getitem__(self, idx):
+        return tuple(np.array(x) for x in self.data[idx])
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split (reference conll05.py): parallel `words`
+    and `props` files (token-per-line, blank-line sentence breaks). Each
+    predicate column yields one (words, predicate, IOB labels) sample."""
+
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, download=True):
+        self.data_file = _require_file(
+            data_file, "Conll05st", "conll05st-tests.tar.gz")
+        self._load()
+        self.word_dict = self._build_vocab(
+            [w for s in self.sentences for w in s[0]])
+        self.predicate_dict = self._build_vocab(
+            [s[1] for s in self.sentences])
+        self.label_dict = self._build_vocab(
+            [t for s in self.sentences for t in s[2]])
+
+    @staticmethod
+    def _build_vocab(items):
+        vocab = {}
+        for it in items:
+            vocab.setdefault(it, len(vocab))
+        return vocab
+
+    @staticmethod
+    def _props_to_iob(tags):
+        """Convert bracketed span tags '(A0*', '*', '*)' to IOB."""
+        out, current = [], None
+        for t in tags:
+            label = None
+            if t.startswith("("):
+                current = t[1:].split("*")[0]
+                label = f"B-{current}"
+            elif current is not None:
+                label = f"I-{current}"
+            else:
+                label = "O"
+            if t.endswith(")"):
+                out.append(label)
+                current = None
+            else:
+                out.append(label)
+        return out
+
+    def _load(self):
+        words_lines, props_lines = None, None
+        with tarfile.open(self.data_file) as tf:
+            for m in tf:
+                if m.name.endswith(".words.gz") or \
+                        m.name.endswith("words"):
+                    data = tf.extractfile(m).read()
+                    words_lines = self._maybe_gunzip(data)
+                elif m.name.endswith(".props.gz") or \
+                        m.name.endswith("props"):
+                    data = tf.extractfile(m).read()
+                    props_lines = self._maybe_gunzip(data)
+        if words_lines is None or props_lines is None:
+            raise FileNotFoundError(
+                "words/props members not found in archive")
+        self.sentences = []
+        for wsent, psent in zip(self._sentences(words_lines),
+                                self._sentences(props_lines)):
+            words = [line.split()[0] for line in wsent]
+            if not psent or not psent[0].split():
+                continue
+            cols = [line.split() for line in psent]
+            n_preds = len(cols[0]) - 1
+            for p in range(n_preds):
+                verb_rows = [row[0] for row in cols]
+                tags = [row[p + 1] for row in cols]
+                try:
+                    verb_idx = next(i for i, t in enumerate(tags)
+                                    if t.startswith("(V"))
+                except StopIteration:
+                    continue
+                predicate = verb_rows[verb_idx]
+                self.sentences.append(
+                    (words, predicate, self._props_to_iob(tags)))
+
+    @staticmethod
+    def _maybe_gunzip(data):
+        if data[:2] == b"\x1f\x8b":
+            import gzip
+            data = gzip.decompress(data)
+        return data.decode().splitlines()
+
+    @staticmethod
+    def _sentences(lines):
+        sent = []
+        for line in lines:
+            if line.strip():
+                sent.append(line)
+            elif sent:
+                yield sent
+                sent = []
+        if sent:
+            yield sent
+
+    def get_dict(self):
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def __getitem__(self, idx):
+        words, pred, labels = self.sentences[idx]
+        return (np.array([self.word_dict[w] for w in words], np.int64),
+                np.array(self.predicate_dict[pred], np.int64),
+                np.array([self.label_dict[t] for t in labels], np.int64))
+
+    def __len__(self):
+        return len(self.sentences)

@@ -151,3 +151,156 @@ def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
     if pretrained:
         raise NotImplementedError("no pretrained weights in this build")
     return MobileNetV2(scale=scale, **kwargs)
+
+
+# --------------------------------------------------------------- MobileNetV3
+# (reference python/paddle/vision/models/mobilenetv3.py; architecture from
+# Howard et al. 2019 "Searching for MobileNetV3")
+
+class _SqueezeExcite(nn.Layer):
+    """SE block with hardsigmoid gate (mobilenetv3.py SqueezeExcitation)."""
+
+    def __init__(self, ch, squeeze_ch):
+        super().__init__()
+        self.pool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(ch, squeeze_ch, 1)
+        self.fc2 = nn.Conv2D(squeeze_ch, ch, 1)
+        self.relu = nn.ReLU()
+        self.hsig = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _V3Block(nn.Layer):
+    """Inverted residual with optional SE and hswish
+    (mobilenetv3.py InvertedResidual)."""
+
+    def __init__(self, in_ch, exp_ch, out_ch, kernel, stride, use_se,
+                 use_hs):
+        super().__init__()
+        self.use_res = stride == 1 and in_ch == out_ch
+        act = nn.Hardswish if use_hs else nn.ReLU
+        layers = []
+        if exp_ch != in_ch:
+            layers += [nn.Conv2D(in_ch, exp_ch, 1, bias_attr=False),
+                       nn.BatchNorm2D(exp_ch), act()]
+        layers += [nn.Conv2D(exp_ch, exp_ch, kernel, stride=stride,
+                             padding=kernel // 2, groups=exp_ch,
+                             bias_attr=False),
+                   nn.BatchNorm2D(exp_ch), act()]
+        if use_se:
+            layers.append(_SqueezeExcite(exp_ch,
+                                         _make_divisible(exp_ch // 4)))
+        layers += [nn.Conv2D(exp_ch, out_ch, 1, bias_attr=False),
+                   nn.BatchNorm2D(out_ch)]
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV3(nn.Layer):
+    """Shared trunk (mobilenetv3.py MobileNetV3): config rows are
+    (kernel, exp, out, use_se, use_hs, stride)."""
+
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_ch = _make_divisible(16 * scale)
+        layers = [nn.Conv2D(3, in_ch, 3, stride=2, padding=1,
+                            bias_attr=False),
+                  nn.BatchNorm2D(in_ch), nn.Hardswish()]
+        for k, exp, out, se, hs, s in config:
+            exp_ch = _make_divisible(exp * scale)
+            out_ch = _make_divisible(out * scale)
+            layers.append(_V3Block(in_ch, exp_ch, out_ch, k, s, se, hs))
+            in_ch = out_ch
+        head_ch = _make_divisible(6 * in_ch)  # in_ch is already width-scaled
+        layers += [nn.Conv2D(in_ch, head_ch, 1, bias_attr=False),
+                   nn.BatchNorm2D(head_ch), nn.Hardswish()]
+        self.features = nn.Sequential(*layers)
+        self.last_channel = last_channel
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(head_ch, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+        self.flatten = nn.Flatten()
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(self.flatten(x))
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    """mobilenetv3.py MobileNetV3Small config."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [
+            (3, 16, 16, True, False, 2),
+            (3, 72, 24, False, False, 2),
+            (3, 88, 24, False, False, 1),
+            (5, 96, 40, True, True, 2),
+            (5, 240, 40, True, True, 1),
+            (5, 240, 40, True, True, 1),
+            (5, 120, 48, True, True, 1),
+            (5, 144, 48, True, True, 1),
+            (5, 288, 96, True, True, 2),
+            (5, 576, 96, True, True, 1),
+            (5, 576, 96, True, True, 1),
+        ]
+        super().__init__(cfg, last_channel=_make_divisible(1024 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    """mobilenetv3.py MobileNetV3Large config."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [
+            (3, 16, 16, False, False, 1),
+            (3, 64, 24, False, False, 2),
+            (3, 72, 24, False, False, 1),
+            (5, 72, 40, True, False, 2),
+            (5, 120, 40, True, False, 1),
+            (5, 120, 40, True, False, 1),
+            (3, 240, 80, False, True, 2),
+            (3, 200, 80, False, True, 1),
+            (3, 184, 80, False, True, 1),
+            (3, 184, 80, False, True, 1),
+            (3, 480, 112, True, True, 1),
+            (3, 672, 112, True, True, 1),
+            (5, 672, 160, True, True, 2),
+            (5, 960, 160, True, True, 1),
+            (5, 960, 160, True, True, 1),
+        ]
+        super().__init__(cfg, last_channel=_make_divisible(1280 * scale),
+                         scale=scale, num_classes=num_classes,
+                         with_pool=with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this build")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("no pretrained weights in this build")
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+__all__ += ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+            "mobilenet_v3_large"]

@@ -1,0 +1,195 @@
+"""ASP (2:4 structured sparsity) + DGC tests.
+
+Reference patterns: test_asp_utils.py (mask algebra vs the documented
+examples), test_asp_pruning_*.py (prune_model keeps n:m sparsity through
+optimizer steps via decorate), test_dgc_op.py / test_dgc_momentum_op.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import asp
+
+
+class TestMaskAlgebra:
+    def test_density(self):
+        x = np.array([[0, 1, 2, 0], [3, 0, 0, 4]], dtype=np.float32)
+        assert asp.calculate_density(x) == pytest.approx(0.5)
+
+    def test_mask_1d_keeps_top2_of_4(self):
+        t = np.array([[2, 8, 9, 9],
+                      [9, 1, 3, 9],
+                      [5, 6, 3, 9],
+                      [2, 4, 6, 9]], dtype=float)
+        mask = asp.get_mask_1d(t, 2, 4)
+        # reference utils.py:480 docstring example
+        np.testing.assert_array_equal(mask, [[0, 0, 1, 1],
+                                             [1, 0, 0, 1],
+                                             [0, 1, 0, 1],
+                                             [0, 0, 1, 1]])
+        assert asp.check_mask_1d(mask, 2, 4)
+        assert not asp.check_mask_1d(np.ones((4, 4)), 2, 4)
+
+    def test_mask_2d_best_row_and_col(self):
+        rng = np.random.default_rng(0)
+        t = rng.normal(size=(8, 8))
+        mask = asp.get_mask_2d_best(t, 2, 4)
+        assert asp.check_mask_2d(mask, 2, 4)
+        # 2:4 in both directions -> exactly half the entries survive
+        assert mask.sum() == pytest.approx(32)
+        # best-pattern keeps at least as much magnitude as greedy
+        greedy = asp.get_mask_2d_greedy(t, 2, 4)
+        assert asp.check_mask_2d(greedy, 2, 4)
+        assert (np.abs(t) * mask).sum() >= (np.abs(t) * greedy).sum() - 1e-9
+
+    def test_create_mask_conv_kernel(self):
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(16, 8, 3, 3))  # NCHW conv kernel
+        mask = asp.create_mask(w, asp.MaskAlgo.MASK_1D, 2, 4)
+        assert mask.shape == w.shape
+        assert asp.check_sparsity(w * mask, asp.CheckMethod.CHECK_1D, 2, 4)
+
+
+class TestPruneWorkflow:
+    def test_prune_and_guarantee(self):
+        asp.reset_excluded_layers()
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc1 = paddle.nn.Linear(16, 32)
+                self.fc2 = paddle.nn.Linear(32, 4)
+
+            def forward(self, x):
+                return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+        net = Net()
+        masks = asp.prune_model(net, n=2, m=4, mask_algo="mask_1d")
+        assert set(masks) == {"fc1.weight", "fc2.weight"}
+        # pruned along the reduction dim (columns of W^T = rows of W)
+        w1 = np.asarray(net.fc1.weight.numpy())
+        assert asp.check_sparsity(w1.T, asp.CheckMethod.CHECK_1D, 2, 4)
+        assert asp.calculate_density(w1) == pytest.approx(0.5)
+
+        opt = asp.decorate(paddle.optimizer.SGD(
+            learning_rate=0.1, parameters=net.parameters()))
+        x = paddle.to_tensor(np.random.default_rng(2).normal(
+            size=(8, 16)).astype(np.float32))
+        loss = (net(x) ** 2).mean()
+        loss.backward()
+        opt.step()
+        # updates cannot resurrect pruned weights
+        w1b = np.asarray(net.fc1.weight.numpy())
+        assert asp.check_sparsity(w1b.T, asp.CheckMethod.CHECK_1D, 2, 4)
+        assert asp.calculate_density(w1b) <= 0.5 + 1e-9
+
+    def test_excluded_layers(self):
+        asp.reset_excluded_layers()
+        net = paddle.nn.Linear(8, 8)
+        asp.set_excluded_layers(["weight"])
+        masks = asp.prune_model(net, n=2, m=4)
+        assert "weight" not in masks
+        assert asp.calculate_density(np.asarray(net.weight.numpy())) == 1.0
+        asp.reset_excluded_layers()
+
+
+class TestDGCMomentum:
+    def _train(self, opt_factory, steps=5):
+        paddle.seed(1234)  # identical init for every optimizer under test
+        rng = np.random.default_rng(3)
+        net = paddle.nn.Linear(64, 1)
+        opt = opt_factory(net)
+        x = paddle.to_tensor(rng.normal(size=(32, 64)).astype(np.float32))
+        y = paddle.to_tensor(rng.normal(size=(32, 1)).astype(np.float32))
+        losses = []
+        for _ in range(steps):
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.numpy()))
+        return net, losses
+
+    def test_matches_momentum_before_rampup(self):
+        from paddle_tpu.incubate.optimizer import DGCMomentumOptimizer
+
+        net_d, loss_d = self._train(lambda n: DGCMomentumOptimizer(
+            0.05, momentum=0.9, parameters=n.parameters(),
+            rampup_begin_step=10 ** 9))
+        net_m, loss_m = self._train(lambda n: paddle.optimizer.Momentum(
+            0.05, momentum=0.9, parameters=n.parameters()))
+        np.testing.assert_allclose(loss_d, loss_m, rtol=1e-5)
+
+    def test_compression_converges_and_sparsifies(self):
+        from paddle_tpu.incubate.optimizer import DGCMomentumOptimizer
+
+        opt_holder = {}
+
+        def factory(n):
+            opt = DGCMomentumOptimizer(
+                0.01, momentum=0.9, parameters=n.parameters(),
+                rampup_begin_step=0, rampup_step=1, sparsity=[0.9])
+            opt._min_numel = 1  # compress even this small test layer
+            opt_holder["opt"] = opt
+            return opt
+
+        _, losses = self._train(factory, steps=30)
+        assert opt_holder["opt"].current_sparsity() == 0.9
+        assert losses[-1] < losses[0]  # still optimizes under 10x compression
+
+    def test_rampup_schedule(self):
+        from paddle_tpu.incubate.optimizer import DGCMomentumOptimizer
+
+        opt = DGCMomentumOptimizer(0.1, parameters=[],
+                                   rampup_begin_step=2, rampup_step=4,
+                                   sparsity=[0.5, 0.75])
+        sched = []
+        for step in range(7):
+            opt._opt_step = step
+            sched.append(opt.current_sparsity())
+        assert sched == [0.0, 0.0, 0.5, 0.5, 0.75, 0.75, 0.75]
+
+
+class TestDistributedFusedLamb:
+    def test_matches_lamb_semantics(self):
+        """One fused flat-buffer step == per-param LAMB math."""
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+
+        rng = np.random.default_rng(4)
+        w0 = rng.normal(size=(8, 4)).astype(np.float32)
+        b0 = rng.normal(size=(4,)).astype(np.float32)
+        g_w = rng.normal(size=(8, 4)).astype(np.float32)
+        g_b = rng.normal(size=(4,)).astype(np.float32)
+
+        pw = paddle.to_tensor(w0.copy()); pw.stop_gradient = False
+        pb = paddle.to_tensor(b0.copy()); pb.stop_gradient = False
+        pw.grad = paddle.to_tensor(g_w); pb.grad = paddle.to_tensor(g_b)
+        opt = DistributedFusedLamb(learning_rate=0.01, lamb_weight_decay=0.01,
+                                   parameters=[pw, pb])
+        opt.step()
+
+        def ref_lamb(p, g, lr=0.01, wd=0.01, b1=0.9, b2=0.999, eps=1e-6):
+            m = (1 - b1) * g
+            v = (1 - b2) * g * g
+            m_hat, v_hat = m / (1 - b1), v / (1 - b2)
+            r = m_hat / (np.sqrt(v_hat) + eps) + wd * p
+            trust = np.linalg.norm(p) / np.linalg.norm(r)
+            return p - lr * trust * r
+
+        np.testing.assert_allclose(np.asarray(pw.numpy()),
+                                   ref_lamb(w0, g_w), rtol=2e-5)
+        np.testing.assert_allclose(np.asarray(pb.numpy()),
+                                   ref_lamb(b0, g_b), rtol=2e-5)
+
+    def test_exclude_from_weight_decay(self):
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+
+        p = paddle.to_tensor(np.ones((4, 4), np.float32))
+        p.stop_gradient = False
+        p.grad = paddle.to_tensor(np.zeros((4, 4), np.float32))
+        opt = DistributedFusedLamb(learning_rate=0.1, lamb_weight_decay=0.5,
+                                   parameters=[p],
+                                   exclude_from_weight_decay_fn=lambda _: True)
+        opt.step()
+        # zero grad + excluded decay -> param unchanged
+        np.testing.assert_allclose(np.asarray(p.numpy()),
+                                   np.ones((4, 4)), atol=1e-6)

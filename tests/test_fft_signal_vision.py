@@ -117,6 +117,11 @@ class TestVisionModels:
 
         self._check(squeezenet1_1(num_classes=4), [1, 3, 64, 64], 4)
 
+    def test_mobilenet_v3(self):
+        from paddle_tpu.vision.models import mobilenet_v3_small
+
+        self._check(mobilenet_v3_small(num_classes=6), [1, 3, 64, 64], 6)
+
     def test_train_step_lenet(self):
         from paddle_tpu.vision.models import LeNet
 

@@ -1,0 +1,87 @@
+"""fleet.dataset over the native DataFeed (reference
+test_dataset.py patterns: slot files -> InMemoryDataset load/shuffle/batch,
+QueueDataset streaming)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fleet import InMemoryDataset, QueueDataset
+
+
+def _write_slot_file(path, rows):
+    """rows: list of (ids list, floats list) -> MultiSlot format lines
+    '<n> ids... <m> floats...'."""
+    with open(path, "w") as f:
+        for ids, vals in rows:
+            f.write(f"{len(ids)} " + " ".join(str(i) for i in ids) + " " +
+                    f"{len(vals)} " + " ".join(f"{v:.3f}" for v in vals) +
+                    "\n")
+
+
+@pytest.fixture
+def slot_files(tmp_path):
+    f1 = tmp_path / "part-0.txt"
+    f2 = tmp_path / "part-1.txt"
+    _write_slot_file(f1, [([1, 2, 3], [0.5]), ([4], [1.5])])
+    _write_slot_file(f2, [([5, 6], [2.5]), ([7, 8, 9, 10], [3.5]),
+                          ([11], [4.5])])
+    return [str(f1), str(f2)]
+
+
+class TestInMemoryDataset:
+    def _make(self, files, batch_size=2):
+        ds = InMemoryDataset()
+        ds.init(batch_size=batch_size, thread_num=2,
+                use_var=[("ids", "int64"), ("label", "float32")])
+        ds.set_filelist(files)
+        return ds
+
+    def test_load_and_sizes(self, slot_files):
+        ds = self._make(slot_files)
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 5
+
+    def test_batches_with_lod(self, slot_files):
+        ds = self._make(slot_files, batch_size=2)
+        ds.load_into_memory()
+        batches = list(ds)
+        assert [b["label"][1].shape[0] - 1 for b in batches] == [2, 2, 1]
+        ids, lod = batches[0]["ids"]
+        assert lod[0] == 0 and lod[-1] == len(ids)
+        # first record of file order: ids [1,2,3]
+        np.testing.assert_array_equal(ids[:3], [1, 2, 3])
+        label, llod = batches[0]["label"]
+        assert label.dtype == np.float32
+        np.testing.assert_array_equal(llod, [0, 1, 2])
+
+    def test_local_shuffle_permutes(self, slot_files):
+        ds = self._make(slot_files, batch_size=5)
+        ds.load_into_memory()
+        before = list(ds)[0]["ids"][0].tolist()
+        ds.local_shuffle(seed=123)
+        after = list(ds)[0]["ids"][0].tolist()
+        assert sorted(before) == sorted(after)
+        assert before != after  # 5 records, seeded shuffle must move some
+
+    def test_bad_file_raises(self, tmp_path):
+        bad = tmp_path / "bad.txt"
+        bad.write_text("3 1 2\n")  # count says 3, only 2 values
+        ds = self._make([str(bad)])
+        with pytest.raises(RuntimeError, match="short|bad"):
+            ds.load_into_memory()
+
+    def test_release_memory(self, slot_files):
+        ds = self._make(slot_files)
+        ds.load_into_memory()
+        ds.release_memory()
+        assert ds.get_memory_data_size() == 0
+
+
+class TestQueueDataset:
+    def test_streaming_iteration(self, slot_files):
+        ds = QueueDataset()
+        ds.init(batch_size=3, thread_num=1,
+                use_var=[("ids", "int64"), ("label", "float32")])
+        ds.set_filelist(slot_files)
+        batches = list(ds)
+        total = sum(b["label"][1].shape[0] - 1 for b in batches)
+        assert total == 5

@@ -1,0 +1,43 @@
+"""incubate.multiprocessing shared-memory tensor transport (reference
+python/paddle/incubate/multiprocessing/reductions.py test pattern:
+test_multiprocess_* in fluid tests — tensor through a Queue round-trips)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.multiprocessing as pmp
+
+
+def _child(q_in, q_out):
+    t = q_in.get(timeout=30)
+    # child sees the payload and sends a derived tensor back through shm
+    import paddle_tpu as paddle
+
+    q_out.put(paddle.to_tensor(np.asarray(t.numpy()) * 2.0))
+
+
+class TestSharedMemoryTensor:
+    def test_queue_roundtrip(self):
+        ctx = pmp.get_context("spawn")
+        q_in, q_out = ctx.Queue(), ctx.Queue()
+        p = ctx.Process(target=_child, args=(q_in, q_out))
+        p.start()
+        try:
+            src = np.arange(12, dtype=np.float32).reshape(3, 4)
+            q_in.put(paddle.to_tensor(src))
+            back = q_out.get(timeout=60)
+            np.testing.assert_allclose(np.asarray(back.numpy()), src * 2.0)
+        finally:
+            p.join(timeout=30)
+            if p.is_alive():
+                p.terminate()
+
+    def test_reduce_rebuild_inprocess(self):
+        from paddle_tpu.incubate.multiprocessing import (_rebuild_tensor,
+                                                         _reduce_tensor)
+
+        t = paddle.to_tensor(np.ones((4, 2), np.float32) * 3)
+        fn, args = _reduce_tensor(t)
+        assert fn is _rebuild_tensor
+        t2 = fn(*args)
+        np.testing.assert_allclose(np.asarray(t2.numpy()),
+                                   np.asarray(t.numpy()))

@@ -1,0 +1,212 @@
+"""paddle.text.datasets parsers over tiny synthetic archives in the exact
+reference formats (imdb aclImdb tar, imikolov ptb tar, ml-1m zip,
+housing.data table, wmt tarballs, conll05 words/props)."""
+import gzip
+import io
+import tarfile
+import zipfile
+
+import numpy as np
+import pytest
+
+from paddle_tpu.text.datasets import (Conll05st, Imdb, Imikolov, Movielens,
+                                      UCIHousing, WMT14, WMT16)
+
+
+def _tar_add(tf, name, content: bytes):
+    info = tarfile.TarInfo(name)
+    info.size = len(content)
+    tf.addfile(info, io.BytesIO(content))
+
+
+@pytest.fixture
+def imdb_tar(tmp_path):
+    path = tmp_path / "aclImdb.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        docs = {
+            "aclImdb/train/pos/0.txt": b"good good movie, great fun!",
+            "aclImdb/train/neg/0.txt": b"bad bad movie. boring",
+            "aclImdb/test/pos/0.txt": b"good fun",
+            "aclImdb/test/neg/0.txt": b"bad boring",
+        }
+        for name, content in docs.items():
+            _tar_add(tf, name, content)
+    return str(path)
+
+
+class TestImdb:
+    def test_train_and_vocab(self, imdb_tar):
+        ds = Imdb(data_file=imdb_tar, mode="train", cutoff=1)
+        # words appearing >1 across both splits: good(3) bad(3) movie(2)
+        # boring(2) fun(2)
+        assert set(ds.word_idx) == {"good", "bad", "movie", "boring", "fun",
+                                    "<unk>"}
+        assert len(ds) == 2
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label.shape == (1,)
+        labels = sorted(int(ds[i][1][0]) for i in range(len(ds)))
+        assert labels == [0, 1]  # one pos, one neg
+
+    def test_requires_data_file(self):
+        with pytest.raises(ValueError, match="data_file is required"):
+            Imdb(data_file=None)
+
+
+@pytest.fixture
+def ptb_tar(tmp_path):
+    path = tmp_path / "simple-examples.tgz"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "./simple-examples/data/ptb.train.txt",
+                 b"the cat sat\nthe dog sat\nthe cat ran\n")
+        _tar_add(tf, "./simple-examples/data/ptb.valid.txt",
+                 b"the cat sat\n")
+    return str(path)
+
+
+class TestImikolov:
+    def test_ngram(self, ptb_tar):
+        ds = Imikolov(data_file=ptb_tar, data_type="NGRAM", window_size=3,
+                      mode="train", min_word_freq=1)
+        assert len(ds) > 0
+        gram = ds[0]
+        assert len(gram) == 3
+        # 'the' appears 3 times > 1 -> real id; every token resolves
+        assert all(int(g) < len(ds.word_idx) for g in gram)
+
+    def test_seq(self, ptb_tar):
+        ds = Imikolov(data_file=ptb_tar, data_type="SEQ", mode="train",
+                      min_word_freq=1)
+        src, trg = ds[0]
+        assert len(src) == len(trg)
+        np.testing.assert_array_equal(src[1:], trg[:-1])
+
+
+@pytest.fixture
+def ml1m_zip(tmp_path):
+    path = tmp_path / "ml-1m.zip"
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("ml-1m/movies.dat",
+                    "1::Toy Story (1995)::Animation|Children's\n"
+                    "2::Jumanji (1995)::Adventure\n")
+        zf.writestr("ml-1m/users.dat",
+                    "1::F::1::10::48067\n2::M::56::16::70072\n")
+        zf.writestr("ml-1m/ratings.dat",
+                    "1::1::5::978300760\n1::2::3::978302109\n"
+                    "2::1::4::978301968\n")
+    return str(path)
+
+
+class TestMovielens:
+    def test_fields(self, ml1m_zip):
+        ds = Movielens(data_file=ml1m_zip, mode="train", test_ratio=0.0)
+        assert len(ds) == 3
+        uid, gender, age, job, mid, cats, title, rating = ds[0]
+        assert rating.dtype == np.float32
+        assert title.shape == (Movielens.MAX_TITLE,)
+        assert int(gender) in (0, 1)
+
+    def test_split_disjoint(self, ml1m_zip):
+        tr = Movielens(data_file=ml1m_zip, mode="train", test_ratio=0.5,
+                       rand_seed=7)
+        te = Movielens(data_file=ml1m_zip, mode="test", test_ratio=0.5,
+                       rand_seed=7)
+        assert len(tr) + len(te) == 3
+
+
+class TestUCIHousing:
+    def test_split_and_normalization(self, tmp_path):
+        rng = np.random.default_rng(0)
+        rows = rng.uniform(1, 10, size=(10, 14))
+        f = tmp_path / "housing.data"
+        f.write_text("\n".join(" ".join(f"{v:.4f}" for v in r)
+                               for r in rows))
+        tr = UCIHousing(data_file=str(f), mode="train")
+        te = UCIHousing(data_file=str(f), mode="test")
+        assert len(tr) == 8 and len(te) == 2
+        x, y = tr[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        # features are mean-shifted: |normalized| < 1 for this data
+        assert np.all(np.abs(x) <= 1.0)
+
+
+@pytest.fixture
+def wmt14_tar(tmp_path):
+    path = tmp_path / "wmt14.tgz"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "data/src.dict", b"<s>\n<e>\n<unk>\nhello\nworld\n")
+        _tar_add(tf, "data/trg.dict", b"<s>\n<e>\n<unk>\nbonjour\nmonde\n")
+        _tar_add(tf, "train/train", b"hello world\tbonjour monde\n"
+                                    b"hello\tbonjour\n")
+        _tar_add(tf, "test/test", b"world\tmonde\n")
+    return str(path)
+
+
+class TestWMT14:
+    def test_train_ids(self, wmt14_tar):
+        ds = WMT14(data_file=wmt14_tar, mode="train", dict_size=5)
+        assert len(ds) == 2
+        src, trg, trg_next = ds[0]
+        assert src[0] == ds.src_dict["<s>"] and src[-1] == ds.src_dict["<e>"]
+        assert trg[0] == ds.trg_dict["<s>"]
+        assert trg_next[-1] == ds.trg_dict["<e>"]
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+
+    def test_mode_test(self, wmt14_tar):
+        assert len(WMT14(data_file=wmt14_tar, mode="test", dict_size=5)) == 1
+
+
+@pytest.fixture
+def wmt16_tar(tmp_path):
+    path = tmp_path / "wmt16.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "wmt16/train",
+                 b"a cat\teine katze\na dog\tein hund\n")
+        _tar_add(tf, "wmt16/val", b"a cat\teine katze\n")
+        _tar_add(tf, "wmt16/test", b"a dog\tein hund\n")
+    return str(path)
+
+
+class TestWMT16:
+    def test_vocab_and_samples(self, wmt16_tar):
+        ds = WMT16(data_file=wmt16_tar, mode="train", src_dict_size=10,
+                   trg_dict_size=10, lang="en")
+        assert ds.src_dict["<s>"] == 0 and ds.src_dict["<e>"] == 1
+        assert ds.src_dict["<unk>"] == 2
+        assert "a" in ds.src_dict and "katze" in ds.trg_dict
+        src, trg, trg_next = ds[0]
+        np.testing.assert_array_equal(trg[1:], trg_next[:-1])
+        assert len(WMT16(data_file=wmt16_tar, mode="val", src_dict_size=10,
+                         trg_dict_size=10)) == 1
+
+    def test_reverse_dict(self, wmt16_tar):
+        ds = WMT16(data_file=wmt16_tar, mode="train", src_dict_size=10,
+                   trg_dict_size=10)
+        rev = ds.get_dict("en", reverse=True)
+        assert rev[0] == "<s>"
+
+
+@pytest.fixture
+def conll_tar(tmp_path):
+    words = "The\ncat\nsleeps\n\nDogs\nbark\n\n"
+    props = ("-\t*\n-\t*\nsleeps\t(V*)\n\n"
+             "-\t*\nbark\t(V*)\n\n")
+    path = tmp_path / "conll05st-tests.tar.gz"
+    with tarfile.open(path, "w:gz") as tf:
+        _tar_add(tf, "conll05st-release/test.wsj/words/test.wsj.words.gz",
+                 gzip.compress(words.encode()))
+        _tar_add(tf, "conll05st-release/test.wsj/props/test.wsj.props.gz",
+                 gzip.compress(props.encode()))
+    return str(path)
+
+
+class TestConll05:
+    def test_predicate_samples(self, conll_tar):
+        ds = Conll05st(data_file=conll_tar)
+        assert len(ds) == 2
+        word_ids, pred_id, label_ids = ds[0]
+        assert word_ids.shape == (3,)
+        assert label_ids.shape == (3,)
+        wd, pd, ld = ds.get_dict()
+        assert "B-V" in ld
+        inv = {v: k for k, v in ld.items()}
+        assert inv[int(label_ids[2])] == "B-V"  # verb position tagged B-V
