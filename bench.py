@@ -6,8 +6,10 @@ compute with fp32 master weights (multi_precision), activation recompute,
 Pallas flash attention.
 
 Prints one JSON line per completed config, smallest config first, so a
-parseable result exists even if the harness kills the process mid-run; the
-LAST line is the biggest model that finished:
+parseable result exists even if the harness kills the process mid-run.
+After the ladder, the BEST-MFU rung is re-emitted once more (tagged
+"best": true) so the final line — what the driver records — is the best
+completed config:
   {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
 vs_baseline = MFU / 0.45 (the driver's v5p-128 target ratio).
 
@@ -26,16 +28,19 @@ import time
 
 import numpy as np
 
-# (preset, batch, seq_len) — smallest first; the ladder climbs while the
-# time budget lasts and the LAST printed line is the best completed config.
-# Bigger batches amortize the per-step overhead that dominates at bs8
-# (medium bs8 measured 23.9% MFU on v5e; the extra rungs push utilization).
+# (preset, batch, seq_len, recompute_policy) — smallest first; the ladder
+# climbs while the time budget lasts and the LAST printed line is the best
+# completed config. Bigger batches amortize per-step overhead (medium bs8
+# measured 23.9% MFU on v5e); the "dots" rungs keep MXU matmul outputs in
+# HBM instead of full remat, trading memory for ~25% less recompute FLOPs.
 CONFIGS = [
-    ("gpt2-tiny", 8, 128),
-    ("gpt2-small", 8, 1024),
-    ("gpt2-medium", 8, 1024),
-    ("gpt2-medium", 16, 1024),
-    ("gpt2-medium", 32, 1024),
+    ("gpt2-tiny", 8, 128, "full"),
+    ("gpt2-small", 8, 1024, "full"),
+    ("gpt2-medium", 8, 1024, "full"),
+    ("gpt2-medium", 16, 1024, "full"),
+    ("gpt2-medium", 32, 1024, "full"),
+    ("gpt2-medium", 32, 1024, "dots"),
+    ("gpt2-medium", 64, 1024, "dots"),
 ]
 
 TOTAL_BUDGET = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", "540"))
@@ -62,14 +67,17 @@ def peak_flops_per_chip():
     return 197e12  # default to v5e
 
 
-def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16"):
+def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16",
+        policy="full"):
     import paddle_tpu as paddle
     from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
                                    GPTPretrainingCriterion)
 
     paddle.seed(0)
     cfg = GPTConfig.preset(preset, seq_len=seq_len, dtype=dtype,
-                           dropout=0.0, use_recompute=True)
+                           dropout=0.0, use_recompute=True,
+                           recompute_policy=None if policy == "full"
+                           else policy)
     model = GPTForPretraining(GPTModel(cfg))
     crit = GPTPretrainingCriterion()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, multi_precision=True,
@@ -105,12 +113,12 @@ def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16"):
     return tps, mfu, final, cfg
 
 
-def _run_child(preset, batch, seq):
+def _run_child(preset, batch, seq, policy="full"):
     """--run mode: execute one config and print its JSON line."""
-    tps, mfu, loss, _ = run(preset, int(batch), int(seq))
+    tps, mfu, loss, _ = run(preset, int(batch), int(seq), policy=policy)
     print(json.dumps({
         "metric": f"GPT({preset}) train tokens/sec/chip "
-                  f"(bf16, seq{seq}, bs{batch})",
+                  f"(bf16, seq{seq}, bs{batch}, remat={policy})",
         "value": round(tps, 1),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.45, 4),
@@ -148,20 +156,21 @@ def _probe_accelerator(deadline):
 
 def main():
     if len(sys.argv) > 1 and sys.argv[1] == "--run":
-        return _run_child(*sys.argv[2:5])
+        return _run_child(*sys.argv[2:6])
 
     deadline = time.time() + TOTAL_BUDGET
     env = _probe_accelerator(deadline)
     printed = 0
+    best = None
     last_err = "no config attempted"
-    for preset, batch, seq in CONFIGS:
+    for preset, batch, seq, policy in CONFIGS:
         remaining = deadline - time.time()
         if remaining < 30:
             break
         try:
             r = subprocess.run(
                 [sys.executable, os.path.abspath(__file__), "--run",
-                 preset, str(batch), str(seq)],
+                 preset, str(batch), str(seq), policy],
                 env=env, timeout=remaining, capture_output=True, text=True)
         except subprocess.TimeoutExpired:
             last_err = f"{preset}: timeout after {remaining:.0f}s"
@@ -170,9 +179,18 @@ def main():
             line = r.stdout.strip().splitlines()[-1]
             print(line, flush=True)
             printed += 1
+            try:
+                rec = json.loads(line)
+                if best is None or rec.get("mfu", 0) > best.get("mfu", 0):
+                    best = rec
+            except ValueError:
+                pass
         else:
             last_err = f"{preset}: " + (r.stderr or r.stdout).strip()[-300:]
     if printed:
+        if best is not None:
+            # re-emit the best rung LAST — the driver records the final line
+            print(json.dumps({**best, "best": True}), flush=True)
         return 0
     print(json.dumps({"metric": "GPT train tokens/sec/chip", "value": 0,
                       "unit": "tokens/s/chip", "vs_baseline": 0,

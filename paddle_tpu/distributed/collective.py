@@ -188,9 +188,24 @@ def axis_index(axis):
 # ===================== eager tensor form =====================================
 
 def _shard_map_call(group, fn, *arrays, in_specs, out_specs):
+    from jax.sharding import NamedSharding
+
+    # concrete arrays committed to a single device (the default for
+    # to_tensor outputs) are incompatible with a multi-device shard_map —
+    # spread them over the group mesh first; tracers (executor replay under
+    # jit) already compose and must not be device_put
+    specs = in_specs if isinstance(in_specs, tuple) \
+        else (in_specs,) * len(arrays)
+    placed = []
+    for a, spec in zip(arrays, specs):
+        if not isinstance(a, jax.core.Tracer):
+            sh = getattr(a, "sharding", None)
+            if getattr(sh, "mesh", None) != group.mesh:
+                a = jax.device_put(a, NamedSharding(group.mesh, spec))
+        placed.append(a)
     sm = jax.shard_map(fn, mesh=group.mesh, in_specs=in_specs,
                        out_specs=out_specs, check_vma=False)
-    return sm(*arrays)
+    return sm(*placed)
 
 
 class _Task:
@@ -273,8 +288,10 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
     src_local = group.get_group_rank(src) if src in group.ranks else src
 
     def f(x):
-        return jax.lax.ppermute(
-            x, ax, [(src_local, j) for j in range(group.nranks)])
+        # one→all fan-out: ppermute needs unique destinations, so gather
+        # the group and select the root's shard (XLA lowers this to a
+        # broadcast collective on ICI)
+        return jax.lax.all_gather(x, ax)[src_local]
 
     out = _shard_map_call(group, f, tensor._data, in_specs=P(group.axis),
                           out_specs=P(group.axis))

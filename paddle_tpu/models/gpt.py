@@ -40,7 +40,8 @@ class GPTConfig:
 
     def __init__(self, vocab_size=50304, n_layer=12, n_head=12, d_model=768,
                  seq_len=1024, d_ff=None, dropout=0.0, attn_dropout=0.0,
-                 dtype="float32", use_recompute=False, initializer_range=0.02):
+                 dtype="float32", use_recompute=False, recompute_policy=None,
+                 initializer_range=0.02):
         self.vocab_size = vocab_size
         self.n_layer = n_layer
         self.n_head = n_head
@@ -51,6 +52,10 @@ class GPTConfig:
         self.attn_dropout = attn_dropout
         self.dtype = dtype
         self.use_recompute = use_recompute
+        # None = save nothing (full remat); "dots" = keep MXU matmul
+        # outputs and rematerialize only the cheap elementwise tail —
+        # ~25-30% less recompute FLOPs for a modest activation-memory cost
+        self.recompute_policy = recompute_policy
         self.initializer_range = initializer_range
 
     @classmethod
@@ -134,6 +139,7 @@ class GPTBlock(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.dropout)
         self._recompute = cfg.use_recompute
+        self._recompute_policy = getattr(cfg, "recompute_policy", None)
 
     def _forward(self, x):
         x = x + self.dropout(self.attn(self.ln1(x)))
@@ -147,7 +153,8 @@ class GPTBlock(nn.Layer):
         if self._recompute and self.training:
             from ..distributed.fleet.utils import recompute
 
-            return recompute(self._forward, x, layer=self)
+            return recompute(self._forward, x, layer=self,
+                             policy=self._recompute_policy)
         return self._forward(x)
 
 

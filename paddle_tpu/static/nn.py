@@ -217,3 +217,110 @@ def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
     # backprops its While op via sub-block replay; matching that needs a
     # bounded-trip scan formulation (future work).
     return forward(f, (*loop_vars, *captured), name="while_loop")
+
+
+# ------------------------------------------------------- static collectives
+# Reference: the 161 static-graph collective ops in
+# `paddle/fluid/operators/collective/` (c_allreduce_{sum,max,min,prod},
+# c_broadcast, c_concat, c_split) recorded into Programs and executed on
+# comm rings. TPU re-design: each records ONE functional op whose kernel is
+# a shard_map collective over the group's mesh axis — at Executor replay the
+# whole program (collectives included) compiles into a single SPMD XLA
+# executable, so "c_allreduce inside a Program" costs one fused psum, not an
+# op-by-op ring call. With nranks == 1 they are identity (same as the
+# reference's single-rank rings).
+
+def _static_collective(x, group, fn_name, per_shard_fn, out_transform=None):
+    """Record one collective op. The group is resolved ONCE here (record
+    time) and threaded into the per-shard kernel — re-resolving the default
+    group at replay time would bind to whatever mesh is current then."""
+    from ..core.dispatch import forward
+    from ..distributed import collective as coll
+
+    group = group if group is not None else coll._default_group()
+    if group.nranks == 1:
+        return forward(lambda a: a, (x,), name=fn_name)
+
+    def f(arr):
+        from jax.sharding import PartitionSpec as P
+
+        out = coll._shard_map_call(group, lambda a: per_shard_fn(group, a),
+                                   arr, in_specs=P(group.axis),
+                                   out_specs=P(group.axis))
+        return out_transform(out) if out_transform else out
+
+    return forward(f, (x,), name=fn_name)
+
+
+def _c_allreduce(op_suffix, reducer):
+    def op(x, group=None, use_calc_stream=True):
+        def per_shard(g, a):
+            return reducer(a, g.axis)
+
+        return _static_collective(x, group, f"c_allreduce_{op_suffix}",
+                                  per_shard)
+    op.__name__ = f"c_allreduce_{op_suffix}"
+    return op
+
+
+def _init_c_ops():
+    import jax
+
+    global c_allreduce_sum, c_allreduce_max, c_allreduce_min, c_allreduce_prod
+    c_allreduce_sum = _c_allreduce("sum", jax.lax.psum)
+    c_allreduce_max = _c_allreduce("max", jax.lax.pmax)
+    c_allreduce_min = _c_allreduce("min", jax.lax.pmin)
+    c_allreduce_prod = _c_allreduce(
+        "prod", lambda a, ax: jax.lax.all_gather(a, ax).prod(axis=0))
+
+
+_init_c_ops()
+
+
+def c_broadcast(x, root=0, group=None, use_calc_stream=True):
+    """Every rank's shard becomes root's shard (c_broadcast_op.cc). `root`
+    follows the eager broadcast convention: a global rank that is a group
+    member is translated to its in-group index; otherwise it must already
+    be a valid in-group index."""
+    import jax
+
+    def per_shard(g, a):
+        local = g.get_group_rank(root) if root in g.ranks else root
+        if not 0 <= local < g.nranks:
+            raise ValueError(
+                f"c_broadcast root {root} is neither a member of "
+                f"{g.ranks} nor a valid in-group index")
+        # one-to-all fan-out: gather + select root's shard (ppermute
+        # requires unique destinations, so it cannot express broadcast)
+        return jax.lax.all_gather(a, g.axis)[local]
+
+    return _static_collective(x, group, "c_broadcast", per_shard)
+
+
+def c_concat(x, group=None, use_calc_stream=True):
+    """All-gather shards along the last dim (c_concat_op.cc — the mp
+    gather used after RowParallelLinear)."""
+    import jax
+
+    def per_shard(g, a):
+        return jax.lax.all_gather(a, g.axis, axis=a.ndim - 1, tiled=True)
+
+    return _static_collective(x, group, "c_concat", per_shard)
+
+
+def c_split(x, rank=None, group=None, use_calc_stream=True):
+    """Keep this rank's slice of the last dim (c_split_op.cc; like the
+    reference op, the split dim must divide evenly)."""
+    import jax
+
+    def per_shard(g, a):
+        if a.shape[-1] % g.nranks:
+            raise ValueError(
+                f"c_split: last dim {a.shape[-1]} not divisible by "
+                f"group size {g.nranks}")
+        idx = jax.lax.axis_index(g.axis)
+        width = a.shape[-1] // g.nranks
+        return jax.lax.dynamic_slice_in_dim(a, idx * width, width,
+                                            axis=a.ndim - 1)
+
+    return _static_collective(x, group, "c_split", per_shard)

@@ -1,0 +1,108 @@
+"""Static-graph collective ops (reference
+paddle/fluid/operators/collective/c_*_op.cc recorded in Programs). Here the
+c_* ops record one functional shard_map collective each; the Executor
+compiles the whole program — collectives included — into one SPMD XLA
+executable over the virtual 8-CPU mesh.
+
+Convention (matches the eager collective API): dim 0 of the global array
+spans the group's ranks — row r is rank r's tensor."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import collective
+
+
+@pytest.fixture
+def group():
+    return collective.new_group(list(range(4)))
+
+
+def _run_static(build, feed):
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            fetch = build()
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        return exe.run(main, feed=feed, fetch_list=[fetch])[0]
+    finally:
+        paddle.disable_static()
+
+
+class TestStaticCollectives:
+    def test_c_allreduce_sum(self, group):
+        x = np.arange(4 * 3, dtype=np.float32).reshape(4, 3)
+
+        def build():
+            v = paddle.static.data("x", [4, 3], "float32")
+            return paddle.static.nn.c_allreduce_sum(v, group=group)
+
+        out = _run_static(build, {"x": x})
+        expected = np.tile(x.sum(axis=0, keepdims=True), (4, 1))
+        np.testing.assert_allclose(out, expected, rtol=1e-6)
+
+    def test_c_allreduce_max(self, group):
+        x = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+
+        def build():
+            v = paddle.static.data("x", [4, 5], "float32")
+            return paddle.static.nn.c_allreduce_max(v, group=group)
+
+        out = _run_static(build, {"x": x})
+        np.testing.assert_allclose(
+            out, np.tile(x.max(axis=0, keepdims=True), (4, 1)), rtol=1e-6)
+
+    def test_c_broadcast(self, group):
+        x = np.random.default_rng(1).normal(size=(4, 2)).astype(np.float32)
+
+        def build():
+            v = paddle.static.data("x", [4, 2], "float32")
+            return paddle.static.nn.c_broadcast(v, root=2, group=group)
+
+        out = _run_static(build, {"x": x})
+        np.testing.assert_allclose(out, np.tile(x[2:3], (4, 1)), rtol=1e-6)
+
+    def test_c_concat_then_split_roundtrip(self, group):
+        x = np.random.default_rng(2).normal(size=(4, 2, 8)).astype(
+            np.float32)
+
+        def build():
+            v = paddle.static.data("x", [4, 2, 8], "float32")
+            g = paddle.static.nn.c_concat(v, group=group)   # [4, 2, 32]
+            return paddle.static.nn.c_split(g, group=group)  # back to [4,2,8]
+
+        out = _run_static(build, {"x": x})
+        np.testing.assert_allclose(out, x, rtol=1e-6)
+
+    def test_eager_broadcast_matches(self, group):
+        """The eager collective.broadcast shares the fan-out fix (ppermute
+        cannot express one→all; gather+select does)."""
+        x = np.random.default_rng(3).normal(size=(4, 2)).astype(np.float32)
+        t = paddle.to_tensor(x.copy())
+        collective.broadcast(t, src=1, group=group)
+        np.testing.assert_allclose(np.asarray(t.numpy()),
+                                   np.tile(x[1:2], (4, 1)), rtol=1e-6)
+
+    def test_c_split_indivisible_raises(self, group):
+        x = np.zeros((4, 10), np.float32)  # 10 % 4 != 0
+
+        def build():
+            v = paddle.static.data("x", [4, 10], "float32")
+            return paddle.static.nn.c_split(v, group=group)
+
+        with pytest.raises(Exception, match="not divisible"):
+            _run_static(build, {"x": x})
+
+    def test_single_rank_identity(self):
+        g1 = collective.new_group([0])
+        x = np.ones((1, 3), np.float32) * 7
+
+        def build():
+            v = paddle.static.data("x", [1, 3], "float32")
+            return paddle.static.nn.c_allreduce_sum(v, group=g1)
+
+        out = _run_static(build, {"x": x})
+        np.testing.assert_allclose(out, x)
