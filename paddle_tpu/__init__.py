@@ -47,12 +47,22 @@ del _cpu_pinned
 # f32 matmuls run at full float32 precision, matching the reference's cuBLAS
 # default (TF32 disabled — `FLAGS_allow_tf32` analog). bf16 — the TPU perf
 # path — is unaffected: the MXU consumes bf16 natively.
-_jax.config.update("jax_default_matmul_precision", "highest")
+# PADDLE_TPU_MATMUL_PRECISION overrides (e.g. "default" for pure-bf16
+# training jobs: f32 passes aren't in the hot path there, and the tuned
+# library flash-attention kernel fails Mosaic compilation under "highest").
+_jax.config.update("jax_default_matmul_precision",
+                   _os.environ.get("PADDLE_TPU_MATMUL_PRECISION",
+                                   "highest"))
 
 # float64/int64 are first-class dtypes in the reference API; enable x64 so
 # `paddle.float64` tensors keep their width (compute stays f32/bf16 unless
 # the user explicitly asks for f64 — creation defaults are float32).
-_jax.config.update("jax_enable_x64", True)
+# PADDLE_TPU_X64=0 opts out: 64-bit dtypes silently narrow (JAX's native
+# mode) and the tuned library flash-attention kernel — whose pallas index
+# maps assume 32-bit ints — becomes eligible (ops/pallas_ops.py); training
+# jobs that never touch f64/i64 payloads should prefer it.
+if _os.environ.get("PADDLE_TPU_X64", "1") != "0":
+    _jax.config.update("jax_enable_x64", True)
 
 # core types ------------------------------------------------------------------
 from .core.tensor import Tensor, Parameter  # noqa: F401

@@ -55,6 +55,15 @@ def recompute(function, *args, layer=None, use_reentrant=True, policy=None,
     jpolicy = None
     if policy == "dots":
         jpolicy = jax.checkpoint_policies.dots_saveable
+    elif policy == "attn":
+        # keep flash-attention outputs (tagged attn_out in ops/pallas_ops);
+        # rematerialize everything else — attention kernels are by far the
+        # costliest thing to re-execute in the backward
+        jpolicy = jax.checkpoint_policies.save_only_these_names("attn_out")
+    elif policy == "dots_attn":
+        jpolicy = jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_saveable,
+            jax.checkpoint_policies.save_only_these_names("attn_out"))
     elif callable(policy):
         jpolicy = policy
     elif policy is not None:
