@@ -83,3 +83,6 @@ def info(filepath):
         i.num_channels = w.getnchannels()
         i.bits_per_sample = 8 * w.getsampwidth()
     return i
+
+
+from . import datasets  # noqa: E402,F401

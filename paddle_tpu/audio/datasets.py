@@ -1,0 +1,172 @@
+"""paddle.audio.datasets parity (reference `python/paddle/audio/datasets/`:
+dataset.py AudioClassificationDataset, esc50.py ESC50, tess.py TESS).
+
+Zero-egress: the reference downloads archives into DATA_HOME; here pass
+`data_dir` (an extracted dataset directory). File layouts and label
+semantics match the reference:
+  * ESC50 — `ESC-50-master/` with `meta/esc50.csv` (filename,fold,target,
+    category,...) and `audio/*.wav`; `split` selects the held-out fold.
+  * TESS — `TESS_Toronto_emotional_speech_set/` with per-emotion wav files
+    named `{speaker}_{word}_{emotion}.wav`; n-fold split over the sorted
+    file list.
+Features: feat_type 'raw' returns the waveform; 'spectrogram',
+'melspectrogram', 'logmelspectrogram', 'mfcc' run the corresponding
+paddle_tpu.audio.features layer on load.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io.dataset import Dataset
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+
+def _feat_funcs():
+    from . import features
+
+    return {
+        "raw": None,
+        "spectrogram": features.Spectrogram,
+        "melspectrogram": features.MelSpectrogram,
+        "logmelspectrogram": features.LogMelSpectrogram,
+        "mfcc": features.MFCC,
+    }
+
+
+class AudioClassificationDataset(Dataset):
+    """Reference dataset.py:29 — (file, label) pairs with on-load feature
+    extraction."""
+
+    def __init__(self, files, labels, feat_type="raw", sample_rate=None,
+                 **kwargs):
+        funcs = _feat_funcs()
+        if feat_type not in funcs:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, must be one of "
+                f"{list(funcs)}")
+        self.files = list(files)
+        self.labels = list(labels)
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+
+    def _convert_to_record(self, idx):
+        from .. import to_tensor
+        from . import load as audio_load
+
+        path, label = self.files[idx], self.labels[idx]
+        waveform, sr = audio_load(path)
+        self.sample_rate = sr
+        wav = np.asarray(waveform, np.float32)
+        if wav.ndim == 2:
+            wav = wav[0]
+        feat_cls = _feat_funcs()[self.feat_type]
+        if feat_cls is None:
+            return to_tensor(wav), label
+        kwargs = dict(self.feat_config)
+        if self.feat_type != "spectrogram":
+            kwargs.setdefault("sr", sr)
+        extractor = feat_cls(**kwargs)
+        feat = extractor(to_tensor(wav[None, :]))
+        return feat.squeeze(0), label
+
+    def __getitem__(self, idx):
+        return self._convert_to_record(idx)
+
+    def __len__(self):
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50 environmental sounds (reference esc50.py:26): 2000 clips,
+    50 classes, 5 folds; `split` names the dev fold."""
+
+    label_list = [  # category order == target id (reference esc50.py:76)
+        f"class_{i}" for i in range(50)
+    ]
+    audio_path = os.path.join("ESC-50-master", "audio")
+    meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
+
+    def __init__(self, mode="train", split=1, feat_type="raw",
+                 data_dir=None, **kwargs):
+        if split not in range(1, 6):
+            raise ValueError(f"split must be 1..5, got {split}")
+        if data_dir is None:
+            raise ValueError(
+                "ESC50: data_dir is required (extracted ESC-50-master "
+                "parent directory; this build runs without network access)")
+        self._root = data_dir
+        files, labels = self._get_data(mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode, split):
+        meta_path = os.path.join(self._root, self.meta)
+        audio_dir = os.path.join(self._root, self.audio_path)
+        if not os.path.isfile(meta_path) or not os.path.isdir(audio_dir):
+            raise FileNotFoundError(
+                f"expected {self.meta} and {self.audio_path} under "
+                f"{self._root}")
+        files, labels = [], []
+        with open(meta_path) as f:
+            header = f.readline().strip().split(",")
+            fn_i = header.index("filename")
+            fold_i = header.index("fold")
+            tgt_i = header.index("target")
+            for line in f:
+                parts = line.strip().split(",")
+                if len(parts) < 3:
+                    continue
+                in_dev = int(parts[fold_i]) == split
+                if (mode == "train") != in_dev:
+                    files.append(os.path.join(audio_dir, parts[fn_i]))
+                    labels.append(int(parts[tgt_i]))
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """TESS emotional speech (reference tess.py): wav files named
+    `{speaker}_{word}_{emotion}.wav`; n-fold split over the sorted list."""
+
+    label_list = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                  "sad"]
+    audio_path = "TESS_Toronto_emotional_speech_set"
+
+    def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
+                 data_dir=None, **kwargs):
+        if not (isinstance(n_folds, int) and n_folds >= 1):
+            raise ValueError(f"n_folds must be int >= 1, got {n_folds}")
+        if split not in range(1, n_folds + 1):
+            raise ValueError(f"split must be 1..{n_folds}, got {split}")
+        if data_dir is None:
+            raise ValueError(
+                "TESS: data_dir is required (extracted "
+                "TESS_Toronto_emotional_speech_set parent directory)")
+        self._root = data_dir
+        files, labels = self._get_data(mode, n_folds, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_data(self, mode, n_folds, split):
+        root = os.path.join(self._root, self.audio_path)
+        if not os.path.isdir(root):
+            root = self._root  # accept the dataset dir itself
+        wavs = []
+        for dirpath, _, names in os.walk(root):
+            for n in sorted(names):
+                if n.lower().endswith(".wav"):
+                    wavs.append(os.path.join(dirpath, n))
+        wavs.sort()
+        files, labels = [], []
+        for i, path in enumerate(wavs):
+            emotion = os.path.basename(path)[:-4].split("_")[-1].lower()
+            if emotion not in self.label_list:
+                continue
+            in_dev = (i % n_folds) == (split - 1)
+            if (mode == "train") != in_dev:
+                files.append(path)
+                labels.append(self.label_list.index(emotion))
+        return files, labels
