@@ -50,27 +50,41 @@ class AudioClassificationDataset(Dataset):
         self.files = list(files)
         self.labels = list(labels)
         self.feat_type = feat_type
+        self._requested_sr = sample_rate
         self.sample_rate = sample_rate
         self.feat_config = kwargs
+        self._extractor = None  # built once on first item (fbank/DCT reuse)
+
+    def _get_extractor(self, sr):
+        if self._extractor is None:
+            feat_cls = _feat_funcs()[self.feat_type]
+            kwargs = dict(self.feat_config)
+            if self.feat_type != "spectrogram":
+                kwargs.setdefault("sr", sr)
+            self._extractor = feat_cls(**kwargs)
+        return self._extractor
 
     def _convert_to_record(self, idx):
+        import warnings
+
         from .. import to_tensor
         from . import load as audio_load
 
         path, label = self.files[idx], self.labels[idx]
         waveform, sr = audio_load(path)
+        if self._requested_sr is not None and self._requested_sr != sr:
+            warnings.warn(
+                f"requested sample_rate {self._requested_sr} but {path} is "
+                f"{sr} Hz; no resampling is performed — features use the "
+                "file's native rate (reference behavior)", stacklevel=2)
+            self._requested_sr = None  # warn once
         self.sample_rate = sr
         wav = np.asarray(waveform, np.float32)
         if wav.ndim == 2:
             wav = wav[0]
-        feat_cls = _feat_funcs()[self.feat_type]
-        if feat_cls is None:
+        if _feat_funcs()[self.feat_type] is None:
             return to_tensor(wav), label
-        kwargs = dict(self.feat_config)
-        if self.feat_type != "spectrogram":
-            kwargs.setdefault("sr", sr)
-        extractor = feat_cls(**kwargs)
-        feat = extractor(to_tensor(wav[None, :]))
+        feat = self._get_extractor(sr)(to_tensor(wav[None, :]))
         return feat.squeeze(0), label
 
     def __getitem__(self, idx):
@@ -84,14 +98,13 @@ class ESC50(AudioClassificationDataset):
     """ESC-50 environmental sounds (reference esc50.py:26): 2000 clips,
     50 classes, 5 folds; `split` names the dev fold."""
 
-    label_list = [  # category order == target id (reference esc50.py:76)
-        f"class_{i}" for i in range(50)
-    ]
     audio_path = os.path.join("ESC-50-master", "audio")
     meta = os.path.join("ESC-50-master", "meta", "esc50.csv")
 
     def __init__(self, mode="train", split=1, feat_type="raw",
                  data_dir=None, **kwargs):
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
         if split not in range(1, 6):
             raise ValueError(f"split must be 1..5, got {split}")
         if data_dir is None:
@@ -111,19 +124,25 @@ class ESC50(AudioClassificationDataset):
                 f"expected {self.meta} and {self.audio_path} under "
                 f"{self._root}")
         files, labels = [], []
+        categories = {}
         with open(meta_path) as f:
             header = f.readline().strip().split(",")
             fn_i = header.index("filename")
             fold_i = header.index("fold")
             tgt_i = header.index("target")
+            cat_i = header.index("category")
             for line in f:
                 parts = line.strip().split(",")
-                if len(parts) < 3:
+                if len(parts) < 4:
                     continue
+                categories[int(parts[tgt_i])] = parts[cat_i]
                 in_dev = int(parts[fold_i]) == split
                 if (mode == "train") != in_dev:
                     files.append(os.path.join(audio_dir, parts[fn_i]))
                     labels.append(int(parts[tgt_i]))
+        # real category names keyed by target id, straight from the meta
+        self.label_list = [categories.get(i, f"class_{i}")
+                           for i in range(max(categories, default=-1) + 1)]
         return files, labels
 
 
@@ -137,6 +156,8 @@ class TESS(AudioClassificationDataset):
 
     def __init__(self, mode="train", n_folds=5, split=1, feat_type="raw",
                  data_dir=None, **kwargs):
+        if mode not in ("train", "dev"):
+            raise ValueError(f"mode must be 'train' or 'dev', got {mode!r}")
         if not (isinstance(n_folds, int) and n_folds >= 1):
             raise ValueError(f"n_folds must be int >= 1, got {n_folds}")
         if split not in range(1, n_folds + 1):
@@ -153,20 +174,26 @@ class TESS(AudioClassificationDataset):
     def _get_data(self, mode, n_folds, split):
         root = os.path.join(self._root, self.audio_path)
         if not os.path.isdir(root):
-            root = self._root  # accept the dataset dir itself
+            raise FileNotFoundError(
+                f"expected {self.audio_path}/ under {self._root} "
+                "(pass the extracted dataset's parent directory)")
         wavs = []
         for dirpath, _, names in os.walk(root):
-            for n in sorted(names):
+            for n in names:
                 if n.lower().endswith(".wav"):
                     wavs.append(os.path.join(dirpath, n))
         wavs.sort()
-        files, labels = [], []
-        for i, path in enumerate(wavs):
+        # filter to conforming files FIRST so a stray wav cannot re-deal
+        # every subsequent file's fold
+        tagged = []
+        for path in wavs:
             emotion = os.path.basename(path)[:-4].split("_")[-1].lower()
-            if emotion not in self.label_list:
-                continue
+            if emotion in self.label_list:
+                tagged.append((path, self.label_list.index(emotion)))
+        files, labels = [], []
+        for i, (path, label) in enumerate(tagged):
             in_dev = (i % n_folds) == (split - 1)
             if (mode == "train") != in_dev:
                 files.append(path)
-                labels.append(self.label_list.index(emotion))
+                labels.append(label)
         return files, labels

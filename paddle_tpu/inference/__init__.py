@@ -60,6 +60,7 @@ class Config:
         self._enable_memory_optim = True
         self._ir_optim = True  # XLA always optimizes; kept for API parity
         self._precision = PrecisionType.Float32
+        self._dist_degree = 1  # enable_dist_inference
 
     # -- device selection (reference enable_use_gpu etc.) --------------------
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0,
@@ -102,6 +103,23 @@ class Config:
         # TensorRT subgraphs have no TPU analog — XLA compiles the whole
         # graph; accept and ignore for API compatibility.
         pass
+
+    def enable_dist_inference(self, degree=None):
+        """Distributed (multi-chip) inference: shard the batch dimension of
+        every feed over `degree` devices (replicated params, GSPMD-
+        propagated compute). Reference analogue: AnalysisPredictor's
+        FleetExecutor-backed dist inference (analysis_predictor.cc:1813),
+        re-designed as sharded SPMD execution instead of a multi-process
+        program runtime. degree=None uses every visible device."""
+        import jax
+
+        n = len(jax.devices()) if degree is None else int(degree)
+        if n < 1:
+            raise ValueError(f"dist inference degree must be >= 1, got {n}")
+        self._dist_degree = n
+
+    def dist_inference_degree(self):
+        return self._dist_degree
 
     def set_model(self, prog_file, params_file=None):
         self._prefix = prog_file[:-8] if prog_file.endswith(".pdmodel") \
@@ -162,6 +180,23 @@ class Predictor:
         with open(params_path, "rb") as f:
             meta = pickle.load(f)
         self._params = tuple(jnp.asarray(a) for a in meta["arrays"])
+        self._mesh = None
+        if config._dist_degree > 1:
+            import jax
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            devs = jax.devices()[:config._dist_degree]
+            if len(devs) < config._dist_degree:
+                raise RuntimeError(
+                    f"dist inference degree {config._dist_degree} exceeds "
+                    f"visible devices ({len(jax.devices())})")
+            self._mesh = Mesh(devs, ("dp",))
+            # params replicated on the mesh; feeds sharded on the batch dim
+            rep = NamedSharding(self._mesh, PartitionSpec())
+            self._params = tuple(jax.device_put(p, rep)
+                                 for p in self._params)
+            self._feed_sharding = NamedSharding(self._mesh,
+                                                PartitionSpec("dp"))
         n_feeds = len(self._exported.in_avals) - len(self._params)
         self._feed_names = list(
             meta.get("feed_names") or [f"x{i}" for i in range(n_feeds)])
@@ -192,6 +227,25 @@ class Predictor:
             for n, a in zip(self._feed_names, inputs):
                 self._inputs[n] = np.asarray(a)
         feeds = tuple(jnp.asarray(self._inputs[n]) for n in self._feed_names)
+        if self._mesh is not None:
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            n_dev = len(self._mesh.devices.ravel())
+            replicated = NamedSharding(self._mesh, PartitionSpec())
+            placed = []
+            for n, f in zip(self._feed_names, feeds):
+                if f.ndim == 0:
+                    # scalars (temperature, lengths...) replicate
+                    placed.append(jax.device_put(f, replicated))
+                    continue
+                if f.shape[0] % n_dev:
+                    raise ValueError(
+                        f"dist inference: feed {n!r} batch dim "
+                        f"{f.shape[0]} must divide mesh size {n_dev} "
+                        "(pad the batch or lower the degree)")
+                placed.append(jax.device_put(f, self._feed_sharding))
+            feeds = tuple(placed)
         outs = self._exported.call(self._params, *feeds)
         names = self.get_output_names()
         self._outputs = {n: o for n, o in zip(names, outs)}
