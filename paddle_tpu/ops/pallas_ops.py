@@ -345,9 +345,33 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None):
                                      sm_scale=sm_scale)
             out = out.transpose(0, 2, 1, 3)
         else:
+            import os
+            import warnings
+
             blk = 256 if T % 256 == 0 else 128
+
+            def _blk_env(name, default):
+                raw = os.environ.get(name)
+                if raw is None:
+                    return default
+                try:
+                    val = int(raw)
+                except ValueError:
+                    warnings.warn(f"{name}={raw!r} is not an int; using "
+                                  f"{default}")
+                    return default
+                if val <= 0 or T % val:
+                    # the kernel grid requires block | seq_len; a partial
+                    # block would silently drop tail rows
+                    warnings.warn(f"{name}={val} does not divide seq_len "
+                                  f"{T}; using {default}")
+                    return default
+                return val
+
+            bq = _blk_env("PADDLE_TPU_FLASH_BLOCK_Q", blk)
+            bk = _blk_env("PADDLE_TPU_FLASH_BLOCK_K", blk)
             out = _flash_attention_tpu(q, k, v, causal=causal, scale=scale,
-                                       block_q=blk, block_k=blk)
+                                       block_q=bq, block_k=bk)
     else:
         out = _attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)
     # tag for remat policies: attention is the most expensive op to
