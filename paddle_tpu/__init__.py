@@ -17,7 +17,7 @@ Usage mirrors the reference's `import paddle`:
 """
 from __future__ import annotations
 
-__version__ = "0.1.0"
+from .version import full_version as __version__  # single version source
 
 import os as _os
 
@@ -118,6 +118,16 @@ from . import device  # noqa: F401
 from . import geometric  # noqa: F401
 from . import text  # noqa: F401
 from . import audio  # noqa: F401
+from . import reader  # noqa: F401
+from .reader import batch  # noqa: F401  (paddle.batch)
+from . import regularizer  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import hub  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import onnx  # noqa: F401
+from . import version  # noqa: F401
+from . import utils  # noqa: F401
 
 
 def is_grad_enabled_():  # pragma: no cover - back-compat alias

@@ -1,0 +1,62 @@
+"""paddle.cost_model (reference `python/paddle/cost_model/cost_model.py` +
+`static_op_benchmark.json`): per-op timing data for planners/tuners.
+
+Static cost data here is produced by `tools/op_bench.py` snapshots instead
+of the reference's frozen 2021 CI JSON; `profile_measure` measures a real
+program through the Executor."""
+from __future__ import annotations
+
+import json
+import os
+import time
+
+__all__ = ["CostModel"]
+
+
+class CostModel:
+    def __init__(self, static_cost_file=None):
+        self._static_file = static_cost_file
+        self._static_data = None
+
+    # ----------------------------------------------------------- static data
+    def static_cost_data(self):
+        """Load the op-timing snapshot (tools/op_bench.py --out format)."""
+        if self._static_data is None:
+            path = self._static_file or os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "static_op_benchmark.json")
+            if not os.path.isfile(path):
+                raise FileNotFoundError(
+                    f"no op-benchmark snapshot at {path}; generate one with "
+                    "`python tools/op_bench.py --out "
+                    "paddle_tpu/cost_model/static_op_benchmark.json`")
+            with open(path) as f:
+                self._static_data = json.load(f)
+        return self._static_data
+
+    def get_static_op_time(self, op_name, forward=True, dtype="float32"):
+        """Op time in ms from the snapshot; KeyError when unmeasured."""
+        data = self.static_cost_data()
+        rec = data.get(op_name)
+        if not isinstance(rec, dict) or "fwd_ms" not in rec:
+            raise KeyError(
+                f"op {op_name!r} not in snapshot; known: "
+                f"{[k for k in data if not k.startswith('_')]}")
+        return rec["fwd_ms"] if forward else rec["fwd_bwd_ms"]
+
+    # ------------------------------------------------------------- measured
+    def profile_measure(self, main_program, startup_program=None,
+                        feed=None, fetch_list=None, device="tpu",
+                        repeat=5):
+        """Run a static Program and return measured wall time per run
+        (reference profile_measure runs the program under the profiler)."""
+        from ..static import Executor
+
+        exe = Executor()
+        if startup_program is not None:
+            exe.run(startup_program)
+        exe.run(main_program, feed=feed, fetch_list=fetch_list)  # compile
+        t0 = time.perf_counter()
+        for _ in range(repeat):
+            exe.run(main_program, feed=feed, fetch_list=fetch_list)
+        return {"program_ms": (time.perf_counter() - t0) / repeat * 1e3}

@@ -1,0 +1,53 @@
+"""paddle.hub (reference `python/paddle/hub.py` → hapi/hub.py): load
+models via a repo's `hubconf.py` entry points. Local directories are fully
+supported; github/gitee sources need network access and raise here."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUB_CONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir, source):
+    if source != "local":
+        raise RuntimeError(
+            f"hub source {source!r} requires network access; this build is "
+            "offline — clone the repo and use source='local'")
+    path = os.path.join(repo_dir, _HUB_CONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"{_HUB_CONF} not found under {repo_dir}")
+    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    """Entry-point names exported by the repo's hubconf."""
+    mod = _load_hubconf(repo_dir, source)
+    return [n for n in dir(mod)
+            if callable(getattr(mod, n)) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model {model!r} not in {repo_dir}/{_HUB_CONF}")
+    return fn.__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    mod = _load_hubconf(repo_dir, source)
+    fn = getattr(mod, model, None)
+    if fn is None:
+        raise ValueError(f"model {model!r} not in {repo_dir}/{_HUB_CONF}")
+    return fn(**kwargs)

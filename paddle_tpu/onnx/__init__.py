@@ -1,0 +1,26 @@
+"""paddle.onnx (reference `python/paddle/onnx/export.py` — a thin wrapper
+over the external paddle2onnx converter). The TPU-native deployment format
+is StableHLO (`paddle.jit.save` → `.pdmodel`), which onnxruntime does not
+consume; ONNX export therefore requires an external converter exactly as
+the reference does."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """Export a Layer to ONNX. Requires the `onnx` package (not bundled in
+    this environment, matching the reference's external paddle2onnx
+    dependency). The portable alternative is `paddle.jit.save`, whose
+    StableHLO artifact any XLA runtime executes."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as exc:
+        raise ImportError(
+            "paddle.onnx.export needs the 'onnx' package, which is not "
+            "installed in this environment. Use paddle.jit.save(layer, "
+            "path, input_spec) for the StableHLO deployment artifact "
+            "instead.") from exc
+    raise NotImplementedError(
+        "ONNX conversion from StableHLO artifacts is not implemented; "
+        "use paddle.jit.save / paddle.inference for deployment.")

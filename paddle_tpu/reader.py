@@ -1,0 +1,219 @@
+"""paddle.reader + paddle.batch — legacy reader-creator combinators
+(reference `python/paddle/reader/decorator.py` and `python/paddle/batch.py`).
+A "reader creator" is a zero-arg callable returning an iterator; these
+combinators compose them. Kept for user-code portability — the modern path
+is `paddle.io.DataLoader`."""
+from __future__ import annotations
+
+import itertools
+import random as _random
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain",
+           "shuffle", "firstn", "xmap_readers", "multiprocess_reader",
+           "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Group a sample reader into a batch reader (reference batch.py)."""
+    batch_size = int(batch_size)
+    if batch_size <= 0:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader
+
+
+def cache(reader):
+    """Materialize once, replay from memory afterwards."""
+    all_data = tuple(reader())
+
+    def impl():
+        return iter(all_data)
+
+    return impl
+
+
+def map_readers(func, *readers):
+    """Zip readers and map func over the tuples."""
+
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Buffered shuffle (reference decorator.py:127)."""
+
+    def data_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return data_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into flat tuples; check_alignment=True (default) raises
+    ComposeNotAligned when lengths differ."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def reader():
+        iters = [r() for r in readers]
+        if check_alignment:
+            for items in itertools.zip_longest(*iters):
+                if any(i is None for i in items):
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(i) for i in items), ())
+        else:
+            for items in zip(*iters):
+                yield sum((make_tuple(i) for i in items), ())
+
+    return reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples in a daemon thread. Producer errors
+    re-raise in the consumer (a swallowed error would read as a silently
+    truncated dataset)."""
+    import queue
+    import threading
+
+    def data_reader():
+        q = queue.Queue(maxsize=size)
+        end = object()
+        err = []
+
+        def producer():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as exc:
+                err.append(exc)
+            finally:
+                q.put(end)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is end:
+                if err:
+                    raise err[0]
+                return
+            yield sample
+
+    return data_reader
+
+
+def firstn(reader, n):
+    def impl():
+        return itertools.islice(reader(), n)
+
+    return impl
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size,
+                 order=False):
+    """Parallel map over samples with a thread pool (reference semantics:
+    process_num workers, bounded buffer, optional order preservation)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def data_reader():
+        with ThreadPoolExecutor(max_workers=process_num) as pool:
+            window = []
+            for sample in reader():
+                window.append(pool.submit(mapper, sample))
+                if len(window) >= buffer_size:
+                    yield window.pop(0).result()
+            for fut in window:
+                yield fut.result()
+
+    if not order:
+        # unordered variant keeps the same API; ordering is already
+        # deterministic here, which satisfies both contracts
+        pass
+    return data_reader
+
+
+def _mp_reader_worker(reader, q, token):
+    """Top-level so mp spawn/forkserver can pickle it. Samples travel as
+    ("sample", x); end/error as tagged tuples carrying the per-call token,
+    so no legitimate sample value can collide with the control frames."""
+    try:
+        for sample in reader():
+            q.put(("sample", sample))
+        q.put(("end", token, None))
+    except BaseException as exc:  # surfaced in the consumer
+        q.put(("error", token, repr(exc)))
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave samples from several readers, each in its own process
+    (reference decorator.py multiprocess_reader). Readers must be
+    picklable (module-level callables) under spawn start methods."""
+    import multiprocessing as mp
+    import uuid
+
+    def data_reader():
+        q = mp.Queue(queue_size)
+        token = uuid.uuid4().hex
+        procs = [mp.Process(target=_mp_reader_worker, args=(r, q, token),
+                            daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        try:
+            while finished < len(readers):
+                frame = q.get()
+                kind = frame[0]
+                if kind == "sample":
+                    yield frame[1]
+                elif frame[1] == token and kind == "end":
+                    finished += 1
+                elif frame[1] == token and kind == "error":
+                    raise RuntimeError(
+                        f"multiprocess_reader worker failed: {frame[2]}")
+        finally:
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
+    return data_reader

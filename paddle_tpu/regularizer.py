@@ -1,0 +1,5 @@
+"""paddle.regularizer namespace (reference `python/paddle/regularizer.py`)
+— re-exports the optimizer-integrated decay implementations."""
+from .optimizer.regularizer import L1Decay, L2Decay  # noqa: F401
+
+__all__ = ["L1Decay", "L2Decay"]

@@ -1,0 +1,21 @@
+"""paddle.sysconfig (reference `python/paddle/sysconfig.py`): paths for
+compiling native extensions against this framework."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of C headers (custom-op ABI `pt_custom_op.h`, inference C
+    API `pt_inference_c.h`)."""
+    return os.path.abspath(os.path.join(_ROOT, "..", "csrc", "include"))
+
+
+def get_lib():
+    """Directory of native shared libraries (libtcpstore, libshmring,
+    libptdatafeed, libptinfer_capi)."""
+    return os.path.join(_ROOT, "lib")

@@ -1,0 +1,23 @@
+"""paddle.version (reference generated `python/paddle/version/__init__.py`)."""
+full_version = "0.1.0"
+major, minor, patch = "0", "1", "0"
+rc = "0"
+commit = "unknown"
+with_gpu = "OFF"
+istaged = False
+
+
+def show():
+    print(f"paddle_tpu {full_version} (commit {commit}); tpu-native build")
+
+
+def mkl():
+    return "OFF"
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

@@ -1,0 +1,124 @@
+"""Small top-level namespaces (reference paddle.batch/reader/sysconfig/
+hub/regularizer/callbacks/cost_model/onnx/version)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestBatchReader:
+    def test_batch(self):
+        r = paddle.batch(lambda: iter(range(10)), batch_size=4)
+        assert list(r()) == [[0, 1, 2, 3], [4, 5, 6, 7], [8, 9]]
+        r2 = paddle.batch(lambda: iter(range(10)), batch_size=4,
+                          drop_last=True)
+        assert list(r2()) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        with pytest.raises(ValueError):
+            paddle.batch(lambda: iter([]), batch_size=0)
+
+    def test_map_chain_firstn(self):
+        m = paddle.reader.map_readers(lambda a, b: a + b,
+                                      lambda: iter([1, 2]),
+                                      lambda: iter([10, 20]))
+        assert list(m()) == [11, 22]
+        ch = paddle.reader.chain(lambda: iter([1]), lambda: iter([2, 3]))
+        assert list(ch()) == [1, 2, 3]
+        assert list(paddle.reader.firstn(lambda: iter(range(9)), 3)()) == \
+            [0, 1, 2]
+
+    def test_compose_misaligned_raises(self):
+        c = paddle.reader.compose(lambda: iter([1]),
+                                  lambda: iter([2, 3]))
+        with pytest.raises(paddle.reader.ComposeNotAligned):
+            list(c())
+
+    def test_buffered_and_cache(self):
+        buf = paddle.reader.buffered(lambda: iter(range(5)), 2)
+        assert list(buf()) == [0, 1, 2, 3, 4]
+        calls = []
+
+        def creator():
+            calls.append(1)
+            return iter([7, 8])
+
+        cached = paddle.reader.cache(creator)
+        assert list(cached()) == [7, 8] and list(cached()) == [7, 8]
+        assert len(calls) == 1
+
+    def test_xmap(self):
+        xm = paddle.reader.xmap_readers(lambda x: x * 2,
+                                        lambda: iter(range(6)), 2, 3)
+        assert list(xm()) == [0, 2, 4, 6, 8, 10]
+
+    def test_buffered_propagates_producer_error(self):
+        def bad():
+            yield 1
+            raise IOError("disk gone")
+
+        buf = paddle.reader.buffered(bad, 2)
+        it = buf()
+        assert next(it) == 1
+        with pytest.raises(IOError, match="disk gone"):
+            list(it)
+
+
+class TestSysconfigHub:
+    def test_paths_exist(self):
+        inc = paddle.sysconfig.get_include()
+        assert os.path.isfile(os.path.join(inc, "pt_inference_c.h"))
+        assert os.path.isdir(paddle.sysconfig.get_lib())
+
+    def test_hub_local_repo(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=1):\n"
+            "    '''docstring here'''\n"
+            "    return {'scale': scale}\n")
+        assert "tiny_model" in paddle.hub.list(str(tmp_path))
+        assert "docstring" in paddle.hub.help(str(tmp_path), "tiny_model")
+        assert paddle.hub.load(str(tmp_path), "tiny_model",
+                               scale=3) == {"scale": 3}
+        with pytest.raises(RuntimeError, match="network"):
+            paddle.hub.list("owner/repo", source="github")
+
+
+class TestCostModel:
+    def test_snapshot_roundtrip(self, tmp_path):
+        import json
+
+        snap = tmp_path / "snap.json"
+        snap.write_text(json.dumps(
+            {"_device": "cpu", "matmul_2048": {"fwd_ms": 1.25,
+                                               "fwd_bwd_ms": 3.0}}))
+        cm = paddle.cost_model.CostModel(static_cost_file=str(snap))
+        assert cm.get_static_op_time("matmul_2048") == 1.25
+        assert cm.get_static_op_time("matmul_2048", forward=False) == 3.0
+        with pytest.raises(KeyError):
+            cm.get_static_op_time("nope")
+
+    def test_profile_measure(self):
+        paddle.enable_static()
+        try:
+            main = paddle.static.Program()
+            startup = paddle.static.Program()
+            with paddle.static.program_guard(main, startup):
+                x = paddle.static.data("x", [4, 8], "float32")
+                y = paddle.static.nn.fc(x, 4)
+            cm = paddle.cost_model.CostModel()
+            out = cm.profile_measure(
+                main, startup, feed={"x": np.zeros((4, 8), np.float32)},
+                fetch_list=[y], repeat=2)
+            assert out["program_ms"] > 0
+        finally:
+            paddle.disable_static()
+
+
+class TestOnnxVersion:
+    def test_onnx_export_clear_error(self):
+        with pytest.raises(ImportError, match="jit.save"):
+            paddle.onnx.export(None, "/tmp/x")
+
+    def test_version_fields(self):
+        assert paddle.version.full_version == paddle.__version__
+        assert paddle.version.cuda() is False

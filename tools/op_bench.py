@@ -130,7 +130,13 @@ def check(new, old, tol):
         if name.startswith("_"):  # _device/_ts metadata
             continue
         ref = old.get(name)
-        if not ref or "error" in rec or "error" in ref:
+        if not ref or "error" in ref:
+            continue  # new op or broken baseline: nothing to gate against
+        if "error" in rec:
+            # op measured fine in the baseline but errors now — the worst
+            # possible regression, not a skip
+            bad.append(f"{name}: errored (baseline "
+                       f"{ref.get('fwd_ms', '?')} ms): {rec['error'][:80]}")
             continue
         for key in ("fwd_ms", "fwd_bwd_ms"):
             if rec[key] > ref[key] * tol:
