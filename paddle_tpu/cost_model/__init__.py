@@ -46,12 +46,25 @@ class CostModel:
 
     # ------------------------------------------------------------- measured
     def profile_measure(self, main_program, startup_program=None,
-                        feed=None, fetch_list=None, device="tpu",
+                        feed=None, fetch_list=None, device=None,
                         repeat=5):
         """Run a static Program and return measured wall time per run
-        (reference profile_measure runs the program under the profiler)."""
+        (reference profile_measure runs the program under the profiler).
+        Measurement happens on the process's current JAX device; a
+        `device` that differs from it is not honored (warned, not
+        silently relabeled)."""
+        import warnings
+
+        import jax
+
         from ..static import Executor
 
+        actual = jax.devices()[0].platform
+        if device is not None and device != actual:
+            warnings.warn(
+                f"profile_measure(device={device!r}) measures on the "
+                f"current backend {actual!r}; set JAX_PLATFORMS to choose "
+                "the device before importing")
         exe = Executor()
         if startup_program is not None:
             exe.run(startup_program)

@@ -20,11 +20,24 @@ def _load_hubconf(repo_dir, source):
     path = os.path.join(repo_dir, _HUB_CONF)
     if not os.path.isfile(path):
         raise FileNotFoundError(f"{_HUB_CONF} not found under {repo_dir}")
-    spec = importlib.util.spec_from_file_location("paddle_tpu_hubconf", path)
+    # unique, stable module name per repo so (a) objects whose classes live
+    # in hubconf.py stay picklable (pickle looks the module up by name in
+    # sys.modules) and (b) two repos' hubconfs don't clash
+    import hashlib
+
+    mod_name = "paddle_tpu_hubconf_" + hashlib.md5(
+        os.path.abspath(repo_dir).encode()).hexdigest()[:12]
+    if mod_name in sys.modules:
+        return sys.modules[mod_name]
+    spec = importlib.util.spec_from_file_location(mod_name, path)
     mod = importlib.util.module_from_spec(spec)
+    sys.modules[mod_name] = mod
     sys.path.insert(0, repo_dir)
     try:
         spec.loader.exec_module(mod)
+    except BaseException:
+        sys.modules.pop(mod_name, None)
+        raise
     finally:
         sys.path.remove(repo_dir)
     return mod

@@ -118,25 +118,45 @@ def buffered(reader, size):
         q = queue.Queue(maxsize=size)
         end = object()
         err = []
+        stop = threading.Event()
 
         def producer():
             try:
                 for sample in reader():
-                    q.put(sample)
+                    # stop-aware put: if the consumer abandoned the
+                    # generator the thread must exit, not block forever on
+                    # a full queue (leaking the thread + reader handles)
+                    while not stop.is_set():
+                        try:
+                            q.put(sample, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+                    if stop.is_set():
+                        return
             except BaseException as exc:
                 err.append(exc)
             finally:
-                q.put(end)
+                while True:
+                    try:
+                        q.put(end, timeout=0.1)
+                        break
+                    except queue.Full:
+                        if stop.is_set():
+                            break
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
-        while True:
-            sample = q.get()
-            if sample is end:
-                if err:
-                    raise err[0]
-                return
-            yield sample
+        try:
+            while True:
+                sample = q.get()
+                if sample is end:
+                    if err:
+                        raise err[0]
+                    return
+                yield sample
+        finally:
+            stop.set()
 
     return data_reader
 
@@ -150,8 +170,10 @@ def firstn(reader, n):
 
 def xmap_readers(mapper, reader, process_num, buffer_size,
                  order=False):
-    """Parallel map over samples with a thread pool (reference semantics:
-    process_num workers, bounded buffer, optional order preservation)."""
+    """Parallel map over samples with a thread pool (process_num workers,
+    bounded buffer). Output order is always input order — stricter than the
+    reference's order=False contract, which permits but does not require
+    reordering."""
     from concurrent.futures import ThreadPoolExecutor
 
     def data_reader():
@@ -164,10 +186,6 @@ def xmap_readers(mapper, reader, process_num, buffer_size,
             for fut in window:
                 yield fut.result()
 
-    if not order:
-        # unordered variant keeps the same API; ordering is already
-        # deterministic here, which satisfies both contracts
-        pass
     return data_reader
 
 
@@ -201,7 +219,16 @@ def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
         finished = 0
         try:
             while finished < len(readers):
-                frame = q.get()
+                try:
+                    frame = q.get(timeout=5.0)
+                except Exception:
+                    # no frame: if workers died without posting end/error
+                    # (OOM-kill, segfault), raise instead of hanging forever
+                    if all(not p.is_alive() for p in procs):
+                        raise RuntimeError(
+                            "multiprocess_reader: all workers exited "
+                            "without completing (killed?)")
+                    continue
                 kind = frame[0]
                 if kind == "sample":
                     yield frame[1]

@@ -11,7 +11,12 @@ _ROOT = os.path.dirname(os.path.abspath(__file__))
 
 def get_include():
     """Directory of C headers (custom-op ABI `pt_custom_op.h`, inference C
-    API `pt_inference_c.h`)."""
+    API `pt_inference_c.h`). Prefers an in-package `include/` (installed
+    wheels ship headers there); falls back to the source checkout's
+    `csrc/include`."""
+    packaged = os.path.join(_ROOT, "include")
+    if os.path.isdir(packaged):
+        return packaged
     return os.path.abspath(os.path.join(_ROOT, "..", "csrc", "include"))
 
 
