@@ -5,7 +5,7 @@ step (fwd + bwd + AdamW) is one XLA executable via jit.TrainStep; bf16
 compute with fp32 master weights (multi_precision), activation recompute,
 Pallas flash attention.
 
-Prints one JSON line per completed config, smallest config first, so a
+Prints one JSON line per completed config, best-known config first, so a
 parseable result exists even if the harness kills the process mid-run.
 After the ladder, the BEST-MFU rung is re-emitted once more (tagged
 "best": true) so the final line — what the driver records — is the best
@@ -13,10 +13,18 @@ completed config:
   {"metric": ..., "value": tokens/sec/chip, "unit": ..., "vs_baseline": ...}
 vs_baseline = MFU / 0.45 (the driver's v5p-128 target ratio).
 
-Every config runs in a watchdog subprocess (`--run` mode) so a hung backend
-init or pathological compile can never zero the whole benchmark. If the
-accelerator probe fails, configs fall back to the CPU platform (degraded
-but non-null numbers beat a timeout).
+Accelerator acquisition (round-3 rework; the round-2 run lost the TPU to a
+single failed 120 s probe and recorded CPU numbers):
+  * No up-front probe gate. The FIRST ladder rung is itself the probe: the
+    known-best config runs on the default (accelerator) platform under a
+    generous watchdog sized to leave a reserve for a guaranteed CPU line.
+  * On a rung failure the accelerator is re-probed (bounded jax.devices()
+    in a subprocess) to distinguish a config problem (OOM/compile error —
+    keep using the TPU) from a wedged tunnel (every probe hangs — fall to
+    CPU for the rest of the budget).
+  * Every result line carries "platform"; CPU lines are tagged
+    "degraded": true and can only become "best" when no real accelerator
+    line exists.
 """
 from __future__ import annotations
 
@@ -28,25 +36,33 @@ import time
 
 import numpy as np
 
-# (preset, batch, seq_len, recompute_policy) — cheapest first; the ladder
-# climbs while the time budget lasts and the best-MFU line is re-emitted
-# last. Measured on v5e (profiling: attention kernels are the costliest
-# thing to rematerialize — 57% of step time under full remat):
+# (preset, batch, seq_len, recompute_policy) — BEST KNOWN FIRST (the driver
+# records the final re-emitted best line; banking the money rung early
+# protects against mid-ladder kills). Measured on v5e (profiling: attention
+# kernels are the costliest thing to rematerialize — 57% of step time under
+# full remat):
 #   medium bs8 full      23.8% MFU
 #   medium bs8 attn      33.9%   (keep attention outputs, remat the rest)
 #   medium bs8 dots_attn 35.3%   (+ keep MXU matmul outputs)
-#   medium bs8 none      40.6%   (no remat; bs16 OOMs)
+#   medium bs8 none      40.6%   (no remat; bs16 OOMs under none)
 #   large  bs8 attn      37.2%
-CONFIGS = [
-    ("gpt2-tiny", 8, 128, "full"),
-    ("gpt2-small", 8, 1024, "none"),
-    ("gpt2-medium", 8, 1024, "dots_attn"),
-    ("gpt2-medium", 8, 1024, "none"),
-    ("gpt2-large", 8, 1024, "attn"),
+# Rungs 2+ are the untried 45%-crossing levers (VERDICT r2): bigger batch
+# under dots_attn, longer sequence, large-model dots_attn.
+TPU_CONFIGS = [
+    ("gpt2-medium", 8, 1024, "none"),       # known 40.6% — bank it first
+    ("gpt2-medium", 16, 1024, "dots_attn"),  # 2x batch, keep MXU outputs
+    ("gpt2-medium", 16, 1024, "none"),       # OOMed on v5e; retry (donation)
+    ("gpt2-medium", 8, 2048, "dots_attn"),   # longer sequence
+    ("gpt2-large", 8, 1024, "dots_attn"),    # large under the best policy
 ]
+# CPU fallback ladder: only the tiny config finishes on one core.
+CPU_CONFIGS = [("gpt2-tiny", 8, 128, "full")]
 
 TOTAL_BUDGET = float(os.environ.get("PADDLE_TPU_BENCH_BUDGET", "540"))
-PROBE_TIMEOUT = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "120"))
+PROBE_TIMEOUT = float(os.environ.get("PADDLE_TPU_BENCH_PROBE_TIMEOUT", "60"))
+# reserve kept for the guaranteed CPU line once the accelerator is declared
+# dead (import + tiny compile + steps on one core ≈ 100 s worst case)
+CPU_RESERVE = 150.0
 
 
 def peak_flops_per_chip():
@@ -76,10 +92,12 @@ def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16",
     # tuned library flash-attention kernel (see ops/pallas_ops._stock_flash)
     os.environ.setdefault("PADDLE_TPU_X64", "0")
     os.environ.setdefault("PADDLE_TPU_MATMUL_PRECISION", "default")
+    import jax
     import paddle_tpu as paddle
     from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
                                    GPTPretrainingCriterion)
 
+    platform = jax.devices()[0].platform
     paddle.seed(0)
     cfg = GPTConfig.preset(preset, seq_len=seq_len, dtype=dtype,
                            dropout=0.0,
@@ -119,13 +137,14 @@ def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16",
     tps = tokens_per_step / dt
     flops = cfg.flops_per_token() * tokens_per_step
     mfu = flops / dt / peak_flops_per_chip()
-    return tps, mfu, final, cfg
+    return tps, mfu, final, platform
 
 
 def _run_child(preset, batch, seq, policy="full"):
     """--run mode: execute one config and print its JSON line."""
-    tps, mfu, loss, _ = run(preset, int(batch), int(seq), policy=policy)
-    print(json.dumps({
+    tps, mfu, loss, platform = run(preset, int(batch), int(seq),
+                                   policy=policy)
+    rec = {
         "metric": f"GPT({preset}) train tokens/sec/chip "
                   f"(bf16, seq{seq}, bs{batch}, remat={policy})",
         "value": round(tps, 1),
@@ -133,34 +152,60 @@ def _run_child(preset, batch, seq, policy="full"):
         "vs_baseline": round(mfu / 0.45, 4),
         "mfu": round(mfu, 4),
         "loss": round(loss, 4),
-    }), flush=True)
+        "platform": platform,
+    }
+    if platform == "cpu":
+        rec["degraded"] = True  # not a TPU number — nominal peak-FLOPs
+    print(json.dumps(rec), flush=True)
     return 0
 
 
-def _probe_accelerator(deadline):
-    """Check the accelerator backend initializes in bounded time (in a
-    subprocess — a hung PJRT client init cannot be interrupted in-process).
-    Returns the env for benchmark children."""
-    env = dict(os.environ)
-    timeout = min(PROBE_TIMEOUT, max(5.0, deadline - time.time()))
+def _probe_platform(timeout):
+    """Bounded default-platform check in a subprocess (a hung PJRT init
+    cannot be interrupted in-process). Returns the platform string, or
+    None on timeout/failure."""
     try:
         r = subprocess.run(
             [sys.executable, "-c",
-             "import jax; d = jax.devices()[0]; print(d.platform)"],
-            env=env, timeout=timeout, capture_output=True, text=True)
+             "import jax; print(jax.devices()[0].platform)"],
+            env=dict(os.environ), timeout=max(5.0, timeout),
+            capture_output=True, text=True)
         if r.returncode == 0 and r.stdout.strip():
-            return env
+            return r.stdout.strip()
     except subprocess.TimeoutExpired:
         pass
-    # Accelerator init hung or failed: pin children to CPU, neutralizing any
-    # TPU-tunnel PJRT plugin (see paddle_tpu/__init__.py guard).
-    env["JAX_PLATFORMS"] = "cpu"
-    env["PALLAS_AXON_POOL_IPS"] = ""
+    return None
+
+
+def _probe_alive(timeout):
+    return _probe_platform(timeout) is not None
+
+
+def _note(text):
     print(json.dumps({"metric": "bench-note", "value": 0, "unit": "",
-                      "vs_baseline": 0,
-                      "note": "accelerator init timed out; CPU fallback"}),
+                      "vs_baseline": 0, "note": text}),
           file=sys.stderr, flush=True)
-    return env
+
+
+def _attempt(cfg, env, watchdog):
+    """Run one config in a watchdog subprocess. Returns (record|None, err)."""
+    preset, batch, seq, policy = cfg
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--run",
+             preset, str(batch), str(seq), policy],
+            env=env, timeout=watchdog, capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return None, f"{preset}: watchdog timeout after {watchdog:.0f}s"
+    if r.returncode != 0:
+        return None, f"{preset}: " + (r.stderr or r.stdout).strip()[-300:]
+    line = r.stdout.strip().splitlines()[-1]
+    try:
+        rec = json.loads(line)
+    except ValueError:
+        return None, f"{preset}: unparseable output {line[-200:]!r}"
+    print(line, flush=True)
+    return rec, None
 
 
 def main():
@@ -168,43 +213,87 @@ def main():
         return _run_child(*sys.argv[2:6])
 
     deadline = time.time() + TOTAL_BUDGET
-    env = _probe_accelerator(deadline)
-    printed = 0
-    best = None
+    results = []
     last_err = "no config attempted"
-    for preset, batch, seq, policy in CONFIGS:
+    accel_dead = False
+    accel_seen = False
+
+    # Cheap pre-check, used ONLY to skip the big-model ladder when the
+    # default platform already resolves to CPU (no accelerator in the env).
+    # A timeout here does NOT pin anything — the first rung below is the
+    # real probe, under a far more generous watchdog (round-2 lesson: one
+    # failed 120 s probe must not decide the whole budget).
+    quick = _probe_platform(25.0)
+    if quick == "cpu":
+        accel_dead = True
+        _note("default platform is cpu; running degraded CPU ladder")
+
+    # ---- accelerator ladder: first rung doubles as the liveness probe ----
+    for i, cfg in enumerate(TPU_CONFIGS):
         remaining = deadline - time.time()
-        if remaining < 30:
+        if accel_dead or remaining < CPU_RESERVE + 60:
             break
-        try:
-            r = subprocess.run(
-                [sys.executable, os.path.abspath(__file__), "--run",
-                 preset, str(batch), str(seq), policy],
-                env=env, timeout=remaining, capture_output=True, text=True)
-        except subprocess.TimeoutExpired:
-            last_err = f"{preset}: timeout after {remaining:.0f}s"
-            break
-        if r.returncode == 0:
-            line = r.stdout.strip().splitlines()[-1]
-            print(line, flush=True)
-            printed += 1
-            try:
-                rec = json.loads(line)
-                if best is None or rec.get("mfu", 0) > best.get("mfu", 0):
-                    best = rec
-            except ValueError:
-                pass
+        watchdog = min(300.0, remaining - CPU_RESERVE)
+        rec, err = _attempt(cfg, dict(os.environ), watchdog)
+        if rec is not None:
+            results.append(rec)
+            if rec.get("platform") != "cpu":
+                accel_seen = True
+            else:
+                # default platform resolved to CPU (no accelerator in env):
+                # the "TPU ladder" would just burn budget on giant CPU runs
+                _note("default platform is cpu; skipping accelerator ladder")
+                break
         else:
-            last_err = f"{preset}: " + (r.stderr or r.stdout).strip()[-300:]
-    if printed:
-        if best is not None:
-            # re-emit the best rung LAST — the driver records the final line
-            print(json.dumps({**best, "best": True}), flush=True)
-        return 0
-    print(json.dumps({"metric": "GPT train tokens/sec/chip", "value": 0,
-                      "unit": "tokens/s/chip", "vs_baseline": 0,
-                      "error": last_err[:300]}), flush=True)
-    return 1
+            last_err = err
+            _note(err)
+            # config failure vs dead tunnel: re-probe, bounded
+            remaining = deadline - time.time()
+            if remaining < CPU_RESERVE + 30:
+                break
+            if not _probe_alive(min(PROBE_TIMEOUT,
+                                    remaining - CPU_RESERVE)):
+                # one escalated retry before declaring death, if the budget
+                # allows — a slow first init can exceed the short probe
+                remaining = deadline - time.time()
+                if accel_seen or remaining < CPU_RESERVE + 2 * PROBE_TIMEOUT \
+                        or not _probe_alive(min(2 * PROBE_TIMEOUT,
+                                                remaining - CPU_RESERVE)):
+                    accel_dead = True
+                    _note("accelerator probe failed; CPU fallback for the "
+                          "rest of the budget")
+
+    # ---- CPU fallback: bank a degraded line if no real one exists --------
+    if not any(r.get("platform") != "cpu" for r in results):
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""  # skip axon PJRT plugin
+        for cfg in CPU_CONFIGS:
+            remaining = deadline - time.time()
+            if remaining < 30:
+                break
+            rec, err = _attempt(cfg, env, remaining)
+            if rec is not None:
+                rec["degraded"] = True
+                rec["platform"] = "cpu"
+                results.append(rec)
+            else:
+                last_err = err
+
+    if not results:
+        print(json.dumps({"metric": "GPT train tokens/sec/chip", "value": 0,
+                          "unit": "tokens/s/chip", "vs_baseline": 0,
+                          "error": last_err[:300]}), flush=True)
+        return 1
+
+    # best = highest-MFU real-accelerator line; degraded lines only count
+    # when nothing ran on the accelerator. Re-emitted LAST — the driver
+    # records the final line.
+    real = [r for r in results if not r.get("degraded")]
+    pool = real or results
+    best = max(pool, key=lambda r: r.get("mfu", 0))
+    print(json.dumps({**best, "best": True}), flush=True)
+    return 0
 
 
 if __name__ == "__main__":

@@ -25,14 +25,9 @@ def _load():
     global _LIB
     if _LIB is not None:
         return _LIB
-    here = os.path.dirname(os.path.dirname(os.path.dirname(
-        os.path.abspath(__file__))))
-    path = os.path.join(here, "lib", "libptdatafeed.so")
-    if not os.path.exists(path):
-        import subprocess
+    from ...sysconfig import ensure_native_built
 
-        src = os.path.join(os.path.dirname(here), "csrc")
-        subprocess.run(["make", "-C", src], check=True, capture_output=True)
+    path = ensure_native_built("libptdatafeed.so")
     lib = ctypes.CDLL(path)
     lib.ptdf_create.restype = ctypes.c_void_p
     lib.ptdf_create.argtypes = [ctypes.c_int, ctypes.POINTER(ctypes.c_int),

@@ -105,26 +105,52 @@ class HybridParallelEngine:
 
     # ------------------------------------------------------------------ build
     def _build(self):
-        mesh_axes = set(self.mesh.axis_names)
-        stack = _find_block_stack(self.model)
-        if stack is None:
-            raise ValueError(
-                "HybridParallelEngine requires a uniform block stack "
-                "(e.g. GPT blocks in a LayerList)")
-        self.stack_prefix, blocks = stack
-        self.block0 = blocks[0]
-        self.n_layers = len(blocks)
-        if self.n_layers % self.pp != 0:
-            raise ValueError(f"n_layers {self.n_layers} % pp {self.pp} != 0")
+        from ..meta_parallel.pp_layers import PipelineLayer
 
-        full_state = self.model.state_dict()
+        mesh_axes = set(self.mesh.axis_names)
+        self._pre_seq = self._post_seq = None
+        if isinstance(self.model, PipelineLayer):
+            # LayerDesc path (reference pp_layers.py:57,209): explicit
+            # layer list, possibly with distinct head/tail entries and
+            # shared-weight groups. The uniform trunk is layer-sharded
+            # over 'pp'; pre/post entries run masked on the first/last
+            # stage; every non-trunk (incl. shared/tied) param lands in
+            # `other`, whose grads are psum'd over 'pp' — the reference's
+            # shared-weight-group allreduce.
+            pre, blocks, post = self.model.segment_for_pipeline(self.pp)
+            self._pre_seq, self._post_seq = pre, post
+            self.stack_prefix = None
+            self.block0 = blocks[0]
+            self.n_layers = len(blocks)
+            trunk_ids = {id(t) for b in blocks
+                         for t in b.state_dict().values()}
+            full_state = self.model.state_dict()
+            self.other_names, self.other_tensors = [], []
+            for name, t in full_state.items():
+                if id(t) not in trunk_ids:
+                    self.other_names.append(name)
+                    self.other_tensors.append(t)
+        else:
+            stack = _find_block_stack(self.model)
+            if stack is None:
+                raise ValueError(
+                    "HybridParallelEngine requires a uniform block stack "
+                    "(e.g. GPT blocks in a LayerList) or a PipelineLayer "
+                    "built from LayerDescs")
+            self.stack_prefix, blocks = stack
+            self.block0 = blocks[0]
+            self.n_layers = len(blocks)
+            if self.n_layers % self.pp != 0:
+                raise ValueError(
+                    f"n_layers {self.n_layers} % pp {self.pp} != 0")
+            full_state = self.model.state_dict()
+            # split state: stacked trunk vs everything else
+            self.other_names, self.other_tensors = [], []
+            for name, t in full_state.items():
+                if not name.startswith(self.stack_prefix + "."):
+                    self.other_names.append(name)
+                    self.other_tensors.append(t)
         block_keys = list(self.block0.state_dict().keys())
-        # split state: stacked trunk vs everything else
-        self.other_names, self.other_tensors = [], []
-        for name, t in full_state.items():
-            if not name.startswith(self.stack_prefix + "."):
-                self.other_names.append(name)
-                self.other_tensors.append(t)
         self.block_tensors = [blocks[i].state_dict() for i in
                               range(self.n_layers)]
         self.block_keys = block_keys
@@ -225,7 +251,8 @@ class HybridParallelEngine:
         saved_blk = [t._data for t in block_tensors]
         use_remat = bool(self.strategy and self.strategy.recompute) or \
             getattr(getattr(self.model, "gpt", None), "cfg", None) is not None \
-            and getattr(self.model.gpt.cfg, "use_recompute", False)
+            and getattr(self.model.gpt.cfg, "use_recompute", False) or \
+            getattr(self.model, "_recompute_interval", 0) > 0
 
         def run_block(x, layer_arrays):
             for t, k in zip(block_tensors, self.block_keys):
@@ -265,16 +292,31 @@ class HybridParallelEngine:
             self._bind(block_tensors, saved_blk)
 
     def _embed(self, tokens):
+        if self._pre_seq is not None:  # PipelineLayer first-stage entries
+            x = tokens
+            for entry in self._pre_seq:
+                x = self.model._apply(entry, x)
+            return x
         gpt = getattr(self.model, "gpt", self.model)
         return gpt.embeddings(tokens)
 
     def _head_loss(self, xa, labels):
-        gpt = getattr(self.model, "gpt", self.model)
-        x = gpt.ln_f(Tensor(xa))
-        w = gpt.embeddings.word_embeddings.weight
-        logits = x._data @ w._data.T
-        if self.criterion is not None:
-            return self.criterion(Tensor(logits), Tensor(labels))._data
+        if self._post_seq is not None:  # PipelineLayer last-stage entries
+            x = Tensor(xa)
+            for entry in self._post_seq:
+                x = self.model._apply(entry, x)
+            crit = self.criterion or getattr(self.model, "_loss_fn", None)
+            if crit is not None:
+                out = crit(x, Tensor(labels))
+                return out._data if isinstance(out, Tensor) else out
+            logits = x._data
+        else:
+            gpt = getattr(self.model, "gpt", self.model)
+            x = gpt.ln_f(Tensor(xa))
+            w = gpt.embeddings.word_embeddings.weight
+            logits = x._data @ w._data.T
+            if self.criterion is not None:
+                return self.criterion(Tensor(logits), Tensor(labels))._data
         lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         ll = jnp.take_along_axis(lp, labels[..., None].astype(jnp.int32),
                                  axis=-1)

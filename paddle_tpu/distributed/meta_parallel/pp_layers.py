@@ -42,6 +42,8 @@ class PipelineLayer(nn.Layer):
         super().__init__()
         self._loss_fn = loss_fn
         self._num_stages = num_stages or 1
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
         self._shared = {}
         built = []
         for i, d in enumerate(layers):
@@ -67,13 +69,78 @@ class PipelineLayer(nn.Layer):
         per = (n + self._num_stages - 1) // self._num_stages
         return idx // per
 
+    def _apply(self, entry, x):
+        """Run one run_sequence entry on x."""
+        kind, item, ffn = entry
+        if kind == "shared":
+            layer = self._shared[item]
+            return ffn(layer, x) if ffn is not None else layer(x)
+        if kind == "fn":
+            return item(x)
+        return ffn(item, x) if ffn is not None else item(x)
+
+    def segment_for_pipeline(self, pp):
+        """Segment the run_sequence for the compiled 1F1B engine:
+        (pre_entries, trunk_layers, post_entries).
+
+        Reference semantics (`pp_layers.py:209` _segment_network): split an
+        arbitrary LayerDesc list into per-stage sublists. TPU re-design:
+        the SPMD 1F1B schedule layer-shards a STACKED trunk over the 'pp'
+        mesh axis (all stages execute one shared block program over their
+        parameter slice), so the trunk must be a structurally-uniform run —
+        we pick the longest run of plain layers with identical class +
+        state structure, trimmed to a multiple of pp. Everything before it
+        (embeddings, preprocessing fns) runs on stage 0 and everything
+        after it (final norm, lm head, leftover blocks) on the last stage,
+        via masked lockstep compute in the engine — the first/last-stage
+        special-casing the reference does with rank-divergent Python.
+        seg_method 'layer:Name' restricts trunk candidates to classes whose
+        name starts with Name (reference seg_method contract)."""
+        entries = list(self.run_sequence)
+        want_cls = None
+        if isinstance(self._seg_method, str) and \
+                self._seg_method.startswith("layer:"):
+            want_cls = self._seg_method[len("layer:"):]
+
+        def sig(entry):
+            kind, item, ffn = entry
+            if kind != "layer" or ffn is not None:
+                return None
+            cls = type(item).__name__
+            if want_cls is not None and not cls.startswith(want_cls):
+                return None
+            sd = item.state_dict()
+            return (cls, tuple(sd.keys()),
+                    tuple(tuple(t._data.shape) for t in sd.values()))
+
+        sigs = [sig(e) for e in entries]
+        start, length = 0, 0
+        i = 0
+        while i < len(entries):
+            if sigs[i] is None:
+                i += 1
+                continue
+            j = i
+            while j < len(entries) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > length:
+                start, length = i, j - i
+            i = j
+        usable = (length // pp) * pp
+        if usable < pp:
+            raise ValueError(
+                f"PipelineLayer: found no structurally-uniform run of at "
+                f"least pp={pp} layers to shard over the pipe axis "
+                f"(longest run: {length}). The compiled SPMD 1F1B schedule "
+                "stacks identical blocks over 'pp'; give the pipeline a "
+                "uniform trunk (reference models do: their LayerDesc lists "
+                "are embedding + N identical blocks + head).")
+        pre = entries[:start]
+        trunk = [e[1] for e in entries[start:start + usable]]
+        post = entries[start + usable:]  # leftover blocks + norm + head
+        return pre, trunk, post
+
     def forward(self, x):
-        for kind, item, ffn in self.run_sequence:
-            if kind == "shared":
-                layer = self._shared[item]
-                x = ffn(layer, x) if ffn is not None else layer(x)
-            elif kind == "fn":
-                x = item(x)
-            else:
-                x = ffn(item, x) if ffn is not None else item(x)
+        for entry in self.run_sequence:
+            x = self._apply(entry, x)
         return x

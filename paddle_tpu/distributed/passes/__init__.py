@@ -175,17 +175,36 @@ def _cast_wrap(fn, low_dtype):
     return wrapped
 
 
+def _replace_record(op, new_fn, marker):
+    """Build a replacement OpRecord with `new_fn` instead of mutating `op`.
+
+    Program.clone() shallow-copies the ops list, so clones share OpRecord
+    objects; mutating op.fn in place would silently rewrite every program
+    that recorded this op (advisor round-2 finding). Replacing the record
+    on the *target* program keeps clones (e.g. clone(for_test=True) eval
+    programs) untouched."""
+    from ...static.program import OpRecord
+
+    new = OpRecord(new_fn, op.name, op.inputs, op.attrs, op.outputs,
+                   nondiff=op.nondiff)
+    for m in ("_amp_wrapped", "_remat_wrapped"):
+        if getattr(op, m, False):
+            setattr(new, m, True)
+    setattr(new, marker, True)
+    return new
+
+
 class _AmpPassBase(PassBase):
     _dtype = jnp.bfloat16
 
     def _apply_single_impl(self, main_program, startup_program, context):
         n = 0
-        for op in main_program.ops:
+        for i, op in enumerate(main_program.ops):
             base = op.name.split("/")[-1]
             if base in _LOW_PRECISION_OPS and \
                     not getattr(op, "_amp_wrapped", False):
-                op.fn = _cast_wrap(op.fn, self._dtype)
-                op._amp_wrapped = True
+                main_program.ops[i] = _replace_record(
+                    op, _cast_wrap(op.fn, self._dtype), "_amp_wrapped")
                 n += 1
         context.set_attr(f"{self.name}:wrapped_ops", n)
 
@@ -231,11 +250,11 @@ class AutoParallelRecomputePass(PassBase):
             return wrapped
 
         n = 0
-        for op in main_program.ops:
+        for i, op in enumerate(main_program.ops):
             base = op.name.split("/")[-1]
             if base in names and not getattr(op, "_remat_wrapped", False):
-                op.fn = remat_wrap(op.fn)
-                op._remat_wrapped = True
+                main_program.ops[i] = _replace_record(
+                    op, remat_wrap(op.fn), "_remat_wrapped")
                 n += 1
         context.set_attr("recompute:wrapped_ops", n)
 

@@ -9,6 +9,16 @@ thread-pooled TCP server speaking length-prefixed pickle frames; rendezvous
 rides the native TCPStore (csrc/tcpstore) exactly like `core.TCPStore` does in
 the reference. RPC here is control-plane only — tensor traffic belongs to the
 compiled ICI collectives, so a brpc-scale data plane would be dead weight.
+
+Trust model (same as the reference's brpc agent): every worker executes
+pickled callables from any peer that can reach its endpoint — this is
+remote code execution BY DESIGN and must only run on a private,
+mutually-trusted cluster network. Workers bind the endpoint from
+PADDLE_WORKER_ENDPOINT; never point that at a routable interface on an
+untrusted network. As defense-in-depth the agent requires a per-job
+shared secret (derived from the rendezvous via the `PADDLE_RPC_TOKEN` the
+master generates, or supplied explicitly) on every frame; a frame bearing
+the wrong token is dropped before unpickling.
 """
 from __future__ import annotations
 
@@ -67,10 +77,11 @@ class _Agent:
     lazily-created channel per peer for outbound calls.
     """
 
-    def __init__(self, name, rank, world_size, infos):
+    def __init__(self, name, rank, world_size, infos, token=b""):
         self.name = name
         self.rank = rank
         self.world_size = world_size
+        self.token = token  # per-job shared secret; prefixes every frame
         self.infos = {i.name: i for i in infos}
         self.infos_by_rank = {i.rank: i for i in infos}
         self.me = self.infos_by_rank[rank]
@@ -103,12 +114,14 @@ class _Agent:
                     req = _recv_frame(conn)
                 except (ConnectionError, OSError):
                     return
+                if self.token and not req.startswith(self.token):
+                    return  # unauthenticated frame: drop before unpickling
                 try:
-                    call = pickle.loads(req)
+                    call = pickle.loads(req[len(self.token):])
                     result = call.func(*call.args, **call.kwargs)
-                    reply = pickle.dumps(("ok", result))
+                    reply = self.token + pickle.dumps(("ok", result))
                 except BaseException as exc:  # ship the error to the caller
-                    reply = pickle.dumps(("err", exc))
+                    reply = self.token + pickle.dumps(("err", exc))
                 try:
                     _send_frame(conn, reply)
                 except OSError:
@@ -131,8 +144,8 @@ class _Agent:
         return entry
 
     def invoke(self, to, fn, args, kwargs, timeout):
-        payload = pickle.dumps(_PythonFunc(fn, tuple(args or ()),
-                                           dict(kwargs or {})))
+        payload = self.token + pickle.dumps(_PythonFunc(fn, tuple(args or ()),
+                                                        dict(kwargs or {})))
 
         def _call():
             sock, lock = self._connection(to)
@@ -141,7 +154,14 @@ class _Agent:
                     sock.settimeout(
                         timeout if timeout and timeout > 0 else None)
                     _send_frame(sock, payload)
-                    status, value = pickle.loads(_recv_frame(sock))
+                    raw = _recv_frame(sock)
+                    # replies are token-prefixed too: never unpickle bytes
+                    # from a peer that doesn't hold the job secret (e.g. a
+                    # rogue process on a recycled worker port)
+                    if self.token and not raw.startswith(self.token):
+                        raise ConnectionError(
+                            "rpc reply failed token authentication")
+                    status, value = pickle.loads(raw[len(self.token):])
                 except Exception:
                     # a timeout/short read leaves a reply (or half-frame) in
                     # flight — the channel is desynchronized; drop it so the
@@ -203,6 +223,19 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
     store = TCPStore(master_addr, int(master_port), is_master=(rank == 0),
                      world_size=world_size)
+    # per-job shared secret: PADDLE_RPC_TOKEN, or generated by rank 0 and
+    # distributed over the (trusted) rendezvous store
+    env_token = os.environ.get("PADDLE_RPC_TOKEN")
+    if env_token is not None:
+        token = env_token.encode()
+    elif rank == 0:
+        import secrets
+
+        token = secrets.token_hex(16).encode()
+        store.set("rpc/token", token)
+    if env_token is None:
+        store.wait(["rpc/token"])
+        token = store.get("rpc/token")
     ip, port = worker_endpoint.rsplit(":", 1)
     store.set(f"rpc/info/{rank}",
               pickle.dumps(WorkerInfo(name, rank, ip, int(port))))
@@ -218,7 +251,7 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
     with _state_lock:
         if _state is not None:
             raise RuntimeError("init_rpc called twice without shutdown")
-        agent = _Agent(name, rank, world_size, infos)
+        agent = _Agent(name, rank, world_size, infos, token=token)
         _state = {"agent": agent, "store": store}
     # all-started barrier (reference _barrier_never_timeout)
     import time

@@ -21,16 +21,9 @@ def _load():
     global _LIB
     if _LIB is not None:
         return _LIB
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    path = os.path.join(here, "lib", "libtcpstore.so")
-    if not os.path.exists(path):
-        # build on demand (g++ is in the image)
-        import subprocess
+    from ..sysconfig import ensure_native_built
 
-        src = os.path.join(os.path.dirname(here), "csrc")
-        if os.path.exists(os.path.join(src, "Makefile")):
-            subprocess.run(["make", "-C", src], check=True,
-                           capture_output=True)
+    path = ensure_native_built("libtcpstore.so")
     lib = ctypes.CDLL(path)
     lib.tcpstore_server_start.restype = ctypes.c_void_p
     lib.tcpstore_server_start.argtypes = [ctypes.c_int]

@@ -13,6 +13,13 @@ counted by the OS: the producer closes its mapping after pickling, the
 consumer unlinks after rebuilding — single-consumer semantics, matching
 the reference's file_system strategy caveats.
 
+Producer-lifetime caveat: the segment is unregistered from the producer's
+`resource_tracker` at creation (else the tracker would unlink it when the
+producer exits, racing a consumer that has not attached yet — e.g. a
+short-lived worker putting a Tensor on a Queue). The cost is that a
+message which is NEVER consumed leaks its segment until reboot/manual
+cleanup — same trade-off the reference's file_system strategy documents.
+
 Usage matches the reference: `import paddle_tpu.incubate.multiprocessing
 as mp` then use mp.Process/Queue/Pipe as normal; Tensors put on queues
 travel via shm automatically.
@@ -44,9 +51,23 @@ def _rebuild_tensor(shm_name, shape, dtype_str):
     return Tensor(arr)
 
 
+def _untrack(seg):
+    """Detach `seg` from this process's resource_tracker so producer exit
+    does not unlink it before the consumer attaches (see module docstring).
+    Python 3.13+ exposes track=False at create; older versions need the
+    explicit unregister."""
+    try:
+        from multiprocessing import resource_tracker
+
+        resource_tracker.unregister(seg._name, "shared_memory")
+    except Exception:
+        pass  # best-effort; tracker internals differ across versions
+
+
 def _reduce_tensor(t):
     arr = np.asarray(t.numpy())
     seg = shared_memory.SharedMemory(create=True, size=max(1, arr.nbytes))
+    _untrack(seg)
     try:
         np.ndarray(arr.shape, dtype=arr.dtype, buffer=seg.buf)[...] = arr
         name = seg.name

@@ -7,7 +7,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 
 _LIB = None
 
@@ -16,12 +15,9 @@ def _lib():
     global _LIB
     if _LIB is not None:
         return _LIB
-    here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    so = os.path.join(here, "lib", "libshmring.so")
-    if not os.path.exists(so):
-        src = os.path.join(os.path.dirname(here), "csrc")
-        subprocess.run(["make", "-C", src], check=True,
-                       capture_output=True)
+    from ..sysconfig import ensure_native_built
+
+    so = ensure_native_built("libshmring.so")
     lib = ctypes.CDLL(so)
     lib.ptshm_create.restype = ctypes.c_void_p
     lib.ptshm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64,

@@ -4,9 +4,50 @@ from __future__ import annotations
 
 import os
 
-__all__ = ["get_include", "get_lib"]
+__all__ = ["get_include", "get_lib", "ensure_native_built"]
 
 _ROOT = os.path.dirname(os.path.abspath(__file__))
+
+_NATIVE_LIBS = ("libtcpstore.so", "libshmring.so", "libptdatafeed.so",
+                "libptinfer_capi.so")
+
+
+def ensure_native_built(lib_name=None):
+    """Build the native runtime libraries from `csrc/` on first use.
+
+    The shared objects are NOT committed to the repository (they embed the
+    local Python ABI — libptinfer_capi links via `python3-config --embed` —
+    so a prebuilt binary silently fails to load on any other interpreter).
+    Every ctypes loader calls this before dlopen; a source checkout with a
+    toolchain (g++ + make, baked into the image) builds them once.
+
+    Returns the path of `lib_name` (or the lib dir when None)."""
+    lib_dir = os.path.join(_ROOT, "lib")
+    targets = [lib_name] if lib_name else list(_NATIVE_LIBS)
+    if any(not os.path.exists(os.path.join(lib_dir, t)) for t in targets):
+        src = os.path.abspath(os.path.join(_ROOT, "..", "csrc"))
+        if os.path.exists(os.path.join(src, "Makefile")):
+            import subprocess
+
+            # serialize concurrent first-use builds (8 ranks cold-starting
+            # would otherwise race `make` into the same output dir and
+            # dlopen half-written .so files)
+            os.makedirs(lib_dir, exist_ok=True)
+            lock_path = os.path.join(lib_dir, ".build.lock")
+            with open(lock_path, "w") as lock:
+                try:
+                    import fcntl
+
+                    fcntl.flock(lock, fcntl.LOCK_EX)
+                except ImportError:
+                    pass
+                # double-check under the lock: another process may have
+                # finished the build while we waited
+                if any(not os.path.exists(os.path.join(lib_dir, t))
+                       for t in targets):
+                    subprocess.run(["make", "-C", src], check=True,
+                                   capture_output=True)
+    return os.path.join(lib_dir, lib_name) if lib_name else lib_dir
 
 
 def get_include():

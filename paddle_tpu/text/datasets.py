@@ -147,6 +147,11 @@ class Imikolov(Dataset):
                             self.data.append(
                                 tuple(ids[i - self.window_size:i]))
                 else:
+                    # reference imikolov.py:167: SEQ mode with a positive
+                    # window_size drops sequences longer than the window
+                    if self.window_size > 0 and \
+                            len(ids[:-1]) > self.window_size:
+                        continue
                     self.data.append((ids[:-1], ids[1:]))
 
     def __getitem__(self, idx):

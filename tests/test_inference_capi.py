@@ -99,7 +99,8 @@ def test_c_client_matches_python(artifact, tmp_path):
     expected = pred.get_output_handle(
         pred.get_output_names()[0]).copy_to_cpu()
 
-    # build the C client
+    # build the C client (and the .so if this checkout hasn't built it yet)
+    paddle.sysconfig.ensure_native_built("libptinfer_capi.so")
     src = tmp_path / "client.c"
     src.write_text(C_CLIENT)
     binary = tmp_path / "client"

@@ -74,6 +74,27 @@ class TestBF16Pass:
         finally:
             paddle.disable_static()
 
+    def test_clone_isolated_from_pass(self):
+        """Applying a pass to the train program must not leak casts into a
+        clone(for_test=True) eval program: clones share the ops *list copy*,
+        so passes replace records instead of mutating shared ones (advisor
+        round-2 finding)."""
+        try:
+            main, startup, loss = _build_mlp_program()
+            eval_prog = main.clone(for_test=True)
+            before = list(eval_prog.ops)
+            ctx = new_pass("auto_parallel_bf16").apply([main])
+            assert ctx.get_attr("auto_parallel_bf16:wrapped_ops") >= 2
+            # the eval clone still holds the original, unwrapped records
+            assert all(a is b for a, b in zip(before, eval_prog.ops))
+            assert not any(getattr(op, "_amp_wrapped", False)
+                           for op in eval_prog.ops)
+            # and the train program got fresh wrapped records
+            assert sum(getattr(op, "_amp_wrapped", False)
+                       for op in main.ops) >= 2
+        finally:
+            paddle.disable_static()
+
     def test_idempotent(self):
         try:
             main, _, _ = _build_mlp_program()

@@ -1,0 +1,125 @@
+"""PipelineLayer/LayerDesc segmentation + compiled 1F1B engine (reference
+`fleet/meta_parallel/parallel_layers/pp_layers.py:57,209`: LayerDesc lists,
+seg_method, shared-weight groups). Oracle = single-device loss trajectory
+(reference hybrid_parallel_pp_layer test pattern)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, ops
+from paddle_tpu.distributed import fleet
+from paddle_tpu.distributed.meta_parallel import (LayerDesc, PipelineLayer,
+                                                  SharedLayerDesc)
+
+VOCAB, D, T = 32, 16, 8
+
+
+class SimpleBlock(nn.Layer):
+    def __init__(self, d):
+        super().__init__()
+        self.ln = nn.LayerNorm(d)
+        self.fc1 = nn.Linear(d, 2 * d)
+        self.fc2 = nn.Linear(2 * d, d)
+
+    def forward(self, x):
+        from paddle_tpu.nn import functional as F
+
+        return x + self.fc2(F.relu(self.fc1(self.ln(x))))
+
+
+def _embed_fwd(layer, x):
+    return layer(x)
+
+
+def _head_fwd(layer, x):
+    # tied lm head: project with the shared embedding table
+    return ops.matmul(x, layer.weight, transpose_y=True)
+
+
+def _make_model(n_blocks, num_stages):
+    descs = [
+        SharedLayerDesc("embed", nn.Embedding, VOCAB, D,
+                        forward_func=_embed_fwd),
+        *[LayerDesc(SimpleBlock, D) for _ in range(n_blocks)],
+        LayerDesc(nn.LayerNorm, D),
+        SharedLayerDesc("embed", nn.Embedding, VOCAB, D,
+                        forward_func=_head_fwd),
+    ]
+    return PipelineLayer(descs, num_stages=num_stages)
+
+
+class TestSegmentation:
+    def test_pre_trunk_post(self):
+        m = _make_model(4, 2)
+        pre, trunk, post = m.segment_for_pipeline(2)
+        assert len(pre) == 1 and len(trunk) == 4 and len(post) == 2
+        assert all(isinstance(b, SimpleBlock) for b in trunk)
+
+    def test_leftover_blocks_fold_into_post(self):
+        # 5 blocks, pp=2: trunk trimmed to 4; the 5th block runs on the
+        # last stage with norm+head (non-uniform stage depth)
+        m = _make_model(5, 2)
+        pre, trunk, post = m.segment_for_pipeline(2)
+        assert len(trunk) == 4 and len(post) == 3
+        assert isinstance(post[0][1], SimpleBlock)
+
+    def test_seg_method_layer_filter(self):
+        descs = [LayerDesc(nn.Linear, D, D) for _ in range(4)] + \
+            [LayerDesc(SimpleBlock, D) for _ in range(2)]
+        m = PipelineLayer(descs, num_stages=2, seg_method="layer:SimpleBlock")
+        pre, trunk, post = m.segment_for_pipeline(2)
+        assert len(trunk) == 2 and all(isinstance(b, SimpleBlock)
+                                       for b in trunk)
+
+    def test_no_uniform_run_raises(self):
+        m = PipelineLayer([LayerDesc(nn.Linear, D, 2 * D),
+                           LayerDesc(nn.Linear, 2 * D, D)], num_stages=2)
+        with pytest.raises(ValueError, match="structurally-uniform"):
+            m.segment_for_pipeline(2)
+
+    def test_shared_weight_is_one_param(self):
+        m = _make_model(2, 2)
+        shared_w = m._shared["embed"].weight
+        hits = [t for t in m.state_dict().values() if t is shared_w]
+        assert len(hits) == 1  # tied table registers exactly once
+
+
+class TestPipelineLayerEngine:
+    def _run(self, pp, n_blocks=4, steps=3, seed=7):
+        paddle.seed(seed)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                                   "pp_degree": pp, "sharding_degree": 1}
+        M = max(2 * pp, 2)
+        strategy.pipeline_configs = {"accumulate_steps": M}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        model = _make_model(n_blocks, pp)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+        engine = fleet.HybridParallelEngine(model, opt, hcg, strategy)
+        rng = np.random.default_rng(0)
+        B = 2 * M
+        toks = rng.integers(0, VOCAB, (B, T)).astype(np.int64)
+        labels = np.roll(toks, -1, 1)
+        return [float(engine.train_batch([toks, labels]))
+                for _ in range(steps)]
+
+    def test_trains_at_pp2(self):
+        losses = self._run(pp=2)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_pp2_matches_single_device(self):
+        # distinct head/tail stages (embed | blocks | norm+tied-head) at
+        # pp=2 must track the pp=1 oracle; step 2+ agreement additionally
+        # proves the tied-embedding grad was psum'd across stages (a
+        # missing shared-weight allreduce diverges after the 1st update)
+        l1 = self._run(pp=1, steps=3)
+        l2 = self._run(pp=2, steps=3)
+        np.testing.assert_allclose(l1, l2, rtol=2e-2)
+
+    def test_nonuniform_stage_depth_pp2(self):
+        # 5 blocks: stage 1 runs 2 trunk slots + leftover block + head
+        losses = self._run(pp=2, n_blocks=5)
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
