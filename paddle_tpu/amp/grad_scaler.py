@@ -40,7 +40,10 @@ class AmpScaler:
         if not self._enable:
             return
         s = self._scale
-        found = False
+        # one fused all-finite reduction across every grad — a single host
+        # sync per step (reference check_finite_and_unscale op semantics;
+        # the per-param bool() this replaces was one blocking sync each)
+        found_traced = jnp.zeros((), jnp.bool_)
         for p in optimizer._parameter_list:
             if p is None or p.grad is None:
                 continue
@@ -48,9 +51,8 @@ class AmpScaler:
             unscaled = forward(lambda a: (a.astype(jnp.float32) / s),
                                (g,), name="unscale", nondiff=True)
             p.grad = Tensor(unscaled._data.astype(g._data.dtype))
-            if not bool(jnp.isfinite(unscaled._data).all()):
-                found = True
-        self._found_inf = found
+            found_traced = found_traced | ~jnp.isfinite(unscaled._data).all()
+        self._found_inf = bool(found_traced)
 
     def minimize(self, optimizer, scaled_loss):
         scaled_loss.backward()

@@ -68,6 +68,14 @@ def unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 
 
+def note(name):
+    """Record an op invocation in the coverage sink without dispatching —
+    for creation-style ops that construct Tensors directly (zeros, arange,
+    randint, ...) and so never pass through forward()."""
+    if _coverage_sink is not None:
+        _coverage_sink.add(name)
+
+
 @functools.lru_cache(maxsize=8192)
 def _jitted(fn, attr_items):
     attrs = dict(attr_items)

@@ -29,6 +29,7 @@ import functools
 import numpy as np
 import jax
 import jax.numpy as jnp
+from ..core.dispatch import note as _note
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.dispatch import forward
@@ -270,6 +271,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """Gather each rank's shard; eager SPMD form: the input's leading dim is
     sharded over the group, output list holds each shard's copy."""
+    _note('all_gather')
     group = group or _default_group()
     if group.nranks == 1:
         tensor_list.append(tensor.clone())
@@ -281,6 +283,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
+    _note('broadcast')
     group = group or _default_group()
     if group.nranks == 1:
         return _Task([tensor._data])

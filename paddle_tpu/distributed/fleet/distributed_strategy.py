@@ -21,7 +21,6 @@ _INERT_TOGGLES = {
     "fp16_allreduce": "grads already reduce in the compute dtype (bf16)",
     "lars": "pass a LARS-wrapped optimizer explicitly",
     "lamb": "use paddle_tpu.optimizer.Lamb as the inner optimizer",
-    "gradient_merge": "use pipeline_configs['accumulate_steps']",
     "a_sync": "async PS mode is out of scope (see distributed/ps)",
     "heter_ccl_mode": "heterogeneous collectives are not supported",
 }

@@ -101,6 +101,7 @@ class HybridParallelEngine:
         # ZeRO offload: optimizer states + master update on host
         # (set by sharding.group_sharded_parallel(offload=True))
         self._offload = bool(getattr(optimizer, "_sharding_offload", False))
+        self._scaler = None  # set at first train_batch(scaler=...)
         self._built = False
 
     # ------------------------------------------------------------------ build
@@ -264,9 +265,12 @@ class HybridParallelEngine:
             run_block = jax.checkpoint(run_block)
         return run_block, block_tensors, saved_blk
 
-    def _forward_loss(self, params, tokens, labels):
-        """Pure loss over (params dict, batch). Tape disabled: jax.grad is
-        the differentiator (the tape can't cross lax.scan boundaries)."""
+    def _forward_loss(self, params, tokens, labels, scale=None):
+        """Pure loss over (params dict, batch), optionally multiplied by the
+        GradScaler loss scale (so jax.grad produces scaled grads — the
+        reference scales the loss before backward for the same reason).
+        Tape disabled: jax.grad is the differentiator (the tape can't cross
+        lax.scan boundaries)."""
         n_stack = len(self.block_keys)
         stack_arrays = {k: params[i] for i, k in enumerate(self.block_keys)}
         other_arrays = params[n_stack:]
@@ -286,6 +290,10 @@ class HybridParallelEngine:
 
                 xa, _ = jax.lax.scan(body, xa, stack_arrays)
                 loss = self._head_loss(xa, labels)
+                if scale is not None:
+                    # differentiate the scaled loss, report the unscaled one
+                    # (an overflowed scaled loss must not poison the metric)
+                    return loss * scale, loss
             return loss
         finally:
             self._bind(self.other_tensors, saved)
@@ -323,7 +331,7 @@ class HybridParallelEngine:
         return -ll.mean()
 
     # --------------------------------------------------------------- pipeline
-    def _pipeline_loss_and_grads(self, params, tokens, labels):
+    def _pipeline_loss_and_grads(self, params, tokens, labels, scale=None):
         """1F1B pipeline in one shard_map(axis_names={'pp'}) region, returning
         (loss, grads-matching-params) directly — forward, per-microbatch loss
         and hand-scheduled backward all inside.
@@ -385,7 +393,7 @@ class HybridParallelEngine:
             out, _ = jax.lax.scan(body, x, stk)
             return out
 
-        def stage_fn(tok_all, lab_all, local_stack, other):
+        def stage_fn(tok_all, lab_all, local_stack, other, scale_arr):
             # tok/lab: [M, mb, T] replicated over pp (tokens are cheap —
             # activations are never replicated); local_stack leading dim =
             # n_layers/pp (this stage's slice); other replicated over pp.
@@ -436,9 +444,15 @@ class HybridParallelEngine:
                 # Head fwd+bwd (the vocab matmul): the last stage seeds
                 # backward from the loss, upstream stages from the received
                 # cotangent (their head output gets cotangent 0).
-                loss_b, (d_oth_h, d_act_h) = jax.value_and_grad(
-                    lambda oth, a: head_fn(oth, a, lab_all[bic]),
-                    argnums=(0, 1))(other, act_b)
+                # scale_arr multiplies the per-microbatch loss, so the
+                # backward seeds (and thus every grad) are loss-scaled; the
+                # aux output keeps the UNSCALED loss for reporting
+                def scaled_head(oth, a):
+                    l = head_fn(oth, a, lab_all[bic])
+                    return l * scale_arr, l
+
+                (_, loss_b), (d_oth_h, d_act_h) = jax.value_and_grad(
+                    scaled_head, argnums=(0, 1), has_aux=True)(other, act_b)
                 ones = jnp.where(is_last, 1.0, 0.0)
                 d_oth_h = jax.tree.map(lambda g: g * ones, d_oth_h)
                 ct = jnp.where(is_last, d_act_h, recv_b)
@@ -478,15 +492,17 @@ class HybridParallelEngine:
             k: P(*(["pp"] + [None] * (self.stack_arrays[k].ndim - 1)))
             for k in self.block_keys}
         other_in = [P() for _ in other_arrays]
+        scale_arr = jnp.float32(1.0) if scale is None else \
+            jnp.asarray(scale, jnp.float32)
         try:
             with autograd._scoped(False):
                 sm = jax.shard_map(
                     stage_fn, mesh=self.mesh,
-                    in_specs=(P(), P(), stack_specs, other_in),
+                    in_specs=(P(), P(), stack_specs, other_in, P()),
                     out_specs=(P(), stack_specs, other_in),
                     axis_names={"pp"}, check_vma=False)
                 loss, d_stack, d_other = sm(tok_all, lab_all, stack_arrays,
-                                            other_arrays)
+                                            other_arrays, scale_arr)
         finally:
             self._bind(block_tensors, saved_blk)
             self._bind(self.other_tensors, saved_other)
@@ -529,17 +545,29 @@ class HybridParallelEngine:
 
     def _compile(self):
         mesh = self.mesh
+        # Donation matters on TPU (param+optimizer buffers dominate HBM);
+        # on the CPU test backend it has no perf value and XLA-CPU's
+        # transfer manager intermittently aborts the process when many
+        # donated executables coexist (observed: SIGABRT materializing a
+        # loss after long pytest sessions) — keep donation accelerator-only.
+        donate = (0, 1) if jax.devices()[0].platform != "cpu" else ()
         p_sh = [NamedSharding(mesh, s) for s in self.param_specs]
         a_sh = {an: [NamedSharding(mesh, s) for s in self.acc_specs]
                 for an in self._acc_names}
         b_sh = NamedSharding(mesh, self.batch_spec)
         scalar = NamedSharding(mesh, P())
 
-        def loss_and_grads(params, tokens, labels):
+        def loss_and_grads(params, tokens, labels, scale=None):
             if self.pp == 1:
-                return jax.value_and_grad(self._forward_loss)(
-                    params, tokens, labels)
-            return self._pipeline_loss_and_grads(params, tokens, labels)
+                if scale is None:
+                    return jax.value_and_grad(self._forward_loss)(
+                        params, tokens, labels)
+                (_, loss), grads = jax.value_and_grad(
+                    self._forward_loss, has_aux=True)(
+                    params, tokens, labels, scale)
+                return loss, grads
+            return self._pipeline_loss_and_grads(params, tokens, labels,
+                                                 scale)
 
         if self._offload:
             # Reference GroupSharded offload semantics
@@ -555,6 +583,60 @@ class HybridParallelEngine:
                 out_shardings=(scalar, p_sh))
             self._host_update = jax.jit(self._apply_updates)
             self._step = None
+        elif self._scaler is not None:
+            # GradScaler path (reference HybridParallelGradScaler,
+            # dygraph_optimizer/hybrid_parallel_optimizer.py:51 +
+            # grad_scaler.py:602): loss is scaled IN-GRAPH before backward,
+            # grads are unscaled by one fused fp32 reduction, found_inf
+            # gates the update with jnp.where — because engine state is
+            # global SPMD arrays, one nonfinite shard anywhere makes every
+            # logical rank skip (the reference needs an explicit allreduce
+            # of found_inf for this; here the check spans all shards by
+            # construction). The dynamic scale/good/bad bookkeeping runs
+            # inside the same XLA executable: ZERO host syncs per step.
+            sc = self._scaler
+            incr_n = float(sc._incr_every_n_steps)
+            decr_n = float(sc._decr_every_n_nan_or_inf)
+            incr_r, decr_r = float(sc._incr_ratio), float(sc._decr_ratio)
+            dynamic = bool(sc._dynamic)
+
+            def step(params, accs, step_count, sstate, tokens, labels):
+                scale = sstate["scale"]
+                loss, grads = loss_and_grads(params, tokens, labels, scale)
+                found = jnp.zeros((), jnp.bool_)
+                unscaled = []
+                for g in grads:
+                    u = g.astype(jnp.float32) / scale
+                    found = found | ~jnp.isfinite(u).all()
+                    unscaled.append(u.astype(g.dtype))
+                new_params, new_accs, new_count = self._apply_updates(
+                    params, accs, step_count, unscaled)
+                new_params = [jnp.where(found, p, q)
+                              for p, q in zip(params, new_params)]
+                new_accs = {an: [jnp.where(found, a, b)
+                                 for a, b in zip(accs[an], new_accs[an])]
+                            for an in self._acc_names}
+                new_count = jnp.where(found, step_count, new_count)
+                bad = jnp.where(found, sstate["bad"] + 1, 0.0)
+                good = jnp.where(found, 0.0, sstate["good"] + 1)
+                if dynamic:
+                    dec = found & (bad >= decr_n)
+                    inc = (~found) & (good >= incr_n)
+                    scale = jnp.where(
+                        dec, jnp.maximum(scale * decr_r, 1.0),
+                        jnp.where(inc, scale * incr_r, scale))
+                    bad = jnp.where(dec, 0.0, bad)
+                    good = jnp.where(inc, 0.0, good)
+                new_sstate = {"scale": scale, "good": good, "bad": bad}
+                return (loss, new_params, new_accs, new_count, new_sstate,
+                        found)
+
+            s_sh = {"scale": scalar, "good": scalar, "bad": scalar}
+            self._step = jax.jit(
+                step,
+                in_shardings=(p_sh, a_sh, scalar, s_sh, b_sh, b_sh),
+                out_shardings=(scalar, p_sh, a_sh, scalar, s_sh, scalar),
+                donate_argnums=donate)
         else:
             def step(params, accs, step_count, tokens, labels):
                 loss, grads = loss_and_grads(params, tokens, labels)
@@ -566,13 +648,29 @@ class HybridParallelEngine:
                 step,
                 in_shardings=(p_sh, a_sh, scalar, b_sh, b_sh),
                 out_shardings=(scalar, p_sh, a_sh, scalar),
-                donate_argnums=(0, 1))
+                donate_argnums=donate)
 
     # -------------------------------------------------------------------- api
     def train_batch(self, data, optimizer=None, lr_scheduler=None,
                     scaler=None):
+        use_scaler = scaler is not None and scaler.is_enable()
         if not self._built:
+            if use_scaler:
+                if self._offload:
+                    raise NotImplementedError(
+                        "GradScaler with sharding offload is not supported: "
+                        "offload already splits the step; run bf16 instead "
+                        "(no scaling needed on TPU)")
+                self._scaler = scaler
+                self._scaler_state = {
+                    "scale": jnp.float32(scaler._scale),
+                    "good": jnp.float32(scaler._good_steps),
+                    "bad": jnp.float32(scaler._bad_steps)}
             self._build()
+        elif use_scaler != (self._scaler is not None):
+            raise RuntimeError(
+                "train_batch scaler presence changed after the step was "
+                "compiled; pass the scaler from the first call on")
         tokens, labels = data[0], data[1]
         tokens = tokens._data if isinstance(tokens, Tensor) else jnp.asarray(tokens)
         labels = labels._data if isinstance(labels, Tensor) else jnp.asarray(labels)
@@ -595,10 +693,29 @@ class HybridParallelEngine:
                 for p, s in zip(new_params, self.param_specs)]
             return Tensor(loss)
         accs = self.acc_arrays
+        if self._scaler is not None:
+            (loss, self.param_arrays, self.acc_arrays, self._step_count,
+             self._scaler_state, self._found_inf) = self._step(
+                self.param_arrays, accs, self._step_count,
+                self._scaler_state, tokens, labels)
+            return Tensor(loss)
         loss, self.param_arrays, self.acc_arrays, self._step_count = \
             self._step(self.param_arrays, accs, self._step_count, tokens,
                        labels)
         return Tensor(loss)
+
+    def sync_scaler(self):
+        """Copy the device-resident scaler state back into the GradScaler
+        object (one host sync; for checkpointing/inspection)."""
+        if self._scaler is None:
+            return None
+        st = self._scaler_state
+        self._scaler._scale = float(st["scale"])
+        self._scaler._good_steps = int(float(st["good"]))
+        self._scaler._bad_steps = int(float(st["bad"]))
+        self._scaler._found_inf = bool(self._found_inf) \
+            if hasattr(self, "_found_inf") else False
+        return self._scaler
 
     def sync_params_to_model(self):
         """Write engine state back into the Layer tensors (for save/eval)."""

@@ -1,9 +1,26 @@
-"""Launcher implementation (reference launch/main.py + controllers/)."""
+"""Launcher implementation.
+
+Reference: `python/paddle/distributed/launch/main.py` + `controllers/`
+(collective.py builds the Pod env, master.py's HTTPMaster/ETCDMaster sync
+the peer list across nodes before any trainer starts).
+
+TPU re-design: the rendezvous master is the native TCPStore
+(csrc/tcpstore) instead of an HTTP/etcd server — node 0's launcher runs
+the store server, every node publishes its IP + reserved trainer ports,
+and all launchers assemble the same ordered global endpoint list before
+spawning trainers. Trainers receive the full `PADDLE_TRAINER_*` env
+protocol plus `PADDLE_COORDINATOR`, which `parallel_env.init_parallel_env`
+feeds to `jax.distributed.initialize` — forming ONE JAX world whose global
+device set spans all hosts (the reference instead builds per-rank NCCL
+rings; here the mesh + compiled collectives span the pod).
+"""
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
+import socket
 import subprocess
 import sys
 import time
@@ -12,7 +29,8 @@ import time
 def _parse():
     p = argparse.ArgumentParser("paddle_tpu.distributed.launch")
     p.add_argument("--master", default=None,
-                   help="rank0 endpoint ip:port (HTTPMaster equivalent)")
+                   help="rendezvous endpoint ip:port on node 0 "
+                        "(TCPStore master; HTTPMaster equivalent)")
     p.add_argument("--nnodes", type=int, default=1, help="number of hosts")
     p.add_argument("--rank", type=int, default=0, help="this host's rank")
     p.add_argument("--nproc_per_node", type=int, default=1,
@@ -23,6 +41,26 @@ def _parse():
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
+
+
+def _local_ip(probe_ip=None):
+    """This host's outbound IP (UDP-connect trick; no packet is sent)."""
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((probe_ip or "8.8.8.8", 53))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def _free_port():
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
 
 
 class Pod:
@@ -69,20 +107,60 @@ class Pod:
                 p.kill()
 
 
+def _rendezvous(args):
+    """Sync the peer list across nodes (reference controllers/master.py:27
+    peer_list sync). Returns (endpoints-by-global-rank, coordinator,
+    store-or-None). The store server (node 0) must outlive the pod — it
+    doubles as the job's rendezvous for elastic/rpc."""
+    nproc = args.nproc_per_node
+    if args.nnodes <= 1:
+        ip = "127.0.0.1"
+        eps = [f"{ip}:{_free_port()}" for _ in range(nproc)]
+        coord = f"{ip}:{_free_port()}"
+        return eps, coord, None
+
+    if not args.master:
+        raise SystemExit("--master ip:port is required when --nnodes > 1")
+    m_ip, m_port = args.master.rsplit(":", 1)
+    from ..store import TCPStore
+
+    store = TCPStore(m_ip, int(m_port), is_master=(args.rank == 0),
+                     world_size=args.nnodes)
+    my_ip = _local_ip(m_ip)
+    ports = [_free_port() for _ in range(nproc)]
+    store.set(f"launch/node/{args.rank}",
+              json.dumps({"ip": my_ip, "ports": ports}).encode())
+    endpoints = []
+    node0_ip = None
+    for r in range(args.nnodes):
+        store.wait([f"launch/node/{r}"])
+        info = json.loads(store.get(f"launch/node/{r}"))
+        if r == 0:
+            node0_ip = info["ip"]
+        endpoints.extend(f"{info['ip']}:{p}" for p in info["ports"])
+    # jax.distributed coordinator: served by trainer global-rank 0 on
+    # node 0 — a distinct port from the TCPStore
+    coord = f"{node0_ip}:{int(m_port) + 1}"
+    return endpoints, coord, store
+
+
 def launch():
     args = _parse()
     pod = Pod()
+    endpoints, coordinator, store = _rendezvous(args)
+    world = args.nnodes * args.nproc_per_node
     master = args.master or "127.0.0.1:8070"
 
     for local_rank in range(args.nproc_per_node):
         rank = args.rank * args.nproc_per_node + local_rank
-        world = args.nnodes * args.nproc_per_node
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": str(world),
             "PADDLE_MASTER": master,
-            "PADDLE_CURRENT_ENDPOINT": f"127.0.0.1:{8071 + local_rank}",
+            "PADDLE_COORDINATOR": coordinator,
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
             "PADDLE_LOCAL_RANK": str(local_rank),
             "FLAGS_selected_tpus": args.devices or "",
         })
@@ -92,6 +170,7 @@ def launch():
                                          f"workerlog.{local_rank}"))
 
     rc = pod.watch()
+    del store  # keep the rendezvous server alive until the pod exits
     sys.exit(rc)
 
 

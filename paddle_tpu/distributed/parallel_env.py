@@ -33,8 +33,14 @@ def init_parallel_env():
         return ParallelEnv()
     nranks = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
     rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
-    master = os.environ.get("PADDLE_MASTER",
-                            os.environ.get("MASTER_ENDPOINT", ""))
+    # PADDLE_COORDINATOR (set by the launcher) is the jax.distributed
+    # coordination service address — distinct from PADDLE_MASTER, which is
+    # the TCPStore rendezvous. Fall back to PADDLE_MASTER for hand-rolled
+    # environments that only export one endpoint.
+    master = os.environ.get(
+        "PADDLE_COORDINATOR",
+        os.environ.get("PADDLE_MASTER",
+                       os.environ.get("MASTER_ENDPOINT", "")))
     if nranks > 1 and master:
         jax.distributed.initialize(coordinator_address=master,
                                    num_processes=nranks, process_id=rank)

@@ -30,7 +30,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["PassContext", "PassType", "PassBase", "register_pass",
-           "new_pass", "PassManager"]
+           "new_pass", "PassManager", "apply_pass_by_strategy"]
 
 
 class PassContext:
@@ -224,7 +224,33 @@ class AutoParallelFP16Pass(_AmpPassBase):
 
 @register_pass("auto_parallel_amp")
 class AutoParallelAMPPass(_AmpPassBase):
+    """O1 (default): whitelist ops run in low precision (record rewrite,
+    base class). O2 (attr level='O2'): PURE low-precision program — the
+    Executor binds fp16/bf16 casts of every float param and feed while the
+    Scope keeps fp32 MASTER weights that the optimizer updates, with
+    in-graph dynamic loss scaling for fp16 (reference static amp
+    meta-optimizer: fleet/meta_optimizers/amp_optimizer.py +
+    static/amp/fp16_utils.py cast_model_to_fp16 + master-weight pass).
+    Attrs: level, dtype ('bfloat16'|'float16'), init_loss_scaling,
+    use_dynamic_loss_scaling."""
+
     _dtype = jnp.bfloat16  # bf16 is the TPU AMP dtype
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        if str(self.get_attr("level", "O1")).upper() != "O2":
+            return super()._apply_single_impl(main_program, startup_program,
+                                              context)
+        dtype = str(self.get_attr("dtype", "bfloat16"))
+        if dtype not in ("bfloat16", "float16"):
+            raise ValueError(f"amp O2 dtype must be bfloat16/float16, "
+                             f"got {dtype}")
+        main_program.amp_o2_dtype = dtype
+        main_program.amp_loss_scaling = float(
+            self.get_attr("init_loss_scaling",
+                          32768.0 if dtype == "float16" else 1.0))
+        main_program.amp_dynamic = bool(
+            self.get_attr("use_dynamic_loss_scaling", dtype == "float16"))
+        context.set_attr("auto_parallel_amp:o2", dtype)
 
 
 # ---------------------------------------------------------------- recompute
@@ -279,6 +305,66 @@ class AutoParallelGradientMergePass(PassBase):
 
     def _type(self):
         return PassType.CALC_OPT
+
+
+# ------------------------------------------------------------------ sharding
+@register_pass("auto_parallel_sharding")
+class AutoParallelShardingPass(PassBase):
+    """Static ZeRO: batch runs data-parallel over a 'sharding' mesh axis
+    and every optimizer-state array is sharded along its first divisible
+    dimension — the Executor compiles the program with those shardings and
+    XLA inserts the grad reduce + state reshards (reference
+    fleet/meta_optimizers/sharding_optimizer.py rewrites the program with
+    c_allreduce/slice ops per rank; here GSPMD owns the comm). Attr
+    `sharding_degree` (required): number of devices on the axis."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        deg = int(self.get_attr("sharding_degree", 0))
+        if deg < 2:
+            raise ValueError("auto_parallel_sharding needs "
+                             "sharding_degree >= 2")
+        main_program.sharding_degree = deg
+        context.set_attr("sharding:degree", deg)
+
+    def _type(self):
+        return PassType.PARALLEL_OPT
+
+
+def apply_pass_by_strategy(main_program, strategy, startup_program=None):
+    """Compose passes from DistributedStrategy flags, reference
+    meta-optimizer chain order (fleet.py _distributed_optimizer: amp →
+    recompute → sharding → gradient_merge)."""
+    pm_list = []
+    if getattr(strategy, "amp", False):
+        cfg = dict(getattr(strategy, "amp_configs", {}) or {})
+        attrs = {}
+        if cfg.get("use_pure_fp16") or cfg.get("use_pure_bf16") or \
+                cfg.get("level", "").upper() == "O2":
+            attrs["level"] = "O2"
+            attrs["dtype"] = "float16" if cfg.get("use_pure_fp16") \
+                else "bfloat16"
+            if "init_loss_scaling" in cfg:
+                attrs["init_loss_scaling"] = cfg["init_loss_scaling"]
+            if "use_dynamic_loss_scaling" in cfg:
+                attrs["use_dynamic_loss_scaling"] = \
+                    cfg["use_dynamic_loss_scaling"]
+        pm_list.append(new_pass("auto_parallel_amp", attrs))
+    if getattr(strategy, "recompute", False):
+        pm_list.append(new_pass("auto_parallel_recompute"))
+    if getattr(strategy, "sharding", False):
+        deg = (getattr(strategy, "sharding_configs", {}) or {}).get(
+            "sharding_degree") or strategy.hybrid_configs.get(
+            "sharding_degree", 1)
+        pm_list.append(new_pass("auto_parallel_sharding",
+                                {"sharding_degree": deg}))
+    if getattr(strategy, "gradient_merge", False):
+        cfg = getattr(strategy, "gradient_merge_configs", {}) or {}
+        pm_list.append(new_pass("auto_parallel_gradient_merge",
+                                {"k_steps": cfg.get("k_steps", 2),
+                                 "avg": cfg.get("avg", True)}))
+    pm = PassManager(pm_list)
+    pm.apply([main_program], [startup_program])
+    return pm.context
 
 
 # ------------------------------------------------------------ fuse allreduce

@@ -223,17 +223,22 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
 
     store = TCPStore(master_addr, int(master_port), is_master=(rank == 0),
                      world_size=world_size)
-    # per-job shared secret: PADDLE_RPC_TOKEN, or generated by rank 0 and
-    # distributed over the (trusted) rendezvous store
+    # per-job shared secret: PADDLE_RPC_TOKEN, or generated by rank 0.
+    # Rank 0 ALWAYS publishes its token to the rendezvous store — env vars
+    # are per-host, so a token exported only on node 0 must still reach
+    # the other ranks (they fall back to the store copy).
     env_token = os.environ.get("PADDLE_RPC_TOKEN")
-    if env_token is not None:
-        token = env_token.encode()
-    elif rank == 0:
-        import secrets
+    if rank == 0:
+        if env_token is not None:
+            token = env_token.encode()
+        else:
+            import secrets
 
-        token = secrets.token_hex(16).encode()
+            token = secrets.token_hex(16).encode()
         store.set("rpc/token", token)
-    if env_token is None:
+    elif env_token is not None:
+        token = env_token.encode()
+    else:
         store.wait(["rpc/token"])
         token = store.get("rpc/token")
     ip, port = worker_endpoint.rsplit(":", 1)

@@ -131,6 +131,10 @@ class TCPStore:
         with self._lock:
             return self._lib.tcpstore_check(self._client, k.encode())
 
+    def check(self, key):
+        """Non-blocking existence test (reference TCPStore::check)."""
+        return self._check_locked(key) == 1
+
     def num_keys(self):
         with self._lock:
             return self._lib.tcpstore_num_keys(self._client)

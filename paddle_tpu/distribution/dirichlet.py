@@ -3,6 +3,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from ..core.dispatch import note as _note
 
 from .distribution import ExponentialFamily, _as_array, _op
 
@@ -37,6 +38,7 @@ class Dirichlet(ExponentialFamily):
                    self.concentration, name="dirichlet_rsample")
 
     def sample(self, shape=()):
+        _note('dirichlet')
         return self.rsample(shape).detach()
 
     def log_prob(self, value):

@@ -27,7 +27,102 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from ...optimizer.optimizer import Optimizer
 
-__all__ = ["DGCMomentumOptimizer", "DistributedFusedLamb"]
+__all__ = ["DGCMomentumOptimizer", "DistributedFusedLamb", "ModelAverage"]
+
+
+class ModelAverage:
+    """Running parameter average (reference
+    `python/paddle/incubate/optimizer/modelaverage.py` over the
+    `average_accumulates_` op, fluid/operators/average_accumulates_op.cc):
+    accumulates sum_1/sum_2/sum_3 + counters with the reference's window
+    rules; `apply()` swaps params for their window average (eval), restore
+    puts the trained values back. The accumulate itself is one fused jnp
+    expression per param (name='average_accumulates')."""
+
+    _MAX_ACC = 16384  # reference kMaxNumAccumulates
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._params = [p for p in (parameters or []) if p is not None]
+        self._state = {
+            id(p): {"sum_1": jnp.zeros_like(p._data, jnp.float32),
+                    "sum_2": jnp.zeros_like(p._data, jnp.float32),
+                    "sum_3": jnp.zeros_like(p._data, jnp.float32)}
+            for p in self._params}
+        self.num_updates = 0
+        self.num_accumulates = 0
+        self.old_num_accumulates = 0
+        self._saved = None
+
+    def step(self):
+        from ...core.dispatch import forward
+
+        self.num_updates += 1
+        self.num_accumulates += 1
+        roll = self.num_updates % self._MAX_ACC == 0
+        window = min(self.max_w, int(self.num_updates * self.rate))
+        emit = (self.num_accumulates >= self.min_w
+                and self.num_accumulates >= window)
+        for p in self._params:
+            st = self._state[id(p)]
+
+            def f(param, s1, s2, s3):
+                s1 = s1 + param.astype(jnp.float32)
+                if roll:
+                    s2, s1 = s2 + s1, jnp.zeros_like(s1)
+                if emit:
+                    s3, s1, s2 = s1 + s2, jnp.zeros_like(s1), \
+                        jnp.zeros_like(s2)
+                return s1, s2, s3
+
+            s1, s2, s3 = forward(f, (p, Tensor(st["sum_1"]),
+                                     Tensor(st["sum_2"]),
+                                     Tensor(st["sum_3"])),
+                                 name="average_accumulates", nondiff=True)
+            st["sum_1"], st["sum_2"], st["sum_3"] = \
+                s1._data, s2._data, s3._data
+        if emit:
+            self.old_num_accumulates = self.num_accumulates
+            self.num_accumulates = 0
+
+    def clear_grad(self):
+        pass
+
+    def minimize(self, loss, startup_program=None):
+        self.step()
+
+    def _average(self, p):
+        st = self._state[id(p)]
+        denom = max(self.num_accumulates + self.old_num_accumulates, 1)
+        total = st["sum_1"] + st["sum_2"] + st["sum_3"]
+        return (total / denom).astype(p._data.dtype)
+
+    def apply(self, executor=None, need_restore=True):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._saved = {id(p): p._data for p in self._params}
+            for p in self._params:
+                p._data = self._average(p)
+            try:
+                yield
+            finally:
+                if need_restore:
+                    self.restore()
+
+        return ctx()
+
+    def restore(self, executor=None):
+        if self._saved is None:
+            return
+        for p in self._params:
+            p._data = self._saved[id(p)]
+        self._saved = None
 
 
 class DGCMomentumOptimizer(Optimizer):

@@ -210,7 +210,12 @@ class TrainStep:
                 for o, s in zip(opts, saved_steps):
                     o._opt_step = s
 
-        donate = (0, 1) if self._donate else ()
+        # donation is accelerator-only: XLA-CPU's transfer manager can
+        # abort the process when many donated executables coexist (see
+        # hybrid_engine._compile note); CPU runs are tests, where the
+        # memory win is irrelevant
+        donate = (0, 1) if self._donate and \
+            jax.devices()[0].platform != "cpu" else ()
         self._compiled = jax.jit(pure, donate_argnums=donate)
 
     def __call__(self, *args):

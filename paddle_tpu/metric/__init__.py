@@ -2,6 +2,7 @@
 from __future__ import annotations
 
 import numpy as np
+from ..core.dispatch import note as _note
 
 from ..core.tensor import Tensor
 
@@ -122,6 +123,7 @@ class Auc(Metric):
         self._stat_neg = np.zeros(self.num_thresholds + 1)
 
     def update(self, preds, labels):
+        _note('auc')
         preds = _np(preds)
         if preds.ndim == 2:
             preds = preds[:, 1]

@@ -12,6 +12,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+from ...core.dispatch import note as _note
 import numpy as np
 
 from ...core.dispatch import forward as _fwd
@@ -184,6 +185,7 @@ class RNN(Layer):
         self.time_major = time_major
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
+        _note('rnn')
         outputs = []
         T = inputs.shape[0 if self.time_major else 1]
         steps = range(T - 1, -1, -1) if self.is_reverse else range(T)

@@ -12,6 +12,7 @@ import jax.numpy as jnp
 
 from ..core import dtype as dtypes
 from ..core import random as prandom
+from ..core import dispatch
 from ..core.dispatch import forward, unwrap
 from ..core.place import jax_device
 from ..core.tensor import Tensor
@@ -45,14 +46,17 @@ def _device_const(arr):
 
 
 def zeros(shape, dtype=None, name=None):
+    dispatch.note('zeros')
     return Tensor(_device_const(jnp.zeros(_shape(shape), dtypes.convert_dtype(dtype))))
 
 
 def ones(shape, dtype=None, name=None):
+    dispatch.note('ones')
     return Tensor(_device_const(jnp.ones(_shape(shape), dtypes.convert_dtype(dtype))))
 
 
 def full(shape, fill_value, dtype=None, name=None):
+    dispatch.note('full')
     if isinstance(fill_value, Tensor):
         fill_value = fill_value.item()
     return Tensor(_device_const(
@@ -78,15 +82,18 @@ def full_like(x, fill_value, dtype=None, name=None):
 
 
 def empty(shape, dtype=None, name=None):
+    dispatch.note('empty')
     # XLA has no uninitialized alloc; zeros is the honest TPU equivalent.
     return zeros(shape, dtype)
 
 
 def empty_like(x, dtype=None, name=None):
+    dispatch.note('empty_like')
     return zeros_like(x, dtype)
 
 
 def arange(start=0, end=None, step=1, dtype=None, name=None):
+    dispatch.note('arange')
     if end is None:
         start, end = 0, start
     start = start.item() if isinstance(start, Tensor) else start
@@ -101,6 +108,7 @@ def arange(start=0, end=None, step=1, dtype=None, name=None):
 
 
 def linspace(start, stop, num, dtype=None, name=None):
+    dispatch.note('linspace')
     start = start.item() if isinstance(start, Tensor) else start
     stop = stop.item() if isinstance(stop, Tensor) else stop
     num = int(num.item() if isinstance(num, Tensor) else num)
@@ -109,12 +117,14 @@ def linspace(start, stop, num, dtype=None, name=None):
 
 
 def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    dispatch.note('logspace')
     return Tensor(_device_const(jnp.logspace(
         float(start), float(stop), int(num), base=float(base),
         dtype=dtypes.convert_dtype(dtype))))
 
 
 def eye(num_rows, num_columns=None, dtype=None, name=None):
+    dispatch.note('eye')
     return Tensor(_device_const(jnp.eye(
         int(num_rows), None if num_columns is None else int(num_columns),
         dtype=dtypes.convert_dtype(dtype))))
@@ -175,12 +185,14 @@ def one_hot(x, num_classes, name=None):
 
 
 def tril_indices(row, col=None, offset=0, dtype="int64"):
+    dispatch.note('tril_indices')
     col = row if col is None else col
     r, c = np.tril_indices(row, offset, col)
     return Tensor(np.stack([r, c]).astype(dtypes.convert_dtype(dtype)))
 
 
 def triu_indices(row, col=None, offset=0, dtype="int64"):
+    dispatch.note('triu_indices')
     col = row if col is None else col
     r, c = np.triu_indices(row, offset, col)
     return Tensor(np.stack([r, c]).astype(dtypes.convert_dtype(dtype)))

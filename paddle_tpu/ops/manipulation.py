@@ -15,6 +15,7 @@ import jax.numpy as jnp
 
 from ..core import dtype as dtypes
 from ..core.dispatch import forward, unwrap
+from ..core.dispatch import note as _note
 from ..core.tensor import Tensor
 
 __all__ = [
@@ -158,6 +159,7 @@ def expand(x, shape, name=None):
 
 
 def expand_as(x, y, name=None):
+    _note('expand_as')
     return expand(x, y.shape)
 
 
@@ -266,6 +268,7 @@ def take(x, index, mode="raise", name=None):
 
 
 def masked_select(x, mask, name=None):
+    _note('masked_select')
     # dynamic output shape: eager-only (reference kernel masked_select_kernel)
     return Tensor(np.asarray(unwrap(x))[np.asarray(unwrap(mask)).astype(bool)])
 
@@ -296,6 +299,7 @@ def where(condition, x=None, y=None, name=None):
 
 
 def nonzero(x, as_tuple=False, name=None):
+    _note('nonzero')
     idx = np.nonzero(np.asarray(unwrap(x)))
     if as_tuple:
         return tuple(Tensor(i.astype(np.int64)) for i in idx)
@@ -383,6 +387,7 @@ def unbind(input, axis=0, name=None):
 
 
 def unstack(x, axis=0, num=None, name=None):
+    _note('unstack')
     return unbind(x, axis)
 
 
@@ -397,6 +402,7 @@ def repeat_interleave(x, repeats, axis=None, name=None):
 
 def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, dtype="int64", name=None):
+    _note('unique')
     # dynamic shape → eager-only, like reference unique_kernel
     arr = np.asarray(unwrap(x))
     out = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
@@ -408,6 +414,7 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
 
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
                        dtype="int64", name=None):
+    _note('unique_consecutive')
     arr = np.asarray(unwrap(x)).reshape(-1) if axis is None else np.asarray(unwrap(x))
     keep = np.ones(arr.shape[0], bool)
     keep[1:] = np.any(arr[1:] != arr[:-1], axis=tuple(range(1, arr.ndim))) \
@@ -560,10 +567,12 @@ def tolist(x):
 
 
 def numel(x, name=None):
+    _note('numel')
     return Tensor(np.asarray(x.size, dtype=np.int64))
 
 
 def shape(x):
+    _note('shape')
     return Tensor(np.asarray(x._data.shape, dtype=np.int32))
 
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ..core import dtype as dtypes
 from ..core import random as prandom
 from ..core.dispatch import forward
+from ..core.dispatch import note as _note
 from ..core.tensor import Tensor
 
 __all__ = [
@@ -340,6 +341,14 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 
 
 # =========================== normalization ===================================
+# Stats accumulate in fp32 for low-precision inputs (the reference's CUDA
+# norm kernels do the same; on fp16 the BACKWARD of rsqrt(var+eps) produces
+# (var+eps)^-1.5 ~ 3e7 which overflows fp16's 65504 max into inf -> NaN).
+
+def _stats_cast(a):
+    if a.dtype in (jnp.float16, jnp.bfloat16):
+        return a.astype(jnp.float32)
+    return a
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
@@ -355,26 +364,30 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     red_axes = tuple(i for i in range(x._data.ndim) if i != ch_axis)
 
     def f_train(a, rm, rv, *wb):
-        mean = a.mean(axis=red_axes)
-        var = a.var(axis=red_axes)
+        af = _stats_cast(a)
+        mean = af.mean(axis=red_axes)
+        var = af.var(axis=red_axes)
         shape = [1] * a.ndim
         shape[ch_axis] = -1
         inv = jax.lax.rsqrt(var + epsilon)
-        out = (a - mean.reshape(shape)) * inv.reshape(shape)
+        out = ((af - mean.reshape(shape)) *
+               inv.reshape(shape)).astype(a.dtype)
         if wb:
             w, b = wb
             out = out * w.reshape(shape) + b.reshape(shape)
         n = a.size // a.shape[ch_axis]
         unbiased = var * n / builtins.max(n - 1, 1)
-        new_rm = momentum * rm + (1 - momentum) * mean
-        new_rv = momentum * rv + (1 - momentum) * unbiased
+        new_rm = momentum * rm + (1 - momentum) * mean.astype(rm.dtype)
+        new_rv = momentum * rv + (1 - momentum) * unbiased.astype(rv.dtype)
         return out, new_rm, new_rv
 
     def f_eval(a, rm, rv, *wb):
+        af = _stats_cast(a)
         shape = [1] * a.ndim
         shape[ch_axis] = -1
-        inv = jax.lax.rsqrt(rv + epsilon)
-        out = (a - rm.reshape(shape)) * inv.reshape(shape)
+        inv = jax.lax.rsqrt(_stats_cast(rv) + epsilon)
+        out = ((af - _stats_cast(rm).reshape(shape)) *
+               inv.reshape(shape)).astype(a.dtype)
         if wb:
             w, b = wb
             out = out * w.reshape(shape) + b.reshape(shape)
@@ -401,9 +414,10 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
 
     def f(a, *wb):
         axes = tuple(range(a.ndim - n, a.ndim))
-        mean = a.mean(axis=axes, keepdims=True)
-        var = a.var(axis=axes, keepdims=True)
-        out = (a - mean) * jax.lax.rsqrt(var + epsilon)
+        af = _stats_cast(a)
+        mean = af.mean(axis=axes, keepdims=True)
+        var = af.var(axis=axes, keepdims=True)
+        out = ((af - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
         if wb:
             w = wb[0]
             out = out * w
@@ -433,9 +447,10 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
                   data_format="NCHW", name=None):
     def f(a, *wb):
         axes = tuple(range(2, a.ndim))
-        mean = a.mean(axis=axes, keepdims=True)
-        var = a.var(axis=axes, keepdims=True)
-        out = (a - mean) * jax.lax.rsqrt(var + eps)
+        af = _stats_cast(a)
+        mean = af.mean(axis=axes, keepdims=True)
+        var = af.var(axis=axes, keepdims=True)
+        out = ((af - mean) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
         if wb:
             shape = [1, -1] + [1] * (a.ndim - 2)
             out = out * wb[0].reshape(shape)
@@ -455,11 +470,12 @@ def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
     def f(a, *wb):
         N, C = a.shape[0], a.shape[1]
         rest = a.shape[2:]
-        g = a.reshape((N, num_groups, C // num_groups) + rest)
+        g = _stats_cast(a).reshape((N, num_groups, C // num_groups) + rest)
         axes = tuple(range(2, g.ndim))
         mean = g.mean(axis=axes, keepdims=True)
         var = g.var(axis=axes, keepdims=True)
-        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(a.shape)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)
+               ).reshape(a.shape).astype(a.dtype)
         if wb:
             shape = [1, -1] + [1] * (a.ndim - 2)
             out = out * wb[0].reshape(shape)
@@ -1244,6 +1260,7 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     phi/kernels/gpu/class_center_sample_kernel.cu): keep all positive
     classes, pad with sampled negatives to num_samples, return the labels
     remapped into the sampled index space."""
+    _note('class_center_sample')
     lab = np.asarray(jax.device_get(
         label._data if hasattr(label, "_data") else label)).reshape(-1)
     pos = np.unique(lab)

@@ -1,5 +1,6 @@
 """paddle_tpu.optimizer (reference `python/paddle/optimizer/`)."""
 from . import lr  # noqa: F401
-from .adam import Adam, AdamW, Adamax, Adagrad, Lamb, RMSProp  # noqa: F401
+from .adam import (Adam, AdamW, Adamax, Adadelta, Adagrad,  # noqa: F401
+                   Lamb, RMSProp)
 from .optimizer import SGD, Momentum, Optimizer  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401

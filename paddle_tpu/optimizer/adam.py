@@ -172,6 +172,39 @@ class Adagrad(Optimizer):
         p._data, acc._data = outs[0]._data, outs[1]._data
 
 
+class Adadelta(Optimizer):
+    """Reference `python/paddle/optimizer/adadelta.py` over the
+    `adadelta_` kernel (phi adadelta_kernel): accumulates squared grads
+    and squared updates; the effective step is RMS(update)/RMS(grad)."""
+
+    _STATIC_ACCS = ["avg_squared_grad", "avg_squared_update"]
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._eps, self._rho = epsilon, rho
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        eps, rho = self._eps, self._rho
+        ag = self._acc("avg_squared_grad", p, dtype=jnp.float32)
+        au = self._acc("avg_squared_update", p, dtype=jnp.float32)
+
+        def f(w, gg, agg, auu):
+            gf = gg.astype(jnp.float32)
+            agg = rho * agg + (1 - rho) * jnp.square(gf)
+            upd = jnp.sqrt(auu + eps) / jnp.sqrt(agg + eps) * gf
+            auu = rho * auu + (1 - rho) * jnp.square(upd)
+            new = w.astype(jnp.float32) - lr * upd
+            return new.astype(w.dtype), agg, auu
+
+        outs = forward(f, (p, g, ag, au), name="adadelta", nondiff=True)
+        p._data, ag._data, au._data = (outs[0]._data, outs[1]._data,
+                                       outs[2]._data)
+
+
 class RMSProp(Optimizer):
     _STATIC_ACCS = ["mean_square", "mean_grad", "velocity"]
 

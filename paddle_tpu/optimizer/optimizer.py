@@ -164,6 +164,15 @@ class Optimizer:
                     state[key] = self._accumulators[acc][id(pt)]._data
         finally:
             self._opt_step = prev_step
+            # the per-trace accumulator Tensors wrap TRACED arrays keyed by
+            # transient ids: drop them so the optimizer object holds no
+            # tracer after the trace (they'd leak memory and poison
+            # static.save's program serialization)
+            for acc in self._static_acc_names():
+                store = self._accumulators.get(acc)
+                if store is not None:
+                    for _, pt in pairs:
+                        store.pop(id(pt), None)
 
     def _ensure_accumulators(self):
         """Materialize all state now (used by ZeRO sharding wrappers)."""

@@ -10,7 +10,10 @@ into the surrounding matmul; no custom kernels. Observer state (absmax
 moving averages) lives as layer buffers so QAT works under jit.TrainStep.
 """
 from .config import QuantConfig, SingleLayerConfig  # noqa: F401
-from .observers import AbsmaxObserver, AbsmaxObserverLayer  # noqa: F401
+from .observers import (AbsmaxObserver, AbsmaxObserverLayer,  # noqa: F401
+                        HistObserver, HistObserverLayer,
+                        PerChannelAbsmaxObserver,
+                        PerChannelAbsmaxObserverLayer)
 from .quanters import (  # noqa: F401
     FakeQuanterWithAbsMaxObserver, FakeQuanterWithAbsMaxObserverLayer,
     quant_dequant,
@@ -20,6 +23,8 @@ from .ptq import PTQ  # noqa: F401
 from .wrapper import QuantedLayer  # noqa: F401
 
 __all__ = ["QuantConfig", "SingleLayerConfig", "AbsmaxObserver",
-           "AbsmaxObserverLayer", "FakeQuanterWithAbsMaxObserver",
+           "AbsmaxObserverLayer", "PerChannelAbsmaxObserver",
+           "PerChannelAbsmaxObserverLayer", "HistObserver",
+           "HistObserverLayer", "FakeQuanterWithAbsMaxObserver",
            "FakeQuanterWithAbsMaxObserverLayer", "quant_dequant", "QAT",
            "PTQ", "QuantedLayer"]

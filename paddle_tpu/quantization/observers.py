@@ -47,3 +47,120 @@ class AbsmaxObserverLayer(Layer):
 class AbsmaxObserver(_Factory):
     def _layer_cls(self):
         return AbsmaxObserverLayer
+
+
+class PerChannelAbsmaxObserverLayer(Layer):
+    """Channel-wise absmax (reference
+    `quantization/observers/abs_max_weight.py` AbsMaxChannelWiseWeight
+    Observer over fake_channel_wise_quantize ops): one threshold per
+    channel along quant_axis — the weight-quant default for conv/linear
+    (conv weight [O,I,kh,kw] → axis 0; linear weight [in,out] → axis 1)."""
+
+    def __init__(self, layer=None, quant_bits=8, quant_axis=None):
+        super().__init__()
+        self._quant_bits = int(quant_bits)
+        if quant_axis is None:
+            from ..nn.layer.common import Linear
+
+            quant_axis = 1 if isinstance(layer, Linear) else 0
+        self._axis = int(quant_axis)
+        self._max = Tensor(jnp.zeros((1,), jnp.float32), stop_gradient=True)
+        self.register_buffer("abs_max_val", self._max)
+
+    def forward(self, x):
+        axis = self._axis
+
+        def f(a):
+            red = tuple(i for i in range(a.ndim) if i != axis)
+            return jnp.max(jnp.abs(a), axis=red).astype(jnp.float32)
+
+        absmax = forward(f, (x,), name="channel_wise_absmax", nondiff=True)
+        cur = self._max._data
+        if cur.shape != absmax._data.shape:
+            cur = jnp.zeros_like(absmax._data)
+        self._max._data = jnp.maximum(cur, absmax._data)
+        return x
+
+    def cal_thresholds(self):
+        return self._max._data
+
+    @property
+    def scales(self):
+        return Tensor(self._max._data)
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return self._axis
+
+
+class PerChannelAbsmaxObserver(_Factory):
+    def _layer_cls(self):
+        return PerChannelAbsmaxObserverLayer
+
+
+class HistObserverLayer(Layer):
+    """Histogram-percentile observer (reference
+    `quantization/observers/hist.py` PercentHistObserver): accumulates a
+    |x| histogram across calibration batches; the threshold is the value
+    below which `percent` of the mass lies — robust to activation
+    outliers that blow up a plain absmax. Range growth re-bins by exact
+    power-of-two merging (the reference re-buckets the same way)."""
+
+    BINS = 2048
+
+    def __init__(self, layer=None, quant_bits=8, percent=0.99999,
+                 bins_count=None):
+        super().__init__()
+        self._quant_bits = int(quant_bits)
+        self._percent = float(percent)
+        self._bins = int(bins_count or self.BINS)
+        self._hist = jnp.zeros((self._bins,), jnp.float32)
+        self._hi = 0.0  # current histogram range [0, hi)
+        self._scale = Tensor(jnp.zeros((), jnp.float32),
+                             stop_gradient=True)
+        self.register_buffer("quant_scale", self._scale)
+
+    def forward(self, x):
+        def f(a):
+            return jnp.abs(a).astype(jnp.float32).reshape(-1)
+
+        flat = forward(f, (x,), name="hist_observe", nondiff=True)._data
+        batch_max = float(jnp.max(flat)) if flat.size else 0.0
+        if self._hi == 0.0:
+            self._hi = max(batch_max, 1e-9)
+        while batch_max > self._hi:
+            # double the range; merge neighbouring bin pairs exactly
+            self._hist = self._hist.reshape(self._bins // 2, 2).sum(1)
+            self._hist = jnp.concatenate(
+                [self._hist, jnp.zeros((self._bins // 2,), jnp.float32)])
+            self._hi *= 2.0
+        h, _ = jnp.histogram(flat, bins=self._bins, range=(0.0, self._hi))
+        self._hist = self._hist + h.astype(jnp.float32)
+        self._scale._data = jnp.float32(self.cal_thresholds())
+        return x
+
+    def cal_thresholds(self):
+        total = float(self._hist.sum())
+        if total <= 0:
+            return 0.0
+        csum = jnp.cumsum(self._hist) / total
+        idx = int(jnp.searchsorted(csum, self._percent))
+        idx = min(idx, self._bins - 1)
+        return (idx + 1) * self._hi / self._bins
+
+    @property
+    def scales(self):
+        return Tensor(jnp.float32(self.cal_thresholds()))
+
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+
+class HistObserver(_Factory):
+    def _layer_cls(self):
+        return HistObserverLayer

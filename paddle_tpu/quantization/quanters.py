@@ -10,15 +10,22 @@ from ..core.tensor import Tensor
 from ..nn.layer.layers import Layer
 
 
-def quant_dequant(x, scale, bits=8):
+def quant_dequant(x, scale, bits=8, axis=None):
     """Simulated quantization with straight-through gradients.
 
     q = round(clip(x, ±scale) / scale * qmax) * scale / qmax; the backward
-    pass sees identity inside the clip range (STE)."""
+    pass sees identity inside the clip range (STE). `scale` is a scalar
+    (per-tensor) or, with `axis`, a vector of per-channel thresholds
+    broadcast along that axis (reference
+    fake_channel_wise_quantize_dequantize_abs_max op)."""
     qmax = float(2 ** (bits - 1) - 1)
 
     def f(a, s):
         s = jnp.maximum(s, 1e-9)
+        if axis is not None and s.ndim == 1:
+            shape = [1] * a.ndim
+            shape[axis] = -1
+            s = s.reshape(shape)
         clipped = jnp.clip(a, -s, s)
         q = jnp.round(clipped / s * qmax) * (s / qmax)
         return a + jax.lax.stop_gradient(q - a)

@@ -52,21 +52,27 @@ class ConvertedQuantedLayer(Layer):
         self.source = quanted.source
         wq = quanted.weight_quanter
         aq = quanted.activation_quanter
-        self._w_scale = float(wq.scales._data) if wq is not None else None
+        # scales may be scalars (per-tensor) or vectors (per-channel,
+        # paired with the observer's quant_axis)
+        self._w_scale = jnp.asarray(wq.scales._data, jnp.float32) \
+            if wq is not None else None
+        self._w_axis = wq.quant_axis() if wq is not None and \
+            hasattr(wq, "quant_axis") else None
+        if self._w_axis is not None and self._w_axis < 0:
+            self._w_axis = None  # -1 sentinel = per-tensor
         self._w_bits = wq.bit_length() if wq is not None else 8
-        self._a_scale = float(aq.scales._data) if aq is not None else None
+        self._a_scale = jnp.asarray(aq.scales._data, jnp.float32) \
+            if aq is not None else None
         self._a_bits = aq.bit_length() if aq is not None else 8
 
     def forward(self, x, *args, **kwargs):
         if self._a_scale is not None:
-            x = quant_dequant(x, Tensor(jnp.float32(self._a_scale)),
-                              bits=self._a_bits)
+            x = quant_dequant(x, Tensor(self._a_scale), bits=self._a_bits)
         if self._w_scale is not None and hasattr(self.source, "weight"):
             w = self.source.weight
             orig = w._data
-            wq = quant_dequant(Tensor(orig),
-                               Tensor(jnp.float32(self._w_scale)),
-                               bits=self._w_bits)
+            wq = quant_dequant(Tensor(orig), Tensor(self._w_scale),
+                               bits=self._w_bits, axis=self._w_axis)
             self.source.weight._data = wq._data
             try:
                 return self.source(x, *args, **kwargs)

@@ -8,7 +8,8 @@ from .program import (Program, Variable, data, default_main_program,  # noqa: F4
                       default_startup_program, global_scope, name_scope,
                       program_guard, scope_guard, Scope)
 from . import nn  # noqa: F401
-from .io import save_inference_model, load_inference_model  # noqa: F401
+from .io import (save_inference_model, load_inference_model,  # noqa: F401
+                 save, load, load_program)
 
 
 class InputSpec:

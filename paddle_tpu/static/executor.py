@@ -47,11 +47,26 @@ class _CompiledStep:
         self.scope = scope
         self.param_vars = [v for v, _ in program.params]
         self.has_opt = bool(program.minimize_reqs)
+        # AMP O2 (auto_parallel_amp level=O2 pass): compute in low
+        # precision against fp32 master weights kept in the Scope
+        self.amp_dtype = getattr(program, "amp_o2_dtype", None)
+        self.amp_low = {"bfloat16": jnp.bfloat16,
+                        "float16": jnp.float16}.get(self.amp_dtype)
+        self.amp_dynamic = bool(getattr(program, "amp_dynamic", False))
+        if self.amp_dtype and self.has_opt and \
+                len(program.minimize_reqs) != 1:
+            raise ValueError("amp O2 supports exactly one optimizer")
+        if self.amp_dtype and getattr(program, "grad_merge_k", 1) > 1:
+            raise ValueError("amp O2 + gradient merge is not supported")
         # optimizer state lives in the scope under reserved names
         self.opt_state_names: list[str] = []
         if self.has_opt:
             self._init_opt_state()
-        self._jitted = jax.jit(self._step)
+        # sharding pass: compile the step over a 'sharding' mesh —
+        # built lazily at first run (shardings depend on feed shapes)
+        self.sharding_degree = int(getattr(program, "sharding_degree", 1))
+        self._jitted = None if self.sharding_degree > 1 \
+            else jax.jit(self._step)
 
     # ---------------------------------------------------------------- state
     def _init_opt_state(self):
@@ -72,6 +87,13 @@ class _CompiledStep:
                     init = self.scope.vars.get(pv.name)
                     self.scope.set(name, jnp.zeros(init.shape, jnp.float32))
                 self.opt_state_names.append(name)
+        if self.amp_dtype:
+            scale0 = float(getattr(self.program, "amp_loss_scaling", 1.0))
+            for nm, v in (("@amp@scale", scale0), ("@amp@good", 0.0),
+                          ("@amp@bad", 0.0)):
+                if nm not in self.scope.vars:
+                    self.scope.set(nm, jnp.float32(v))
+                self.opt_state_names.append(nm)
         for oi, (opt, loss_var) in enumerate(self.program.minimize_reqs):
             tname = f"@opt{oi}@step"
             if tname not in self.scope.vars:
@@ -107,14 +129,25 @@ class _CompiledStep:
                 env[v.vid] = o
 
     def _step(self, feed_arrays, param_arrays, opt_arrays):
-        # bind params as trainable leaf tensors
+        # bind params as trainable leaf tensors; under amp O2 the compute
+        # graph sees low-precision casts while `masters` keeps the fp32
+        # arrays the optimizer updates (reference master-weight semantics)
         env = {}
         param_tensors = {}
+        masters = {}
+        low = self.amp_low
         for pv, arr in zip(self.param_vars, param_arrays):
-            t = Tensor(arr, stop_gradient=pv.stop_gradient)
+            carr = arr
+            if low is not None and jnp.issubdtype(arr.dtype, jnp.floating):
+                masters[pv.name] = arr
+                carr = arr.astype(low)
+            t = Tensor(carr, stop_gradient=pv.stop_gradient)
             env[pv.vid] = t
             param_tensors[pv.name] = t
         for name, arr in zip(self.feed_names, feed_arrays):
+            if low is not None and jnp.issubdtype(jnp.asarray(arr).dtype,
+                                                  jnp.floating):
+                arr = jnp.asarray(arr).astype(low)
             env[self.program.feed_vars[name].vid] = Tensor(arr)
 
         train = self.has_opt
@@ -123,7 +156,9 @@ class _CompiledStep:
 
         new_opt = dict(zip(self.opt_state_names, opt_arrays))
         gm_k = getattr(self.program, "grad_merge_k", 1)
-        if train:
+        if train and low is not None:
+            self._amp_o2_apply(env, param_tensors, masters, new_opt)
+        elif train:
             for oi, (opt, loss_var) in enumerate(self.program.minimize_reqs):
                 loss_t = env[loss_var.vid]
                 loss_t.backward()
@@ -141,7 +176,66 @@ class _CompiledStep:
                     new_opt)
 
         fetches = tuple(env[v.vid]._data for v in self.fetch_vars)
+        if low is not None:
+            # scope keeps fp32 masters; low-precision copies are transient
+            for name, m in masters.items():
+                param_tensors[name] = Tensor(m)
         return self._finish_step(env, param_tensors, new_opt, fetches)
+
+    def _amp_o2_apply(self, env, param_tensors, masters, new_opt):
+        """Pure-low-precision backward + fp32 master update with in-graph
+        (dynamic) loss scaling — one XLA executable, zero host syncs
+        (reference amp_optimizer + check_finite_and_unscale +
+        update_loss_scaling op chain)."""
+        oi, (opt, loss_var) = 0, self.program.minimize_reqs[0]
+        scale = new_opt["@amp@scale"]
+        loss_t = env[loss_var.vid]
+        # scale via a fresh dispatch so the tape differentiates it
+        from ..core import dispatch as _dispatch
+
+        scaled = _dispatch.forward(
+            lambda a, s: a.astype(jnp.float32) * s,
+            (loss_t, Tensor(scale)), name="scale_loss")
+        scaled.backward()
+        trainables = [pv for pv in self.param_vars if not pv.stop_gradient]
+        found = jnp.zeros((), jnp.bool_)
+        pairs = []
+        for pv in trainables:
+            ct = param_tensors[pv.name]
+            if ct.grad is None:
+                continue
+            g = ct.grad._data if isinstance(ct.grad, Tensor) else \
+                jnp.asarray(ct.grad)
+            u = g.astype(jnp.float32) / scale
+            found = found | ~jnp.isfinite(u).all()
+            mt = Tensor(masters[pv.name], stop_gradient=False)
+            mt.grad = Tensor(u)
+            pairs.append((pv, mt))
+        pre_params = {pv.name: mt._data for pv, mt in pairs}
+        opt_keys = [n for n in self.opt_state_names
+                    if n.startswith(f"@opt{oi}@")]
+        pre_state = {n: new_opt[n] for n in opt_keys}
+        step_arr = new_opt[f"@opt{oi}@step"] + jnp.where(found, 0.0, 1.0)
+        new_opt[f"@opt{oi}@step"] = step_arr
+        opt._static_apply(oi, step_arr, pairs, new_opt)
+        for pv, mt in pairs:
+            mt._data = jnp.where(found, pre_params[pv.name], mt._data)
+            masters[pv.name] = mt._data
+        for n in opt_keys:
+            new_opt[n] = jnp.where(found, pre_state[n], new_opt[n])
+        # dynamic loss-scale bookkeeping (GradScaler rule, in-graph)
+        bad = jnp.where(found, new_opt["@amp@bad"] + 1, 0.0)
+        good = jnp.where(found, 0.0, new_opt["@amp@good"] + 1)
+        if self.amp_dynamic:
+            dec = found & (bad >= 1.0)
+            inc = (~found) & (good >= 1000.0)
+            scale = jnp.where(dec, jnp.maximum(scale * 0.5, 1.0),
+                              jnp.where(inc, scale * 2.0, scale))
+            bad = jnp.where(dec, 0.0, bad)
+            good = jnp.where(inc, 0.0, good)
+        new_opt["@amp@scale"] = scale
+        new_opt["@amp@good"] = good
+        new_opt["@amp@bad"] = bad
 
     def _grad_merge_apply(self, oi, opt, trainables, param_tensors, new_opt,
                           k):
@@ -191,6 +285,42 @@ class _CompiledStep:
         new_opt_tuple = tuple(new_opt[n] for n in self.opt_state_names)
         return fetches, new_params, new_opt_tuple
 
+    # ------------------------------------------------------------- sharding
+    def _build_sharded_jit(self, feed_arrays, param_arrays, opt_arrays):
+        """Compile the step over a ('sharding',) mesh: batch-dim feeds and
+        optimizer-state arrays shard, params/fetches replicate — XLA
+        inserts the grad reduce and state reshards (GSPMD replacing the
+        reference sharding_optimizer's explicit c_allreduce/slice ops)."""
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        deg = self.sharding_degree
+        devs = jax.devices()
+        if len(devs) < deg:
+            raise RuntimeError(
+                f"sharding_degree={deg} needs {deg} devices, have "
+                f"{len(devs)}")
+        mesh = Mesh(np.array(devs[:deg]), ("sharding",))
+
+        def sh(spec):
+            return NamedSharding(mesh, spec)
+
+        def arr_spec(a):
+            a = np.asarray(a)
+            if a.ndim >= 1 and a.shape[0] % deg == 0 and a.shape[0] > 0:
+                return P("sharding")
+            return P()
+
+        feed_sh = tuple(sh(arr_spec(a)) for a in feed_arrays)
+        param_sh = tuple(sh(P()) for _ in param_arrays)
+        opt_sh = tuple(sh(arr_spec(a)) if not n.startswith(("@amp@",))
+                       else sh(P())
+                       for n, a in zip(self.opt_state_names, opt_arrays))
+        fetch_sh = tuple(sh(P()) for _ in self.fetch_vars)
+        self._jitted = jax.jit(
+            self._step,
+            in_shardings=(feed_sh, param_sh, opt_sh),
+            out_shardings=(fetch_sh, param_sh, opt_sh))
+
     # ----------------------------------------------------------------- run
     def run(self, feed):
         from ..core import flags as _flags
@@ -199,6 +329,8 @@ class _CompiledStep:
         param_arrays = tuple(self.scope.vars[pv.name]
                              for pv in self.param_vars)
         opt_arrays = tuple(self.scope.vars[n] for n in self.opt_state_names)
+        if self._jitted is None:
+            self._build_sharded_jit(feed_arrays, param_arrays, opt_arrays)
         if _flags._FLAGS["FLAGS_check_nan_inf"]:
             # debug mode: replay per-op eagerly so dispatch's finite check
             # scans every op output with its name (reference

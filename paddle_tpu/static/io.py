@@ -21,7 +21,74 @@ from ..core.tensor import Tensor
 from . import program as prog_mod
 from .program import Program, Variable, global_scope
 
-__all__ = ["save_inference_model", "load_inference_model"]
+__all__ = ["save_inference_model", "load_inference_model", "save", "load",
+           "load_program"]
+
+
+# ================== training-Program serialization ==========================
+# Reference: `python/paddle/static/io.py` save/load (`paddle.static.save`
+# writes <prefix>.pdmodel (ProgramDesc proto, framework.py:5383
+# _serialize_program) + .pdparams + .pdopt). There is no proto here — a
+# Program is a linear record of functional ops whose `fn` closures are
+# serialized with cloudpickle (module-level kernels pickle by reference;
+# attr-capturing closures by value), so a TRAINING program — including its
+# recorded minimize request and optimizer hyperparams — survives the
+# process and can load-and-continue.
+
+def save(program, path_prefix, scope=None):
+    """`paddle.static.save`: persist program + params + optimizer state."""
+    import cloudpickle
+
+    scope = scope or global_scope()
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    # scrub volatile trace-time state (control-flow replay bindings) so no
+    # jax tracer is reachable from the serialized object graph
+    for v in program.vars.values():
+        v.__dict__.pop("_replay_value", None)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        cloudpickle.dump(program, f)
+    params = {pv.name: np.asarray(scope.vars[pv.name])
+              for pv, _ in program.params if pv.name in scope.vars}
+    with open(path_prefix + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=4)
+    opt_state = {n: np.asarray(v) for n, v in scope.vars.items()
+                 if n.startswith("@")}
+    with open(path_prefix + ".pdopt", "wb") as f:
+        pickle.dump(opt_state, f, protocol=4)
+
+
+def load(program, path_prefix, executor=None, var_list=None, scope=None):
+    """`paddle.static.load`: restore params (+ optimizer state) into the
+    scope for `program`. Training resumes exactly where `save` left off."""
+    scope = scope or global_scope()
+    with open(path_prefix + ".pdparams", "rb") as f:
+        for name, arr in pickle.load(f).items():
+            scope.set(name, jnp.asarray(arr))
+    if os.path.exists(path_prefix + ".pdopt") and var_list is None:
+        with open(path_prefix + ".pdopt", "rb") as f:
+            for name, arr in pickle.load(f).items():
+                scope.set(name, jnp.asarray(arr))
+
+
+def load_program(path_prefix, scope=None, load_state=True):
+    """Deserialize a training Program saved by `save` (reference
+    deserialize_program, io.py). Returns the Program; with load_state the
+    saved params + optimizer state are installed into the scope so
+    Executor.run continues the trajectory."""
+    import cloudpickle
+
+    with open(path_prefix + ".pdmodel", "rb") as f:
+        program = cloudpickle.load(f)
+    # keep the Variable id counter ahead of every loaded vid so new
+    # Variables recorded after the load cannot collide
+    max_vid = max((v.vid for v in program.vars.values()), default=0)
+    for op in program.ops:
+        for v in op.outputs:
+            max_vid = max(max_vid, v.vid)
+    Variable._counter = max(Variable._counter, max_vid)
+    if load_state:
+        load(program, path_prefix, scope=scope)
+    return program
 
 
 def _export_program(program: Program, feed_vars, fetch_vars, scope):

@@ -15,6 +15,7 @@ from __future__ import annotations
 import numpy as np
 import jax
 import jax.numpy as jnp
+from ..core.dispatch import note as _note
 
 from ..core.dispatch import forward
 from ..core.tensor import Tensor
@@ -29,6 +30,7 @@ def viterbi_decode(potentials, transition_params, lengths,
     """CRF Viterbi decode (reference text/viterbi_decode.py): returns
     (scores [B], paths [B, T]). potentials: [B, T, N] emission scores,
     transition_params: [N, N], lengths: [B]."""
+    _note('viterbi_decode')
 
     def f(emis, trans, lens, *, bos_eos):
         B, T, N = emis.shape
@@ -94,6 +96,7 @@ class ViterbiDecoder(Layer):
 def gather_tree(ids, parents, name=None):
     """Beam-search ancestry gather (reference fluid gather_tree op):
     ids/parents [T, B, beam] → full paths [T, B, beam]."""
+    _note('gather_tree')
 
     def f(idv, par):
         T = idv.shape[0]
@@ -117,6 +120,7 @@ def edit_distance(input, label, normalized=True, ignored_tokens=None,
     """Levenshtein distance per batch row (reference
     fluid/operators/edit_distance_op). input/label: [B, T] int arrays (use
     *_length for ragged); returns (dist [B, 1], seq_num)."""
+    _note('edit_distance')
     iv = np.asarray(jax.device_get(
         input._data if isinstance(input, Tensor) else input))
     lv = np.asarray(jax.device_get(

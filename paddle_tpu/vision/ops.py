@@ -16,6 +16,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..core.dispatch import note as _note
+
 from ..core.dispatch import forward
 from ..core.tensor import Tensor
 
@@ -68,6 +70,7 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         categories=None, top_k=None):
     """Greedy (optionally category-aware) hard NMS (vision/ops.py:1853).
     Returns kept indices sorted by descending score."""
+    _note('nms')
     b = _unwrap(boxes)
     s = _unwrap(scores) if scores is not None else \
         jnp.arange(b.shape[0], 0, -1).astype(b.dtype)
@@ -92,6 +95,7 @@ def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_siz
               box_normalized=True, axis=0, name=None):
     """Encode/decode boxes against priors (vision/ops.py:572 /
     fluid/operators/detection/box_coder_op.cc)."""
+    _note('box_coder')
     pb = _unwrap(prior_box)
     tb = _unwrap(target_box)
     var = _unwrap(prior_box_var) if not isinstance(
@@ -148,6 +152,7 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh,
     """Decode a YOLO detection head (vision/ops.py:262 /
     detection/yolo_box_op.cc). x: [N, C, H, W], C = na*(5+class_num).
     Returns (boxes [N, H*W*na, 4], scores [N, H*W*na, class_num])."""
+    _note('yolo_box')
     xv = _unwrap(x).astype(jnp.float32)
     img = _unwrap(img_size).astype(jnp.float32)
     na = len(anchors) // 2
@@ -197,6 +202,7 @@ def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
               min_max_aspect_ratios_order=False, name=None):
     """SSD prior boxes over a feature map (vision/ops.py:425 /
     detection/prior_box_op.cc). Returns (boxes [H, W, P, 4], vars)."""
+    _note('prior_box')
     fm = _unwrap(input)
     img = _unwrap(image)
     H, W = fm.shape[-2:]
@@ -296,6 +302,7 @@ def _rois_to_batch(boxes_num, num_rois):
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
               sampling_ratio=-1, aligned=True, name=None):
     """RoIAlign (vision/ops.py:1628 / detection/roi_align_op.cc)."""
+    _note('roi_align')
     xv = _unwrap(x)
     bx = _unwrap(boxes) * spatial_scale
     if isinstance(output_size, int):
@@ -317,6 +324,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
     """RoIPool max-pooling (vision/ops.py:1504 / roi_pool_op.cc)."""
+    _note('roi_pool')
     xv = _unwrap(x)
     bx = _unwrap(boxes) * spatial_scale
     if isinstance(output_size, int):
@@ -357,6 +365,7 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                name=None):
     """Position-sensitive RoI average pool (vision/ops.py:1384): input
     channels C = out_c * oh * ow; bin (i, j) reads channel group (i, j)."""
+    _note('psroi_pool')
     xv = _unwrap(x)
     bx = _unwrap(boxes) * spatial_scale
     if isinstance(output_size, int):
@@ -396,6 +405,7 @@ def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
     """Assign RoIs to FPN levels by scale (vision/ops.py:1151 /
     distribute_fpn_proposals_op.cc). Returns (per-level roi lists,
     restore_index, per-level counts)."""
+    _note('distribute_fpn_proposals')
     rois = np.asarray(_unwrap(fpn_rois))
     off = 1.0 if pixel_offset else 0.0
     w = rois[:, 2] - rois[:, 0] + off
@@ -447,6 +457,7 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
     input at a learned fractional offset (bilinear), optionally modulated
     by a mask; the taps then contract with the weights as a dense einsum —
     gather + MXU matmul, no custom kernel."""
+    _note('deform_conv2d')
     xv = _unwrap(x)
     off = _unwrap(offset)
     w = _unwrap(weight)
@@ -525,6 +536,7 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
     generate_proposals_v2_op.cc): decode anchors with deltas, clip to the
     image, drop tiny boxes, take pre-NMS top-k, NMS, take post-NMS top-k.
     Returns (rois [R, 4], scores [R, 1][, rois_num])."""
+    _note('generate_proposals')
     sc = np.asarray(_unwrap(scores))          # [N, A, H, W]
     bd = np.asarray(_unwrap(bbox_deltas))     # [N, 4A, H, W]
     ims = np.asarray(_unwrap(img_size))       # [N, 2]
@@ -583,6 +595,7 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     box SSE + objectness/class BCE, negatives ignored above
     ignore_thresh. x: [N, na*(5+C), H, W]; gt_box: [N, G, 4] (cx cy w h,
     image units); gt_label: [N, G]."""
+    _note('yolo_loss')
     xv = _unwrap(x).astype(jnp.float32)
     gb = _unwrap(gt_box).astype(jnp.float32)
     gl = _unwrap(gt_label)

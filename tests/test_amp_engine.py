@@ -1,0 +1,106 @@
+"""GradScaler integrated with the hybrid engine (reference
+`fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:51`
+HybridParallelGradScaler + `amp/grad_scaler.py:602`): loss scaled in-graph,
+one fused found_inf reduction spanning every shard, update skipped on ALL
+ranks via jnp.where, dynamic scale bookkeeping inside the compiled step."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+
+
+def _engine(dp=2, pp=1, sharding=2, dtype="float16", scaler=None, seed=3):
+    from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
+                                   GPTPretrainingCriterion)
+
+    paddle.seed(seed)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 1,
+                               "pp_degree": pp, "sharding_degree": sharding}
+    M = max(2 * pp, 2)
+    strategy.pipeline_configs = {"accumulate_steps": M}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    cfg = GPTConfig.preset("gpt2-tiny", vocab_size=64, n_layer=2 * pp,
+                           seq_len=16, dropout=0.0, n_head=2, d_model=32,
+                           dtype=dtype)
+    model = GPTForPretraining(GPTModel(cfg))
+    opt = paddle.optimizer.AdamW(1e-3, multi_precision=True,
+                                 parameters=model.parameters())
+    engine = fleet.HybridParallelEngine(
+        model, opt, hcg, strategy, criterion=GPTPretrainingCriterion())
+    rng = np.random.default_rng(0)
+    B = 4 * max(dp * sharding, M)
+    toks = rng.integers(0, 64, (B, 16)).astype(np.int64)
+    labels = np.roll(toks, -1, 1)
+    return engine, toks, labels
+
+
+class TestEngineScaler:
+    def test_fp16_trains_with_scaler(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 15)
+        engine, toks, labels = _engine(dtype="float16", scaler=scaler)
+        losses = [float(engine.train_batch([toks, labels], scaler=scaler))]
+        p0 = [np.asarray(p) for p in engine.param_arrays]
+        losses += [float(engine.train_batch([toks, labels], scaler=scaler))
+                   for _ in range(5)]
+        assert np.isfinite(losses).all()
+        # fp16 loss readout is coarse; require net decrease + param movement
+        assert min(losses) < losses[0]
+        p1 = [np.asarray(p) for p in engine.param_arrays]
+        assert any(not np.array_equal(a, b) for a, b in zip(p0, p1))
+        engine.sync_scaler()
+        assert scaler._good_steps == 6  # no overflow seen
+        assert scaler._scale == 2.0 ** 15
+
+    def test_injected_inf_skips_update_and_halves_scale(self):
+        # scale far beyond fp16 max (65504): the backward seed overflows
+        # the fp16 cotangents -> every grad nonfinite -> update skipped on
+        # all logical ranks and the dynamic rule halves the scale
+        # (decr_every_n_nan_or_inf=1)
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1.0e30)
+        engine, toks, labels = _engine(dtype="float16", scaler=scaler)
+        loss0 = float(engine.train_batch([toks, labels], scaler=scaler))
+        params_before = [np.asarray(p) for p in engine.param_arrays]
+        loss1 = float(engine.train_batch([toks, labels], scaler=scaler))
+        params_after = [np.asarray(p) for p in engine.param_arrays]
+        assert np.isfinite(loss0) and np.isfinite(loss1)  # loss unscaled
+        for a, b in zip(params_before, params_after):
+            np.testing.assert_array_equal(a, b)  # update skipped
+        engine.sync_scaler()
+        assert scaler._found_inf
+        assert scaler._scale == pytest.approx(1.0e30 * 0.25, rel=1e-3)
+        assert scaler._good_steps == 0
+
+    def test_scale_recovers_and_training_resumes(self):
+        # overflow-scale first step, then the (steep) decrease brings the
+        # scale into fp16 range and updates resume
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1.0e30,
+                                       decr_ratio=1e-27)
+        engine, toks, labels = _engine(dtype="float16", scaler=scaler)
+        float(engine.train_batch([toks, labels], scaler=scaler))  # inf
+        p0 = [np.asarray(p) for p in engine.param_arrays]
+        float(engine.train_batch([toks, labels], scaler=scaler))  # updates
+        p1 = [np.asarray(p) for p in engine.param_arrays]
+        assert any(not np.array_equal(a, b) for a, b in zip(p0, p1))
+        engine.sync_scaler()
+        assert not scaler._found_inf
+
+    def test_pipeline_scaler_pp2(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        engine, toks, labels = _engine(dp=1, pp=2, sharding=1,
+                                       dtype="float32", scaler=scaler)
+        losses = [float(engine.train_batch([toks, labels], scaler=scaler))
+                  for _ in range(3)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+        engine.sync_scaler()
+        assert scaler._scale == 1024.0 and scaler._good_steps == 3
+
+    def test_scaler_presence_must_be_stable(self):
+        scaler = paddle.amp.GradScaler()
+        engine, toks, labels = _engine(dtype="float32", scaler=scaler)
+        float(engine.train_batch([toks, labels], scaler=scaler))
+        with pytest.raises(RuntimeError, match="scaler presence"):
+            engine.train_batch([toks, labels])

@@ -174,11 +174,11 @@ def test_inert_strategy_toggles_warn():
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         s.dgc = True
-        s.gradient_merge = True
+        s.gradient_merge = True  # implemented by the static pass: no warn
         s.recompute = True  # implemented: must NOT warn
     msgs = [str(x.message) for x in w]
     assert any("dgc" in m for m in msgs)
-    assert any("gradient_merge" in m for m in msgs)
+    assert not any("gradient_merge" in m for m in msgs)
     assert not any("recompute" in m for m in msgs)
 
 

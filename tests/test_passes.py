@@ -8,7 +8,7 @@ from paddle_tpu.distributed.passes import (PassContext, PassManager,
                                            new_pass, register_pass, PassBase)
 
 
-def _build_mlp_program(lr=0.1, bsz=8):
+def _build_mlp_program(lr=0.1, bsz=8, opt_cls=None):
     paddle.enable_static()
     main = paddle.static.Program()
     startup = paddle.static.Program()
@@ -18,7 +18,7 @@ def _build_mlp_program(lr=0.1, bsz=8):
         h = paddle.static.nn.fc(x, 32, activation="relu")
         out = paddle.static.nn.fc(h, 1)
         loss = ((out - y) * (out - y)).mean()
-        opt = paddle.optimizer.SGD(learning_rate=lr)
+        opt = (opt_cls or paddle.optimizer.SGD)(learning_rate=lr)
         opt.minimize(loss)
     return main, startup, loss
 
@@ -197,3 +197,110 @@ class TestFuseAllReducePass:
     def test_documented_noop(self):
         ctx = new_pass("fuse_all_reduce").apply([object()])
         assert "combiner" in ctx.get_attr("fuse_all_reduce:note")
+
+
+class TestAmpO2Pass:
+    def test_bf16_o2_master_weights_and_numerics(self):
+        try:
+            paddle.seed(21)
+            main, startup, loss = _build_mlp_program()
+            base = _run_steps(main, startup, loss, 5, seed=2)
+
+            paddle.seed(21)
+            paddle.static.global_scope().vars.clear()
+            main2, startup2, loss2 = _build_mlp_program()
+            ctx = new_pass("auto_parallel_amp",
+                           {"level": "O2", "dtype": "bfloat16"}).apply(
+                [main2])
+            assert ctx.get_attr("auto_parallel_amp:o2") == "bfloat16"
+            o2 = _run_steps(main2, startup2, loss2, 5, seed=2)
+            assert np.isfinite(o2).all()
+            np.testing.assert_allclose(base, o2, rtol=5e-2, atol=5e-2)
+            # masters stay fp32 in the scope
+            scope = paddle.static.global_scope()
+            for pv, _ in main2.params:
+                assert np.asarray(scope.vars[pv.name]).dtype == np.float32
+        finally:
+            paddle.disable_static()
+
+    def test_fp16_overflow_skips_update_and_decreases_scale(self):
+        try:
+            paddle.seed(5)
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program()
+            new_pass("auto_parallel_amp",
+                     {"level": "O2", "dtype": "float16",
+                      "init_loss_scaling": 1.0e30}).apply([main])
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            scope = paddle.static.global_scope()
+            rng = np.random.default_rng(1)
+            feed = {"x": rng.normal(size=(8, 16)).astype(np.float32),
+                    "y": rng.normal(size=(8, 1)).astype(np.float32)}
+            exe.run(main, feed=feed, fetch_list=[loss])
+            before = {pv.name: np.asarray(scope.vars[pv.name]).copy()
+                      for pv, _ in main.params}
+            exe.run(main, feed=feed, fetch_list=[loss])
+            for pv, _ in main.params:  # overflow -> update skipped
+                np.testing.assert_array_equal(before[pv.name],
+                                              scope.vars[pv.name])
+            assert float(scope.vars["@amp@scale"]) < 1.0e30  # decreased
+        finally:
+            paddle.disable_static()
+
+
+class TestShardingPass:
+    def test_matches_unsharded_and_shards_opt_state(self):
+        try:
+            paddle.seed(31)
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program(
+                opt_cls=paddle.optimizer.Adam)
+            base = _run_steps(main, startup, loss, 4, seed=3)
+
+            paddle.seed(31)
+            paddle.static.global_scope().vars.clear()
+            main2, startup2, loss2 = _build_mlp_program(
+                opt_cls=paddle.optimizer.Adam)
+            new_pass("auto_parallel_sharding",
+                     {"sharding_degree": 4}).apply([main2])
+            shd = _run_steps(main2, startup2, loss2, 4, seed=3)
+            np.testing.assert_allclose(base, shd, rtol=1e-4, atol=1e-5)
+            scope = paddle.static.global_scope()
+            moments = [n for n in scope.vars if "@moment" in n]
+            assert moments
+            sharded = [n for n in moments
+                       if len(scope.vars[n].sharding.device_set) == 4]
+            assert sharded, f"no ZeRO-sharded state among {moments}"
+        finally:
+            paddle.disable_static()
+
+
+class TestStrategyComposition:
+    def test_amp_plus_sharding_from_strategy_flags(self):
+        from paddle_tpu.distributed.passes import apply_pass_by_strategy
+        from paddle_tpu.distributed import fleet
+
+        try:
+            paddle.seed(41)
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program(
+                opt_cls=paddle.optimizer.Adam)
+            base = _run_steps(main, startup, loss, 4, seed=4)
+
+            paddle.seed(41)
+            paddle.static.global_scope().vars.clear()
+            main2, startup2, loss2 = _build_mlp_program(
+                opt_cls=paddle.optimizer.Adam)
+            strategy = fleet.DistributedStrategy()
+            strategy.amp = True
+            strategy.amp_configs = {"level": "O2"}  # bf16 O2
+            strategy.sharding = True
+            strategy.sharding_configs = {"sharding_degree": 2}
+            apply_pass_by_strategy(main2, strategy)
+            assert getattr(main2, "amp_o2_dtype", None) == "bfloat16"
+            assert getattr(main2, "sharding_degree", 1) == 2
+            combo = _run_steps(main2, startup2, loss2, 4, seed=4)
+            np.testing.assert_allclose(base, combo, rtol=5e-2, atol=5e-2)
+        finally:
+            paddle.disable_static()

@@ -140,8 +140,20 @@ class TestElasticIntegration:
             m1.stop()
             time.sleep(1.2)
             assert m0.alive_ranks() == [0]
+            # tick 1: leader observes the dead set (debounce)
+            assert m0.watch() == ElasticStatus.HOLD
+            # tick 2: same dead set again -> publishes generation g+1
+            assert m0.watch() == ElasticStatus.HOLD
+            # tick 3: it adopts the new generation -> RESTART once
             assert m0.watch() == ElasticStatus.RESTART
             assert m0.need_restart
+            assert m0.members == [0]
+            assert m0.local_rank_and_world() == (0, 1)
+            # after re-registering under the new generation the stale
+            # lease of the dead rank is invisible: back to HOLD forever
+            # (round-2 weak #8: no restart-loop)
+            m0.register()
+            assert m0.watch() == ElasticStatus.HOLD
         finally:
             m0.stop(); m1.stop()
 

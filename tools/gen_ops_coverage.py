@@ -93,6 +93,7 @@ ALIASES = {
     "fft_c2r": "irfft",
     "fft_r2c": "rfft",
     "frobenius_norm": "norm",
+    "p_norm": "norm",
     "mean_all": "mean",
     "pad3d": "pad",
     "fill": "full",
@@ -108,6 +109,51 @@ ALIASES = {
     "auc": "Auc",
     "dirichlet": "Dirichlet",
     "warprnnt": "rnnt_loss",
+    # optimizer update ops dispatch under their kernel names
+    "adam_": "adam",
+    "adamw_": "adamw",
+    "adamax_": "adamax",
+    "adagrad_": "adagrad",
+    "adadelta_": "adadelta",
+    "sgd_": "sgd",
+    "momentum_": "momentum",
+    "rmsprop_": "rmsprop",
+    "lamb_": "lamb",
+    "merged_adam_": "adam",
+    "merged_momentum_": "momentum",
+    "check_finite_and_unscale_": "unscale",
+    "update_loss_scaling_": "scale_loss",
+    "average_accumulates_": "average_accumulates",
+    "spectral_norm": "spectral_norm",
+    "rnn": "rnn",
+    "unpool": "max_unpool2d",
+    "unpool3d": "max_unpool3d",
+    "margin_cross_entropy": "margin_cross_entropy",
+    "lu_unpack": "lu_unpack",
+    "viterbi_decode": "viterbi_decode",
+    "gather_tree": "gather_tree",
+    "edit_distance": "edit_distance",
+    "deformable_conv": "deform_conv2d",
+    "depthwise_conv2d_transpose": "conv2d_transpose",
+    "box_coder": "box_coder",
+    "yolo_box": "yolo_box",
+    "prior_box": "prior_box",
+    "roi_align": "roi_align",
+    "roi_pool": "roi_pool",
+    "psroi_pool": "psroi_pool",
+    "distribute_fpn_proposals": "distribute_fpn_proposals",
+    "generate_proposals": "generate_proposals",
+    "send_u_recv": "send_u_recv",
+    "send_ue_recv": "send_ue_recv",
+    "send_uv": "send_uv",
+}
+
+# mark-only map: the dispatch name an op is RECORDED under when it differs
+# from its public alias (resolution still uses ALIASES)
+RECORDED_AS = {
+    "auc": "auc",
+    "dirichlet": "dirichlet",
+    "sigmoid_cross_entropy_with_logits": "bce_with_logits",
 }
 
 # reference op name -> capability that covers it outside the flat-op surface
@@ -219,11 +265,28 @@ def resolve(name):
     return None, None
 
 
+def _committed_tested(path):
+    """Ops marked tested (✓) in an existing OPS_COVERAGE.md."""
+    marked = set()
+    try:
+        for ln in open(path):
+            parts = [c.strip() for c in ln.split("|")]
+            if len(parts) >= 6 and parts[5] == "✓":
+                marked.add(parts[1])
+    except OSError:
+        pass
+    return marked
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--coverage", default="/tmp/op_coverage.txt")
     ap.add_argument("--out", default=os.path.join(
         os.path.dirname(__file__), "..", "OPS_COVERAGE.md"))
+    ap.add_argument("--check", action="store_true",
+                    help="fail if a previously-tested op regressed to "
+                         "untested (compares against the committed "
+                         "OPS_COVERAGE.md before overwriting it)")
     args = ap.parse_args()
 
     tested = set()
@@ -247,8 +310,11 @@ def main():
             if status is None:
                 status, where = "no", "—"
         counts[status] += 1
-        mark = "✓" if (ALIASES.get(name, name) in tested
-                       or name in tested) else ""
+        target = ALIASES.get(name, name)
+        cands = {name, target, name.rstrip("_"), target.rstrip("_")}
+        if name in RECORDED_AS:
+            cands.add(RECORDED_AS[name])
+        mark = "✓" if cands & tested else ""
         rows.append((name, ref[name]["src"], status, where or "—", mark))
 
     total = len(ref)
@@ -285,11 +351,21 @@ def main():
     missing = [r[0] for r in rows if r[2] == "no"]
     out += ["", f"## Missing ({len(missing)})", "",
             ", ".join(missing) or "none"]
+    if args.check:
+        before = _committed_tested(args.out)
+        now = {r[0] for r in rows if r[4]}
+        regressed = sorted(before - now)
+        if regressed:
+            print(f"FAIL: {len(regressed)} op(s) regressed from tested to "
+                  f"untested: {', '.join(regressed)}")
+            return 1
+        print(f"check OK: tested {len(now)} (was {len(before)})")
     with open(args.out, "w") as f:
         f.write("\n".join(out) + "\n")
     print(f"wrote {args.out}: {impl}/{total} covered, "
           f"{len(missing)} missing")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
