@@ -76,3 +76,102 @@ class cuda:  # namespace shim: paddle.device.cuda.*
 
 def synchronize(device=None):
     cuda.synchronize(device)
+
+
+class Event:
+    """Device-event surface (reference `paddle.device.cuda.Event` over
+    `platform/device_event_*`; `phi/backends` DeviceEvent).
+
+    TPU re-design: PJRT exposes no user events — dispatch is async with
+    in-order execution per device, so "record" snapshots a fence array on
+    the stream and "synchronize"/"query" ride `block_until_ready` on it.
+    `elapsed_time` measures host-observed completion-to-completion time,
+    which on a single-stream in-order device brackets the enqueued work
+    the same way a CUDA event pair does."""
+
+    def __init__(self, enable_timing=True, blocking=False,
+                 interprocess=False, device=None):
+        self._fence = None
+        self._time = None
+        self._waiter = None
+
+    def record(self, stream=None):
+        import threading
+        import time as _time
+
+        import jax.numpy as jnp
+
+        fence = jnp.zeros(()) + 0  # an array ordered after prior work
+        self._fence = fence
+        self._time = None
+
+        def stamp():
+            # stamp COMPLETION time asynchronously — record() stays async
+            # and elapsed_time measures real enqueued-work duration even
+            # when the events are synchronized out of order
+            fence.block_until_ready()
+            self._time = _time.perf_counter()
+
+        self._waiter = threading.Thread(target=stamp, daemon=True)
+        self._waiter.start()
+
+    def query(self):
+        return self._fence is None or self._time is not None
+
+    def synchronize(self):
+        if self._waiter is not None:
+            self._waiter.join()
+
+    def elapsed_time(self, end_event):
+        """Milliseconds between this event's completion and `end_event`'s."""
+        self.synchronize()
+        end_event.synchronize()
+        if self._time is None or end_event._time is None:
+            return 0.0
+        return max((end_event._time - self._time) * 1000.0, 0.0)
+
+
+class Stream:
+    """Stream surface (reference `paddle.device.cuda.Stream`). PJRT runs
+    one in-order compute stream per device and XLA owns cross-stream
+    overlap internally, so user streams are a compatibility veneer:
+    work "on" any Stream joins the same in-order queue, and
+    synchronize/wait degenerate to device sync — documented divergence,
+    not silent no-op."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        event.synchronize()
+
+    def wait_stream(self, stream):
+        stream.synchronize()
+
+    def record_event(self, event=None):
+        event = event or Event()
+        event.record(self)
+        return event
+
+
+def current_stream(device=None):
+    return Stream(device)
+
+
+def stream_guard(stream):
+    import contextlib
+
+    @contextlib.contextmanager
+    def guard():
+        yield stream
+
+    return guard()
+
+
+cuda.Event = Event
+cuda.Stream = Stream
+cuda.current_stream = staticmethod(current_stream)
+cuda.stream_guard = staticmethod(stream_guard)

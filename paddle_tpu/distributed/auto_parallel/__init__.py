@@ -18,6 +18,8 @@ from .interface import shard_tensor, shard_op  # noqa: F401
 from .engine import Engine  # noqa: F401
 from .strategy import Strategy  # noqa: F401
 from .converter import Converter  # noqa: F401
+from .planner import Planner, ShardingPlan, apply_plan  # noqa: F401
 
 __all__ = ["ProcessMesh", "get_current_process_mesh", "shard_tensor", "Converter",
-           "shard_op", "Engine", "Strategy"]
+           "shard_op", "Engine", "Strategy", "Planner", "ShardingPlan",
+           "apply_plan"]

@@ -62,6 +62,18 @@ class Engine:
         from ...jit import TrainStep
 
         model, loss_fn, opt = self.model, self.loss, self.optimizer
+        if getattr(self.strategy, "auto_mode", "semi") == "full":
+            # full-auto: the planner CHOOSES parameter shardings before
+            # the step compiles (reference planner/tuner; semi mode keeps
+            # user shard_tensor annotations + GSPMD propagation)
+            from .planner import Planner
+
+            planner = Planner(model, self._ensure_mesh())
+            best, _ = planner.plan()
+            from .planner import apply_plan
+
+            apply_plan(model, best, self._ensure_mesh())
+            self.chosen_plan = best
 
         def step(x, y):
             out = model(x)

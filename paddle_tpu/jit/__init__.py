@@ -64,6 +64,15 @@ class StaticFunction:
     """Compiled wrapper (reference StaticFunction, program_translator.py:299)."""
 
     def __init__(self, fn, layer=None, input_spec=None):
+        # dy2static: rewrite pythonic tensor control flow (if/while on
+        # tensor values) into lax.cond/while_loop conversion calls before
+        # tracing (reference program_translator applies the AST
+        # transformers here); functions the transformer can't handle run
+        # unchanged
+        if not getattr(fn, "_not_to_static", False):
+            from .dy2static import ast_transform
+
+            fn = ast_transform(fn)
         self._fn = fn
         self._layer = layer
         self._input_spec = input_spec
@@ -79,6 +88,7 @@ class StaticFunction:
         def pure(state_arrays, key, arg_arrays):
             tensors = {n: self._state[n] for n in names}
             old = {n: t._data for n, t in tensors.items()}
+            old_key = prandom.get_rng_state()
             for n, arr in zip(names, state_arrays):
                 tensors[n]._data = arr
             prandom.set_rng_state(key)
@@ -94,6 +104,9 @@ class StaticFunction:
             finally:
                 for n, t in tensors.items():
                     t._data = old[n]
+                # a FAILED trace must not leave a traced key in the global
+                # RNG state (it would poison every later unrelated op)
+                prandom.set_rng_state(old_key)
         self._pure = pure
         self._compiled = jax.jit(pure)
 
@@ -174,8 +187,18 @@ class TrainStep:
             for acc_name, store in sorted(opt._accumulators.items()):
                 for pi, p in enumerate(opt._parameter_list):
                     if p is not None and id(p) in store:
-                        self._acc_refs.append((oi, acc_name, pi,
-                                               store[id(p)]))
+                        acc = store[id(p)]
+                        # optimizer state follows its parameter's placement
+                        # (a planner/apply_plan may have sharded the param
+                        # after the accumulator was created; jit refuses
+                        # mixed committed placements)
+                        p_sh = getattr(p._data, "sharding", None)
+                        a_sh = getattr(acc._data, "sharding", None)
+                        if p_sh is not None and a_sh is not None and \
+                                p_sh != a_sh and \
+                                acc._data.shape == p._data.shape:
+                            acc._data = jax.device_put(acc._data, p_sh)
+                        self._acc_refs.append((oi, acc_name, pi, acc))
         names = list(self._state)
         fn = self._fn
         opts = self._opts
@@ -185,6 +208,7 @@ class TrainStep:
             saved_p = [t._data for t in tensors]
             saved_a = [r[3]._data for r in self._acc_refs]
             saved_steps = [o._opt_step for o in opts]
+            saved_key = prandom.get_rng_state()
             for t, arr in zip(tensors, state_arrays):
                 t._data = arr
             for (oi, an, pi, t), arr in zip(self._acc_refs, acc_arrays):
@@ -209,6 +233,7 @@ class TrainStep:
                     r[3]._data = arr
                 for o, s in zip(opts, saved_steps):
                     o._opt_step = s
+                prandom.set_rng_state(saved_key)
 
         # donation is accelerator-only: XLA-CPU's transfer manager can
         # abort the process when many donated executables coexist (see
@@ -217,25 +242,51 @@ class TrainStep:
         donate = (0, 1) if self._donate and \
             jax.devices()[0].platform != "cpu" else ()
         self._compiled = jax.jit(pure, donate_argnums=donate)
+        # planner-sharded params span a mesh: scalars (step counters, rng
+        # key) and single-device batches must be lifted onto it, or jit
+        # rejects the mixed committed placements
+        self._lift_sh = None
+        for n in self._state:
+            sh = getattr(self._state[n]._data, "sharding", None)
+            if sh is not None and len(sh.device_set) > 1 and \
+                    hasattr(sh, "mesh"):
+                from jax.sharding import NamedSharding, PartitionSpec
+
+                self._lift_sh = NamedSharding(sh.mesh, PartitionSpec())
+                break
+
+    def _lift(self, arr):
+        if self._lift_sh is None:
+            return arr
+        sh = getattr(arr, "sharding", None)
+        if sh is None or len(getattr(sh, "device_set", [1, 2])) > 1:
+            return arr
+        return jax.device_put(arr, self._lift_sh)
 
     def __call__(self, *args):
         if self._compiled is None:
             self._build()
-        arg_arrays = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
-                           for a in args)
+        arg_arrays = tuple(
+            self._lift(a._data if isinstance(a, Tensor) else jnp.asarray(a))
+            for a in args)
         state_arrays = tuple(self._state[n]._data for n in self._state)
         acc_arrays = tuple(r[3]._data for r in self._acc_refs)
-        steps = tuple(jnp.asarray(o._opt_step, jnp.float32)
+        steps = tuple(self._lift(jnp.asarray(o._opt_step, jnp.float32))
                       for o in self._opts)
         outs, new_state, new_accs, new_steps, new_key = self._compiled(
-            state_arrays, acc_arrays, steps, prandom.get_rng_state(),
-            arg_arrays)
+            state_arrays, acc_arrays, steps,
+            self._lift(prandom.get_rng_state()), arg_arrays)
         for n, arr in zip(self._state, new_state):
             self._state[n]._data = arr
         for r, arr in zip(self._acc_refs, new_accs):
             r[3]._data = arr
         for o, s in zip(self._opts, new_steps):
             o._opt_step = s
+        if self._lift_sh is not None:
+            # the key came back committed to the whole mesh; the global RNG
+            # state must stay single-device or every later unrelated jit
+            # sees mixed committed placements
+            new_key = jax.device_put(new_key, jax.devices()[0])
         prandom.set_rng_state(new_key)
         res = tuple(Tensor(o) for o in outs)
         return res[0] if len(res) == 1 else res

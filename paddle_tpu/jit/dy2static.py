@@ -1,0 +1,365 @@
+"""Dy2static AST transforms — pythonic control flow to compiled control flow.
+
+Reference: `python/paddle/jit/dy2static/{ifelse,loop}_transformer.py` +
+`convert_operators.py` (`convert_ifelse`, `convert_while_loop`): user
+functions are AST-rewritten so `if`/`while` over TENSOR values become
+runtime-dispatched conversion calls; a bool predicate keeps plain Python
+semantics, a tensor predicate builds graph control flow.
+
+TPU re-design: the conversion targets are `jax.lax.cond` /
+`jax.lax.while_loop` instead of the reference's cond/while ops. Dispatch is
+three-way at runtime:
+  * python value        → plain Python branch/loop (zero overhead),
+  * CONCRETE Tensor     → `bool()` materializes it and Python branches —
+                          eager dygraph keeps the full tape/hook semantics,
+  * TRACED Tensor       → `lax.cond`/`lax.while_loop` over the assigned
+                          variables (inside `jit.to_static`/`jax.jit`,
+                          where data-dependent Python branching is
+                          impossible by construction).
+
+The transformer intentionally covers the reference's core contract
+(branch/loop variable hoisting by assignment analysis) without its full
+breadth (no for-over-tensor, no break/continue rewriting); any function it
+cannot rewrite falls back to the original, matching the reference's
+fallback-to-dygraph behavior (`program_translator.py` error recovery).
+"""
+from __future__ import annotations
+
+import ast
+import inspect
+import textwrap
+
+import jax
+
+__all__ = ["ast_transform", "convert_ifelse", "convert_while_loop",
+           "UNDEF"]
+
+
+class _Undefined:
+    """Placeholder for a name created inside both branches (reference
+    dy2static UndefinedVar)."""
+
+    def __repr__(self):
+        return "<dy2static undefined>"
+
+
+UNDEF = _Undefined()
+
+
+def _is_traced(x):
+    from ..core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _unwrap(x):
+    from ..core.tensor import Tensor
+
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _to_pred(x):
+    arr = _unwrap(x)
+    return arr.astype(bool).reshape(())
+
+
+def convert_ifelse(pred, true_fn, false_fn, operands):
+    """Reference convert_operators.convert_ifelse. operands: current values
+    of every name either branch assigns; returns their new values."""
+    from ..core.tensor import Tensor
+
+    if not _is_traced(pred):
+        if isinstance(pred, Tensor):
+            pred = bool(pred.numpy())
+        return true_fn(*operands) if pred else false_fn(*operands)
+
+    # a name first created INSIDE both branches has no pre-value: feed a
+    # NaN placeholder (any read before assignment poisons visibly —
+    # reference UndefinedVar contract) and wrap its output as a Tensor
+    import jax.numpy as jnp
+
+    arrs = tuple(jnp.float32(jnp.nan) if o is UNDEF else _unwrap(o)
+                 for o in operands)
+
+    def wrap(fn):
+        def g(xs):
+            ins = tuple(Tensor(x) if isinstance(o, Tensor) or o is UNDEF
+                        else x for x, o in zip(xs, operands))
+            outs = fn(*ins)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            return tuple(_unwrap(o) for o in outs)
+
+        return g
+
+    from ..core import autograd
+
+    with autograd._scoped(False):  # lax.cond regions are jax-differentiated
+        outs = jax.lax.cond(_to_pred(pred), wrap(true_fn), wrap(false_fn),
+                            arrs)
+    return tuple(Tensor(x) if isinstance(o, Tensor) or o is UNDEF else x
+                 for x, o in zip(outs, operands))
+
+
+def convert_while_loop(cond_fn, body_fn, operands):
+    """Reference convert_operators.convert_while_loop."""
+    from ..core.tensor import Tensor
+    from ..core import autograd
+
+    probe = cond_fn(*operands)
+    if not _is_traced(probe):
+        vals = tuple(operands)
+        cur = probe
+        while (bool(cur.numpy()) if isinstance(cur, Tensor) else bool(cur)):
+            vals = body_fn(*vals)
+            if not isinstance(vals, tuple):
+                vals = (vals,)
+            cur = cond_fn(*vals)
+        return vals
+
+    import jax.numpy as jnp
+
+    # loop-created names get a NaN placeholder like convert_ifelse —
+    # but a while carry must be TYPE-STABLE, so placeholder slots are
+    # re-seeded from the body's OUTPUT aval (the steady-state type),
+    # discovered with eval_shape; one fixpoint refinement covers slots
+    # whose first output still depended on the scalar seed
+    arrs = tuple(jnp.float32(jnp.nan) if o is UNDEF else _unwrap(o)
+                 for o in operands)
+
+    def rewrap(xs):
+        return tuple(Tensor(x) if isinstance(o, Tensor) or o is UNDEF
+                     else x for x, o in zip(xs, operands))
+
+    def c(xs):
+        return _to_pred(cond_fn(*rewrap(xs)))
+
+    def b(xs):
+        outs = body_fn(*rewrap(xs))
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        return tuple(_unwrap(o) for o in outs)
+
+    with autograd._scoped(False):
+        if any(o is UNDEF for o in operands):
+            for _ in range(2):
+                out_avals = jax.eval_shape(b, arrs)
+                reseeded = tuple(
+                    jnp.full(a.shape, jnp.nan, a.dtype)
+                    if o is UNDEF else x
+                    for x, a, o in zip(arrs, out_avals, operands))
+                if all(x.shape == a.shape and x.dtype == a.dtype
+                       for x, a in zip(reseeded, out_avals)):
+                    arrs = reseeded
+                    break
+                arrs = reseeded
+        outs = jax.lax.while_loop(c, b, arrs)
+    return rewrap(outs)
+
+
+# ============================ AST transformer ================================
+
+def _assigned_names(nodes):
+    out = []
+    for node in nodes:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Store):
+                if sub.id not in out:
+                    out.append(sub.id)
+            elif isinstance(sub, (ast.AugAssign,)) and \
+                    isinstance(sub.target, ast.Name):
+                if sub.target.id not in out:
+                    out.append(sub.target.id)
+    return out
+
+
+def _load(name):
+    return ast.Name(id=name, ctx=ast.Load())
+
+
+def _store(name):
+    return ast.Name(id=name, ctx=ast.Store())
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    """Rewrites `if`/`while` statements into convert_* calls (reference
+    IfElseTransformer/LoopTransformer collapsed: one hoisting strategy —
+    every name assigned in a branch/body becomes an operand and a return)."""
+
+    def __init__(self, local_names):
+        self._counter = 0
+        self._locals = set(local_names)  # fn-local names (args + stores)
+        self.hoisted: set = set()  # every name used as an operand
+        self.changed = False
+
+    def _fresh(self, kind):
+        self._counter += 1
+        return f"__dy2static_{kind}_{self._counter}"
+
+    def _make_branch_fn(self, name, body, var_names):
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in var_names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        ret = ast.Return(value=ast.Tuple(
+            elts=[_load(v) for v in var_names], ctx=ast.Load()))
+        fn = ast.FunctionDef(name=name, args=args,
+                             body=(body or [ast.Pass()]) + [ret],
+                             decorator_list=[], returns=None,
+                             type_params=[])
+        return fn
+
+    @staticmethod
+    def _has_escape(nodes):
+        """return/break/continue ESCAPING a hoisted region would silently
+        change semantics (the generated branch fn swallows them): leave
+        such statements untransformed — a tensor pred then fails loudly at
+        trace time instead of mis-executing (documented narrowness).
+        Scoped scan: nested function/class definitions (including our own
+        generated branch fns) own their returns, and break/continue inside
+        a loop nested WITHIN the region don't escape it."""
+
+        def scan(node, in_loop):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                return False
+            if isinstance(node, ast.Return):
+                return True
+            if isinstance(node, (ast.Break, ast.Continue)) and not in_loop:
+                return True
+            nested = in_loop or isinstance(
+                node, (ast.For, ast.AsyncFor, ast.While))
+            return any(scan(ch, nested)
+                       for ch in ast.iter_child_nodes(node))
+
+        return any(scan(n, False) for n in nodes)
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if self._has_escape(node.body) or self._has_escape(node.orelse):
+            return node
+        names = _assigned_names(node.body) + [
+            n for n in _assigned_names(node.orelse)
+            if n not in _assigned_names(node.body)]
+        names = [n for n in names if not n.startswith("__dy2static")]
+        if not names:
+            return node  # no state: leave it (pred must then be python)
+        self.changed = True
+        self.hoisted.update(names)
+        tname, fname = self._fresh("true"), self._fresh("false")
+        true_fn = self._make_branch_fn(tname, node.body, names)
+        false_fn = self._make_branch_fn(fname, node.orelse, names)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(n) for n in names],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=_load("__dy2static_convert_ifelse"),
+                args=[node.test, _load(tname), _load(fname),
+                      ast.Tuple(elts=[_load(n) for n in names],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return [true_fn, false_fn, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or self._has_escape(node.body):
+            return node  # while/else, break/continue: keep python
+        names = _assigned_names(node.body)
+        names = [n for n in names if not n.startswith("__dy2static")]
+        # LOCAL loop-condition reads must be loop-carried too (globals /
+        # closure modules stay free variables of the generated functions)
+        for sub in ast.walk(node.test):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
+                if sub.id not in names and sub.id in self._locals and \
+                        not sub.id.startswith("__"):
+                    names.append(sub.id)
+        if not names:
+            return node
+        self.changed = True
+        self.hoisted.update(names)
+        cname, bname = self._fresh("cond"), self._fresh("body")
+        args = ast.arguments(
+            posonlyargs=[], args=[ast.arg(arg=v) for v in names],
+            kwonlyargs=[], kw_defaults=[], defaults=[])
+        cond_fn = ast.FunctionDef(
+            name=cname, args=args,
+            body=[ast.Return(value=node.test)], decorator_list=[],
+            returns=None, type_params=[])
+        body_fn = self._make_branch_fn(bname, node.body, names)
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(n) for n in names],
+                               ctx=ast.Store())],
+            value=ast.Call(
+                func=_load("__dy2static_convert_while"),
+                args=[_load(cname), _load(bname),
+                      ast.Tuple(elts=[_load(n) for n in names],
+                                ctx=ast.Load())],
+                keywords=[]))
+        return [cond_fn, body_fn, call]
+
+
+def ast_transform(fn):
+    """Rewrite fn's pythonic tensor control flow; returns the transformed
+    function, or fn unchanged when nothing needed rewriting or the source
+    is unavailable/unsupported (reference fallback behavior)."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    fdef.decorator_list = []  # run undecorated (to_static re-wraps)
+    arg_names = [a.arg for a in fdef.args.args + fdef.args.posonlyargs +
+                 fdef.args.kwonlyargs]
+    local_names = set(arg_names) | set(_assigned_names(fdef.body))
+    tr = _ControlFlowTransformer(local_names)
+    tr.visit(fdef)
+    if not tr.changed:
+        return fn
+    # a name first CREATED inside both branches would be unbound at the
+    # operand load; it is fn-local (assigned somewhere), so a top-of-body
+    # UNDEF initializer only converts UnboundLocalError into a placeholder
+    # (reference UndefinedVar hoisting)
+    uninit = sorted(tr.hoisted - set(arg_names))
+    inits = [ast.Assign(targets=[_store(n)],
+                        value=_load("__dy2static_UNDEF"))
+             for n in uninit]
+    fdef.body = inits + fdef.body
+    ast.fix_missing_locations(tree)
+    if fn.__closure__:
+        # closures: run against a SNAPSHOT with the cells flattened in by
+        # name (cells can't be re-attached to exec'd code). An empty cell
+        # (decoration before the helper is defined) or a freevar shadowing
+        # a module global is ambiguous — fall back to the original fn.
+        glb = dict(fn.__globals__)
+        try:
+            for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                if name in glb:
+                    return fn
+                glb[name] = cell.cell_contents
+        except ValueError:  # cell is empty at decoration time
+            return fn
+    else:
+        # no closure: share the LIVE module globals so helpers defined (or
+        # monkeypatched) after decoration resolve exactly like they would
+        # in the untransformed function
+        glb = fn.__globals__
+    glb["__dy2static_convert_ifelse"] = convert_ifelse
+    glb["__dy2static_convert_while"] = convert_while_loop
+    glb["__dy2static_UNDEF"] = UNDEF
+    try:
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        ns: dict = {}
+        exec(code, glb, ns)
+        new_fn = ns[fdef.name]
+    except Exception:
+        return fn  # reference behavior: fall back to the dygraph function
+    new_fn.__defaults__ = fn.__defaults__
+    new_fn.__kwdefaults__ = fn.__kwdefaults__
+    new_fn.__dict__.update(fn.__dict__)
+    new_fn.__wrapped_by_dy2static__ = fn
+    return new_fn

@@ -122,3 +122,36 @@ class TestOnnxVersion:
     def test_version_fields(self):
         assert paddle.version.full_version == paddle.__version__
         assert paddle.version.cuda() is False
+
+
+class TestDeviceEvents:
+    """Device event/stream surface (reference paddle.device.cuda.Event/
+    Stream over platform DeviceEvent; PJRT in-order-stream veneer)."""
+
+    def test_event_record_sync_elapsed(self):
+        import time
+
+        import paddle_tpu as paddle
+
+        start = paddle.device.Event()
+        start.record()
+        x = paddle.randn([256, 256])
+        y = (x @ x).sum()
+        end = paddle.device.Event()
+        end.record()
+        end.synchronize()
+        assert start.query() and end.query()
+        ms = start.elapsed_time(end)
+        assert ms >= 0.0
+        assert float(y.numpy()) == float(y.numpy())  # work completed
+
+    def test_stream_veneer(self):
+        import paddle_tpu as paddle
+
+        s = paddle.device.current_stream()
+        ev = s.record_event()
+        s.wait_event(ev)
+        s.synchronize()
+        with paddle.device.stream_guard(paddle.device.Stream()) as st:
+            st.synchronize()
+        assert paddle.device.cuda.Stream is paddle.device.Stream
