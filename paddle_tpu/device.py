@@ -108,9 +108,13 @@ class Event:
         def stamp():
             # stamp COMPLETION time asynchronously — record() stays async
             # and elapsed_time measures real enqueued-work duration even
-            # when the events are synchronized out of order
+            # when the events are synchronized out of order. Guarded by
+            # fence identity: a stale thread from a PREVIOUS record() on a
+            # reused event must not clobber the new recording's time.
             fence.block_until_ready()
-            self._time = _time.perf_counter()
+            t = _time.perf_counter()
+            if self._fence is fence and self._time is None:
+                self._time = t
 
         self._waiter = threading.Thread(target=stamp, daemon=True)
         self._waiter.start()

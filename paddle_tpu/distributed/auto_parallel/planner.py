@@ -174,10 +174,18 @@ class Planner:
         best = min(cands, key=lambda c: c.estimated_cost)
         return best, cands
 
-    def tune(self, step_builder, sample_batch, warmup=1, iters=2):
-        """Measured tuner (reference OptimizationTuner): for each candidate
+    def tune(self, step_builder, sample_batch, warmup=1, iters=2,
+             optimizer=None):
+        """Measured tuner (reference OptimizationTuner — which profiles in
+        a cloned context for exactly this reason): for each candidate
         apply → build a compiled step via `step_builder()` → time `iters`
         steps → keep the fastest plan applied and return it.
+
+        Profiling runs REAL optimizer steps, so model parameters (and,
+        when `optimizer` is passed, its accumulators + step counter) are
+        snapshotted up front and restored around every candidate — each
+        candidate profiles from identical state, and training starts from
+        the seeded initialization afterwards.
 
         step_builder: () -> callable(*sample_batch) running one train/eval
         step against the CURRENT model placement."""
@@ -186,9 +194,30 @@ class Planner:
                 lambda t: t._data if isinstance(t, Tensor) else t, out,
                 is_leaf=lambda t: isinstance(t, Tensor)))
 
+        params = [p for _, p in self.model.named_parameters()
+                  if p is not None]
+        saved_params = [p._data for p in params]
+        saved_accs = saved_step = None
+        if optimizer is not None:
+            saved_accs = {an: {k: t._data for k, t in store.items()}
+                          for an, store in optimizer._accumulators.items()}
+            saved_step = optimizer._opt_step
+
+        def restore():
+            for p, a in zip(params, saved_params):
+                p._data = a
+            if optimizer is not None:
+                for an, store in saved_accs.items():
+                    live = optimizer._accumulators.get(an, {})
+                    for k, a in store.items():
+                        if k in live:
+                            live[k]._data = a
+                optimizer._opt_step = saved_step
+
         cands = candidate_plans(self.model, self.mesh)
         results = []
         for cand in cands:
+            restore()
             apply_plan(self.model, cand, self.mesh)
             step = step_builder()
             out = None
@@ -203,5 +232,6 @@ class Planner:
             cand.estimated_cost = dt
             results.append((cand, dt))
         best = min(results, key=lambda r: r[1])[0]
+        restore()
         apply_plan(self.model, best, self.mesh)
         return best, results

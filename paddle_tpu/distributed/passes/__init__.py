@@ -307,6 +307,35 @@ class AutoParallelGradientMergePass(PassBase):
         return PassType.CALC_OPT
 
 
+# ----------------------------------------------------------------- grad clip
+@register_pass("auto_parallel_grad_clip")
+class AutoParallelGradClipPass(PassBase):
+    """Global-norm gradient clipping compiled into the program's optimizer
+    update (reference distributed/passes/auto_parallel_grad_clip.py — the
+    reference rewrites clip ops into the partitioned program with
+    cross-rank norm allreduces; here the clip joins the recorded minimize
+    request and the global norm is computed over the full logical grads,
+    so under the sharding pass GSPMD inserts the reduce). Attrs:
+    clip_norm (default 1.0)."""
+
+    def _apply_single_impl(self, main_program, startup_program, context):
+        from ...nn.clip import ClipGradByGlobalNorm
+
+        clip_norm = float(self.get_attr("clip_norm", 1.0))
+        n = 0
+        for opt, _loss in main_program.minimize_reqs:
+            opt._grad_clip = ClipGradByGlobalNorm(clip_norm)
+            n += 1
+        if n == 0:
+            raise ValueError(
+                "auto_parallel_grad_clip: program has no recorded "
+                "optimizer (call minimize before applying passes)")
+        context.set_attr("grad_clip:optimizers", n)
+
+    def _type(self):
+        return PassType.CALC_OPT
+
+
 # ------------------------------------------------------------------ sharding
 @register_pass("auto_parallel_sharding")
 class AutoParallelShardingPass(PassBase):
@@ -362,6 +391,11 @@ def apply_pass_by_strategy(main_program, strategy, startup_program=None):
         pm_list.append(new_pass("auto_parallel_gradient_merge",
                                 {"k_steps": cfg.get("k_steps", 2),
                                  "avg": cfg.get("avg", True)}))
+    clip_cfg = getattr(strategy, "gradient_clip_configs", None)
+    if clip_cfg:
+        pm_list.append(new_pass("auto_parallel_grad_clip",
+                                {"clip_norm": clip_cfg.get("clip_norm",
+                                                           1.0)}))
     pm = PassManager(pm_list)
     pm.apply([main_program], [startup_program])
     return pm.context

@@ -4,12 +4,13 @@ Reference: `python/paddle/jit/` — dy2static AST transpilation
 (`jit/dy2static/program_translator.py:299`), `paddle.jit.save/load` →
 inference programs (`jit/api.py`, `translated_layer.py`).
 
-TPU re-design: no AST surgery. Because every eager op dispatches to a pure
-JAX function and the autograd tape itself is jit-traceable, `to_static`
-simply functionalizes a Layer/function over its parameter/buffer/RNG state
-and hands it to `jax.jit` — Python control flow is unrolled at trace time
-(the same contract the reference's dy2static places on data-independent
-control flow). `TrainStep` compiles forward+backward+optimizer into ONE XLA
+TPU re-design: `to_static` functionalizes a Layer/function over its
+parameter/buffer/RNG state and hands it to `jax.jit`. Data-INdependent
+Python control flow is unrolled at trace time; data-DEPENDENT `if`/`while`
+over tensor values is AST-rewritten first by `jit.dy2static.ast_transform`
+into `lax.cond`/`lax.while_loop` conversion calls (runtime-dispatched, so
+eager/python semantics are untouched; unconvertible functions fall back
+unchanged). `TrainStep` compiles forward+backward+optimizer into ONE XLA
 executable — the TPU answer to the reference's per-op executor overhead and
 the engine under bench.py.
 

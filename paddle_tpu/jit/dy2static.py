@@ -303,6 +303,16 @@ def ast_transform(fn):
     """Rewrite fn's pythonic tensor control flow; returns the transformed
     function, or fn unchanged when nothing needed rewriting or the source
     is unavailable/unsupported (reference fallback behavior)."""
+    if inspect.ismethod(fn):
+        # bound methods (the Layer.forward path — to_static's primary
+        # consumer): transform the underlying function, re-bind to the
+        # same instance
+        import types
+
+        transformed = ast_transform(fn.__func__)
+        if transformed is fn.__func__:
+            return fn
+        return types.MethodType(transformed, fn.__self__)
     try:
         src = textwrap.dedent(inspect.getsource(fn))
         tree = ast.parse(src)

@@ -265,8 +265,16 @@ class TestPlanner:
         rng = np.random.default_rng(0)
         x = paddle.to_tensor(rng.normal(size=(16, 32)).astype(np.float32))
         y = paddle.to_tensor(rng.normal(size=(16, 8)).astype(np.float32))
+        p0 = [np.asarray(pp) for _, pp in model.named_parameters()
+              if pp is not None]
         planner = Planner(model, mesh)
-        best, results = planner.tune(step_builder, (x, y))
+        best, results = planner.tune(step_builder, (x, y),
+                                     optimizer=opt)
+        # profiling must not have moved the params (state restored)
+        p1 = [np.asarray(pp) for _, pp in model.named_parameters()
+              if pp is not None]
+        for a, b in zip(p0, p1):
+            np.testing.assert_array_equal(a, b)
         assert len(results) == 3
         assert best.estimated_cost == min(dt for _, dt in results)
         # model still trains under the winning plan

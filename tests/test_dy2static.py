@@ -206,3 +206,29 @@ def _late_fn(x):
 
 def _late_helper(t):  # defined AFTER the decorated fn: live-globals path
     return t * 2.0
+
+
+class TestLayerForward:
+    def test_layer_with_tensor_control_flow(self):
+        # the PRIMARY to_static consumer: a Layer whose forward branches
+        # on a tensor value (bound-method transform path)
+        import paddle_tpu.nn as nn
+
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if paddle.mean(h) > 0:
+                    y = h * 2.0
+                else:
+                    y = -h
+                return y
+
+        paddle.seed(5)
+        layer = paddle.jit.to_static(Gate())
+        out = layer(paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert list(out.shape) == [2, 4]
+        assert np.isfinite(out.numpy()).all()

@@ -155,3 +155,14 @@ class TestDeviceEvents:
         with paddle.device.stream_guard(paddle.device.Stream()) as st:
             st.synchronize()
         assert paddle.device.cuda.Stream is paddle.device.Stream
+
+    def test_event_reuse_across_records(self):
+        import paddle_tpu as paddle
+
+        ev = paddle.device.Event()
+        for _ in range(3):  # reused event: stale stamp threads must not
+            ev.record()     # clobber the new recording's time
+            x = (paddle.randn([64, 64]) @ paddle.randn([64, 64])).sum()
+            ev.synchronize()
+            assert ev.query()
+            float(x.numpy())

@@ -304,3 +304,41 @@ class TestStrategyComposition:
             np.testing.assert_allclose(base, combo, rtol=5e-2, atol=5e-2)
         finally:
             paddle.disable_static()
+
+
+class TestGradClipPass:
+    def test_clip_bounds_update_magnitude(self):
+        try:
+            paddle.seed(9)
+            paddle.static.global_scope().vars.clear()
+            # huge targets -> huge grads; clip_norm must bound the step
+            main, startup, loss = _build_mlp_program(lr=1.0)
+            ctx = new_pass("auto_parallel_grad_clip",
+                           {"clip_norm": 0.1}).apply([main])
+            assert ctx.get_attr("grad_clip:optimizers") == 1
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            scope = paddle.static.global_scope()
+            rng = np.random.default_rng(2)
+            feed = {"x": rng.normal(size=(8, 16)).astype(np.float32),
+                    "y": (rng.normal(size=(8, 1)) * 1e4).astype(np.float32)}
+            before = {pv.name: np.asarray(init).copy()
+                      for pv, init in main.params}
+            exe.run(main, feed=feed, fetch_list=[loss])
+            total_sq = 0.0
+            for pv, _ in main.params:
+                delta = np.asarray(scope.vars[pv.name]) - before[pv.name]
+                total_sq += float((delta ** 2).sum())
+            # lr=1.0, global grad norm clipped to 0.1 -> update norm <= 0.1
+            assert np.sqrt(total_sq) <= 0.1 + 1e-5
+        finally:
+            paddle.disable_static()
+
+    def test_no_optimizer_raises(self):
+        try:
+            paddle.enable_static()
+            prog = paddle.static.Program()
+            with pytest.raises(ValueError, match="no recorded optimizer"):
+                new_pass("auto_parallel_grad_clip").apply([prog])
+        finally:
+            paddle.disable_static()
