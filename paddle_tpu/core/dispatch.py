@@ -25,6 +25,7 @@ import jax
 
 from . import autograd as ag
 from . import flags as _flags
+from . import lazy as _lazy
 from .tensor import Tensor
 
 # Pluggable hooks -------------------------------------------------------------
@@ -119,12 +120,16 @@ def _wrap_out(arrays, node, multi):
         t = Tensor(arrays, stop_gradient=node is None)
         if node is not None:
             t._grad_node, t._out_idx = node, 0
+        if isinstance(arrays, _lazy.LazyArray):
+            arrays.owners.add(t)  # lazy keep-mask: Tensor owns this output
         return t
     outs = []
     for i, a in enumerate(arrays):
         t = Tensor(a, stop_gradient=node is None)
         if node is not None:
             t._grad_node, t._out_idx = node, i
+        if isinstance(a, _lazy.LazyArray):
+            a.owners.add(t)
         outs.append(t)
     return tuple(outs)
 
@@ -146,13 +151,29 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
         if out is not NotImplemented:
             return out
 
-    arrays = [unwrap(x) for x in inputs]
-
     needs_grad = (
         not nondiff
         and ag.is_grad_enabled()
         and any(isinstance(t, Tensor) and not t.stop_gradient for t in inputs)
     )
+
+    # Lazy eager mode (core/lazy.py): record instead of execute; one
+    # compiled segment per materialization. Gated to the cases laziness is
+    # known-safe for: no tape, no autocast plan, no nan-scan, and
+    # cache-keyable kernels + attrs (keys computed ONCE here, reused by
+    # the node and the segment signature).
+    if _lazy.enabled() and not needs_grad \
+            and amp_cast_hook is None \
+            and not _flags._FLAGS["FLAGS_check_nan_inf"]:
+        lkey = _lazy.fn_key(fn)
+        lattrs = _lazy.attrs_key(attrs) if lkey is not None else None
+        if lkey is not None and lattrs is not None:
+            out = _lazy.build(fn, name, [unwrap(x) for x in inputs],
+                              attrs, lkey, lattrs)
+            return _wrap_out(out, None, isinstance(out, tuple))
+
+    # any lazy payload reaching a non-lazy path is forced here
+    arrays = [_lazy.force(unwrap(x)) for x in inputs]
 
     # AMP cast. On the no-grad path, cast the arrays directly. On the grad
     # path the cast must happen INSIDE the traced function so jax.vjp sees it

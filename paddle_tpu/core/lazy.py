@@ -1,0 +1,392 @@
+"""Lazy eager mode — the dygraph-on-TPU latency answer (SURVEY §7 hard
+part #1; round-2 VERDICT weak #5).
+
+Reference context: the reference's whole PHI/eager design exists to make
+per-op dispatch cheap on CPU/GPU; over a remote TPU runtime each eager op
+costs a round trip, so per-op eager is structurally slow no matter how
+lean the dispatch is. The TPU-native answer is LAZY accumulation: under
+`paddle.incubate.lazy_eval()` eager ops record into
+a thread-local expression graph instead of executing; the first
+materialization (numpy()/item()/float()/print or any concrete use)
+compiles the ENTIRE accumulated segment as one XLA executable and runs it
+in a single device round trip. Executables are cached by graph structure
+(op identity + attrs + topology + leaf avals), so steady-state loops reuse
+the compiled segment — eager-looking code, compiled execution.
+
+Scope (documented, enforced by dispatch.forward's gate): applies to
+no-grad, no-AMP-cast, non-recorded ops. Ops needing the tape, an autocast
+plan, or the static recorder run eagerly (lazy inputs are forced first),
+so correctness never depends on laziness.
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+__all__ = ["LazyArray", "enabled", "lazy_guard", "build", "force",
+           "stats"]
+
+_state = threading.local()
+
+# structure-key -> jitted replay fn; shared across segments/threads.
+# Bounded LRU: long-lived serving loops with varying shapes must not
+# accumulate executables forever (same reason dispatch._jitted is an
+# lru_cache).
+from collections import OrderedDict
+
+_exec_cache: OrderedDict = OrderedDict()
+_EXEC_CACHE_MAX = 512
+_counters = {"materializations": 0, "cache_hits": 0, "nodes_built": 0}
+
+
+def enabled():
+    return getattr(_state, "on", False)
+
+
+class lazy_guard:
+    """Context manager enabling lazy eager accumulation."""
+
+    def __init__(self, flag=True):
+        self._flag = bool(flag)
+
+    def __enter__(self):
+        self._prev = enabled()
+        _state.on = self._flag
+        return self
+
+    def __exit__(self, *exc):
+        _state.on = self._prev
+        return False
+
+
+def stats():
+    """Counters for tests/diagnostics."""
+    return dict(_counters)
+
+
+# strong refs for id-keyed objects (jnp singleton fns AND code objects):
+# a collected object's id could be reused by a DIFFERENT one, turning a
+# cache key into a silently-wrong hit
+_pinned: dict = {}
+
+
+def attrs_key(attrs):
+    """Hashable key for an op's attrs, converting (nested) lists to tuples
+    — shape/perm/axes lists are the bread-and-butter attrs of
+    manipulation ops and must not force a lazy bail-out."""
+    def conv(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(conv(x) for x in v)
+        return v
+
+    try:
+        items = tuple(sorted((k, conv(v)) for k, v in attrs.items()))
+        hash(items)
+        return items
+    except TypeError:
+        return None
+
+
+def fn_key(fn):
+    """Stable hashable identity for an op kernel, or None when the fn
+    can't be cached. Op kernels here are python functions (module-level or
+    per-call closures capturing STATIC attrs — the code object is defined
+    once, so (code, captured cells) identifies the computation; per-call
+    lambda IDENTITY does not) or jnp/lax callables without __code__
+    (module singletons: identity IS the key, pinned against id reuse)."""
+    code = getattr(fn, "__code__", None)
+    if len(_pinned) > 8192:
+        return None  # runaway distinct callables: stop pinning/caching
+    if code is None:
+        _pinned[id(fn)] = fn
+        return ("id", id(fn))
+    cells = ()
+    if fn.__closure__:
+        try:
+            cells = tuple(c.cell_contents for c in fn.__closure__)
+            hash(cells)
+        except (ValueError, TypeError):
+            return None  # empty cell / unhashable capture (e.g. an array)
+    _pinned[id(code)] = code  # dynamically-created code can be GC'd too
+    return (id(code), cells)
+
+
+_aval_cache: dict = {}
+
+
+def _infer_avals(fn, key, attrs, inputs, attrs_key):
+    """(multi, avals) via eval_shape, cached by (fn key, attrs, input
+    avals) — a steady-state lazy loop must not re-trace abstractly at
+    every record."""
+    in_avals = tuple(_aval_of(i) for i in inputs)
+    ck = None
+    if key is not None and attrs_key is not None:
+        ck = (key, attrs_key,
+              tuple((a.shape, str(a.dtype)) for a in in_avals))
+        hit = _aval_cache.get(ck)
+        if hit is not None:
+            return hit
+    out_aval = jax.eval_shape(lambda *xs: fn(*xs, **attrs), *in_avals)
+    multi = isinstance(out_aval, (tuple, list))
+    res = (multi, tuple(out_aval) if multi else (out_aval,))
+    if ck is not None:
+        if len(_aval_cache) > 8192:
+            _aval_cache.clear()
+        _aval_cache[ck] = res
+    return res
+
+
+class _Node:
+    """One recorded op: fn(*inputs, **attrs) -> n_outputs arrays."""
+
+    __slots__ = ("fn", "attrs", "inputs", "name", "avals", "values",
+                 "multi", "key", "attrs_key", "refs")
+
+    def __init__(self, fn, attrs, inputs, name, key, attrs_key):
+        import weakref
+
+        self.fn = fn
+        self.attrs = attrs
+        self.inputs = inputs  # list of LazyArray | concrete array
+        self.name = name
+        self.key = key  # precomputed by the dispatch gate (hot path)
+        self.attrs_key = attrs_key
+        self.multi, self.avals = _infer_avals(fn, key, attrs, inputs,
+                                              attrs_key)
+        self.values = None  # tuple of jax.Array once materialized
+        self.refs = weakref.WeakSet()  # live LazyArrays viewing this node
+
+
+def _aval_of(x):
+    if isinstance(x, LazyArray):
+        return x.aval
+    return jax.api_util.shaped_abstractify(x) if not hasattr(x, "aval") \
+        else jax.ShapeDtypeStruct(x.shape, x.dtype)
+
+
+class LazyArray:
+    """Deferred array: shape/dtype known (via eval_shape), payload computed
+    on first concrete use. Quacks like a jax.Array for the metadata the
+    framework reads; any numeric coercion materializes the segment."""
+
+    __slots__ = ("node", "idx", "owners", "__weakref__")
+
+    def __init__(self, node, idx=0):
+        import weakref
+
+        self.node = node
+        self.idx = idx
+        self.owners = weakref.WeakSet()  # Tensors holding this payload
+        node.refs.add(self)
+
+    # ---- metadata (no materialization) ----
+    @property
+    def aval(self):
+        return self.node.avals[self.idx]
+
+    @property
+    def shape(self):
+        return self.node.avals[self.idx].shape
+
+    @property
+    def dtype(self):
+        return self.node.avals[self.idx].dtype
+
+    @property
+    def ndim(self):
+        return len(self.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.shape)) if self.shape else 1
+
+    # ---- materialization ----
+    def _force(self):
+        if self.node.values is None:
+            _materialize(self.node)
+        return self.node.values[self.idx]
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        return self._force()
+
+    def astype(self, dtype):
+        return self._force().astype(dtype)
+
+    def block_until_ready(self):
+        return self._force().block_until_ready()
+
+    @property
+    def sharding(self):
+        return self._force().sharding
+
+    def __repr__(self):
+        state = "pending" if self.node.values is None else "ready"
+        return (f"LazyArray(shape={tuple(self.shape)}, dtype={self.dtype}, "
+                f"{state})")
+
+    def __float__(self):
+        return float(self._force())
+
+    def __int__(self):
+        return int(self._force())
+
+    def __bool__(self):
+        return bool(self._force())
+
+
+def force(x):
+    """Concrete array for x (materializing a LazyArray)."""
+    if isinstance(x, LazyArray):
+        return x._force()
+    return x
+
+
+def build(fn, name, input_arrays, attrs, key, attrs_key):
+    """Record one op over (Lazy or concrete) input arrays; returns a
+    LazyArray (or tuple of them for multi-output fns). `key`/`attrs_key`
+    come precomputed from the dispatch gate (both are non-None there)."""
+    node = _Node(fn, attrs, list(input_arrays), name, key, attrs_key)
+    _counters["nodes_built"] += 1
+    if node.multi:
+        return tuple(LazyArray(node, i) for i in range(len(node.avals)))
+    return LazyArray(node, 0)
+
+
+def _collect(root):
+    """Topological order of unmaterialized nodes feeding `root` —
+    iterative (lazy mode exists to accumulate LONG segments; recursive
+    DFS would hit the Python recursion limit around 1000 ops)."""
+    topo, seen = [], set()
+    stack = [(root, False)]
+    while stack:
+        node, expanded = stack.pop()
+        if expanded:
+            topo.append(node)
+            continue
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.append((node, True))
+        for inp in node.inputs:
+            if isinstance(inp, LazyArray) and inp.node.values is None \
+                    and id(inp.node) not in seen:
+                stack.append((inp.node, False))
+    return topo
+
+
+def _signature(topo):
+    """Hashable structure key + flat leaf list for the segment."""
+    index = {id(n): i for i, n in enumerate(topo)}
+    leaves = []
+    sig = []
+    cacheable = True
+    for n in topo:
+        in_sig = []
+        for inp in n.inputs:
+            if isinstance(inp, LazyArray) and inp.node.values is None:
+                in_sig.append(("n", index[id(inp.node)], inp.idx))
+            else:
+                arr = force(inp)
+                in_sig.append(("l", len(leaves)))
+                leaves.append(arr)
+        # keys are enforced non-None by the dispatch gate; the guard stays
+        # for direct build() callers — but the leaf list must ALWAYS be
+        # complete (the replay indexes into it) so collection continues
+        if n.attrs_key is None or n.key is None:
+            cacheable = False
+        else:
+            sig.append((n.key, n.name, n.attrs_key, tuple(in_sig),
+                        len(n.avals)))
+    if not cacheable:
+        return None, leaves
+    leaf_avals = tuple((np.shape(a), np.result_type(a).str)
+                       for a in leaves)
+    return (tuple(sig), leaf_avals), leaves
+
+
+def _make_replay(topo_template, keep):
+    """Build a pure replay fn for a segment STRUCTURE: takes the flat leaf
+    list, returns outputs only for `keep`-marked nodes (the root plus
+    nodes with live external LazyArray references) — purely-internal
+    intermediates stay inside the jit where XLA fuses/DCEs them instead
+    of forcing one HBM output buffer per op."""
+    # capture per-node (fn, attrs, input wiring) — structure only
+    wiring = []
+    index = {id(n): i for i, n in enumerate(topo_template)}
+    for n in topo_template:
+        ins = []
+        for inp in n.inputs:
+            if isinstance(inp, LazyArray) and inp.node.values is None:
+                ins.append(("n", index[id(inp.node)], inp.idx))
+            else:
+                ins.append(("l", None))  # position assigned at call
+        wiring.append((n.fn, dict(n.attrs), ins, len(n.avals)))
+
+    def replay(leaves):
+        env = []
+        li = 0
+        nonlocal_leaves = list(leaves)
+        for fn, attrs, ins, n_out in wiring:
+            args = []
+            for kind, *ref in ins:
+                if kind == "n":
+                    args.append(env[ref[0]][ref[1]])
+                else:
+                    args.append(nonlocal_leaves[li])
+                    li += 1
+            out = fn(*args, **attrs)
+            env.append(tuple(out) if isinstance(out, (tuple, list))
+                       else (out,))
+        return tuple(e for e, k in zip(env, keep) if k)
+
+    return jax.jit(replay)
+
+
+def _materialize(root):
+    """Compile + run the whole pending segment feeding `root` in one
+    device round trip, filling values for externally-referenced nodes."""
+    topo = _collect(root)
+    # keep = nodes whose outputs are OWNED by a live Tensor (registered
+    # by dispatch._wrap_out) or the root: only those become executable
+    # outputs; consumer-wiring references alone don't count, so dead
+    # intermediates stay inside the jit for XLA to fuse/DCE. An
+    # under-count is safe: an unkept node keeps its graph and recomputes
+    # on a late force (see below).
+    keep = tuple(
+        n is root or any(len(la.owners) > 0 for la in n.refs)
+        for n in topo)
+    key, leaves = _signature(topo)
+    if key is not None:
+        key = (key, keep)
+    _counters["materializations"] += 1
+    compiled = _exec_cache.get(key) if key is not None else None
+    if compiled is not None:
+        _exec_cache.move_to_end(key)
+        _counters["cache_hits"] += 1
+    else:
+        compiled = _make_replay(topo, keep)
+        if key is not None:
+            _exec_cache[key] = compiled
+            if len(_exec_cache) > _EXEC_CACHE_MAX:
+                _exec_cache.popitem(last=False)
+    outs = compiled(leaves)
+    kept = [n for n, k in zip(topo, keep) if k]
+    for n, vals in zip(kept, outs):
+        n.values = tuple(vals)
+    # break the graph for MATERIALIZED nodes: a surviving output Tensor
+    # must pin only its own node's values, not every upstream
+    # intermediate/leaf of the segment. Unkept nodes keep their wiring so
+    # a late force (an ownership path the WeakSet missed) recomputes
+    # correctly instead of crashing.
+    for n, k in zip(topo, keep):
+        if k:
+            n.fn = None
+            n.attrs = None
+            n.inputs = ()

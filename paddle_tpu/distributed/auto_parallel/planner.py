@@ -207,11 +207,21 @@ class Planner:
             for p, a in zip(params, saved_params):
                 p._data = a
             if optimizer is not None:
-                for an, store in saved_accs.items():
-                    live = optimizer._accumulators.get(an, {})
-                    for k, a in store.items():
-                        if k in live:
-                            live[k]._data = a
+                # restore snapshotted accumulator values AND drop entries
+                # (or whole stores) that profiling lazily created — else
+                # training would start with Adam moments pre-warmed by the
+                # last profiled candidate while _opt_step says 0
+                for an in list(optimizer._accumulators):
+                    snap = saved_accs.get(an)
+                    if snap is None:
+                        del optimizer._accumulators[an]
+                        continue
+                    store = optimizer._accumulators[an]
+                    for k in list(store):
+                        if k in snap:
+                            store[k]._data = snap[k]
+                        else:
+                            del store[k]
                 optimizer._opt_step = saved_step
 
         cands = candidate_plans(self.model, self.mesh)

@@ -319,18 +319,17 @@ class AutoParallelGradClipPass(PassBase):
     clip_norm (default 1.0)."""
 
     def _apply_single_impl(self, main_program, startup_program, context):
-        from ...nn.clip import ClipGradByGlobalNorm
-
-        clip_norm = float(self.get_attr("clip_norm", 1.0))
-        n = 0
-        for opt, _loss in main_program.minimize_reqs:
-            opt._grad_clip = ClipGradByGlobalNorm(clip_norm)
-            n += 1
-        if n == 0:
+        if not main_program.minimize_reqs:
             raise ValueError(
                 "auto_parallel_grad_clip: program has no recorded "
                 "optimizer (call minimize before applying passes)")
-        context.set_attr("grad_clip:optimizers", n)
+        # program-level state consumed by the Executor at step time (like
+        # grad_merge_k): clones share the live optimizer object, so
+        # mutating opt._grad_clip here would leak the clip into the
+        # original program and any eager use of the same optimizer
+        main_program.grad_clip_norm = float(self.get_attr("clip_norm", 1.0))
+        context.set_attr("grad_clip:optimizers",
+                         len(main_program.minimize_reqs))
 
     def _type(self):
         return PassType.CALC_OPT

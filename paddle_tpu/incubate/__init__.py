@@ -9,3 +9,23 @@ from .optimizer import ModelAverage  # noqa: F401
 # importing it registers ForkingPickler reducers that change how Tensors
 # pickle across processes (single-consumer shm segments). Like the
 # reference, `import paddle.incubate.multiprocessing` is the opt-in.
+
+
+def lazy_eval(flag=True):
+    """Lazy eager accumulation (core/lazy.py): inside the context, eager
+    ops record into an expression graph and the first concrete use
+    compiles the whole segment as ONE XLA executable (cached by graph
+    structure) — the dygraph-on-TPU latency answer. No-grad / no-autocast
+    ops only; everything else transparently runs eagerly.
+
+        with paddle.no_grad(), paddle.incubate.lazy_eval():
+            y = model(x)          # no device round trips yet
+        print(y.numpy())          # one compiled segment executes
+
+    Combine with `paddle.no_grad()` (or stop_gradient inputs): ops the
+    tape must see run eagerly by design, so a bare training loop inside
+    lazy_eval gains nothing (and loses nothing — it stays correct).
+    """
+    from ..core.lazy import lazy_guard
+
+    return lazy_guard(flag)

@@ -132,23 +132,26 @@ class Optimizer:
     def _static_acc_names(self):
         return type(self)._STATIC_ACCS
 
-    def _static_apply(self, oi, step_arr, pairs, state):
+    def _static_apply(self, oi, step_arr, pairs, state, grad_clip=None):
         """Apply updates inside an Executor trace (static/executor.py).
 
         pairs: [(Variable, traced param Tensor with .grad set)]. Accumulators
         are seeded from / written back to `state` (the Scope-backed dict), so
         the whole optimizer step compiles into the program's XLA executable —
         the reference needed per-op optimizer kernels + a program rewrite pass
-        (fleet/meta_optimizers) for the same effect.
+        (fleet/meta_optimizers) for the same effect. `grad_clip` overrides
+        self._grad_clip for program-level clip (auto_parallel_grad_clip
+        pass) without mutating this shared optimizer object.
         """
         prev_step = self._opt_step
         self._opt_step = step_arr
+        clip = grad_clip if grad_clip is not None else self._grad_clip
         try:
             pg = [(pt, pt.grad) for _, pt in pairs if pt.grad is not None]
             if self._weight_decay is not None:
                 pg = [(p, self._weight_decay(p, g)) for p, g in pg]
-            if self._grad_clip is not None:
-                pg = self._grad_clip(pg)
+            if clip is not None:
+                pg = clip(pg)
             grads = {id(p): g for p, g in pg}
             for pv, pt in pairs:
                 g = grads.get(id(pt))

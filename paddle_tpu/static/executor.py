@@ -62,6 +62,15 @@ class _CompiledStep:
         self.opt_state_names: list[str] = []
         if self.has_opt:
             self._init_opt_state()
+        # auto_parallel_grad_clip pass: program-level clip threaded into
+        # the optimizer update without mutating the shared optimizer
+        clip_norm = getattr(program, "grad_clip_norm", None)
+        if clip_norm is not None:
+            from ..nn.clip import ClipGradByGlobalNorm
+
+            self._prog_clip = ClipGradByGlobalNorm(float(clip_norm))
+        else:
+            self._prog_clip = None
         # sharding pass: compile the step over a 'sharding' mesh —
         # built lazily at first run (shardings depend on feed shapes)
         self.sharding_degree = int(getattr(program, "sharding_degree", 1))
@@ -173,7 +182,7 @@ class _CompiledStep:
                 opt._static_apply(
                     oi, step_arr,
                     [(pv, param_tensors[pv.name]) for pv in trainables],
-                    new_opt)
+                    new_opt, grad_clip=self._prog_clip)
 
         fetches = tuple(env[v.vid]._data for v in self.fetch_vars)
         if low is not None:
@@ -217,7 +226,8 @@ class _CompiledStep:
         pre_state = {n: new_opt[n] for n in opt_keys}
         step_arr = new_opt[f"@opt{oi}@step"] + jnp.where(found, 0.0, 1.0)
         new_opt[f"@opt{oi}@step"] = step_arr
-        opt._static_apply(oi, step_arr, pairs, new_opt)
+        opt._static_apply(oi, step_arr, pairs, new_opt,
+                          grad_clip=self._prog_clip)
         for pv, mt in pairs:
             mt._data = jnp.where(found, pre_params[pv.name], mt._data)
             masters[pv.name] = mt._data
@@ -273,7 +283,8 @@ class _CompiledStep:
         step_arr = new_opt[f"@opt{oi}@step"] + \
             jnp.where(apply_flag, 1.0, 0.0)
         new_opt[f"@opt{oi}@step"] = step_arr
-        opt._static_apply(oi, step_arr, pairs, new_opt)
+        opt._static_apply(oi, step_arr, pairs, new_opt,
+                          grad_clip=self._prog_clip)
         for pv, pt in pairs:
             pt._data = jnp.where(apply_flag, pt._data, pre_params[pv.name])
         for n in opt_keys:
