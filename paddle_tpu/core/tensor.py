@@ -146,7 +146,15 @@ class Tensor:
     cast = astype
 
     def cpu(self):
-        return Tensor(jax.device_put(self._data, jax.devices("cpu")[0]),
+        from .dispatch import note as _note
+        _note('cpu')
+        try:
+            dev = jax.devices("cpu")[0]
+        except RuntimeError:
+            # JAX_PLATFORMS may exclude the cpu backend (the driver pins
+            # axon-only; same fallback as __graft_entry__.entry)
+            dev = jax.devices()[0]
+        return Tensor(jax.device_put(self._data, dev),
                       stop_gradient=self.stop_gradient)
 
     def tpu(self, device_id=0):

@@ -246,6 +246,7 @@ class _RNNBase(Layer):
                                           default_initializer=init))
 
     def forward(self, inputs, initial_states=None, sequence_length=None):
+        _note('rnn')
         mode = self.mode
         L, D, H = self.num_layers, self.num_directions, self.hidden_size
         is_lstm = mode == "LSTM"

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..core.dispatch import forward
+from ..core.dispatch import note as _note
 from ..core.tensor import Tensor
 from .math import _binary
 
@@ -55,6 +56,7 @@ def equal_all(x, y, name=None):
 
 
 def is_empty(x, name=None):
+    _note('is_empty')
     return Tensor(np.asarray(x.size == 0))
 
 

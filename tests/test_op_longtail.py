@@ -728,3 +728,26 @@ class TestMiscLayers:
         outs = paddle.broadcast_tensors(
             [paddle.to_tensor(_f(1, 4)), paddle.to_tensor(_f(3, 1))])
         assert list(outs[0].shape) == [3, 4]
+
+
+class TestProposalsAndMethods:
+    def test_generate_proposals_runs(self):
+        from paddle_tpu.vision import ops as vops
+
+        scores = paddle.to_tensor(_unit(1, 3, 4, 4))
+        deltas = paddle.to_tensor(_f(1, 12, 4, 4) * 0.1)
+        img_size = paddle.to_tensor(np.array([[32.0, 32.0]], np.float32))
+        anchors = paddle.to_tensor(_pos(4, 4, 3, 4) * 8)
+        variances = paddle.to_tensor(np.ones((4, 4, 3, 4), np.float32))
+        rois = vops.generate_proposals(scores, deltas, img_size, anchors,
+                                       variances, pre_nms_top_n=12,
+                                       post_nms_top_n=6)
+        boxes = rois[0] if isinstance(rois, (tuple, list)) else rois
+        arr = np.asarray(boxes.numpy())
+        assert arr.shape[-1] == 4 and arr.shape[0] <= 6  # [R<=post_nms, 4]
+        assert np.isfinite(arr).all()
+
+    def test_tensor_cpu_method(self):
+        t = paddle.to_tensor(_f(2, 2))
+        c = t.cpu()
+        assert np.isfinite(np.asarray(c.numpy())).all()

@@ -106,3 +106,16 @@ class TestStaticCollectives:
 
         out = _run_static(build, {"x": x})
         np.testing.assert_allclose(out, x)
+
+    def test_eager_all_gather(self, group):
+        """Eager collective.all_gather: output list holds each rank-shard
+        of the group-sharded leading dim (reference
+        communication/all_gather.py semantics under SPMD)."""
+        n = group.nranks
+        x = np.arange(n * 3, dtype=np.float32).reshape(n, 3)
+        outs = []
+        collective.all_gather(outs, paddle.to_tensor(x), group=group)
+        assert len(outs) == n
+        np.testing.assert_allclose(
+            np.concatenate([np.asarray(o.numpy()).reshape(1, -1)
+                            for o in outs], 0).reshape(n, 3), x, rtol=1e-6)
