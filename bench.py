@@ -92,6 +92,14 @@ def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16",
     # tuned library flash-attention kernel (see ops/pallas_ops._stock_flash)
     os.environ.setdefault("PADDLE_TPU_X64", "0")
     os.environ.setdefault("PADDLE_TPU_MATMUL_PRECISION", "default")
+    # persistent compilation cache: a re-run of a previously-compiled rung
+    # skips its 30-90 s XLA compile — on a flaky tunnel, the difference
+    # between banking a number and a watchdog timeout (r4 lesson)
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          os.path.join(os.path.dirname(
+                              os.path.abspath(__file__)), ".jax_cache"))
+    os.environ.setdefault(
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
     import jax
     import paddle_tpu as paddle
     from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,

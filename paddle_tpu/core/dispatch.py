@@ -36,6 +36,13 @@ static_recorder = None
 # into the traced function without materializing throwaway casted arrays.
 amp_cast_hook = None
 
+# dy2static capture probe: when set, every grad-requiring input Tensor of
+# every dispatched op is reported — jit/dy2static.py uses an abstract trace
+# with this hook to discover closure tensors (layer params accessed via
+# attribute) a control-flow region reads, so it can functionalize them into
+# region inputs instead of silently dropping their gradients.
+capture_sink = None
+
 # Op-coverage recorder: PADDLE_TPU_OP_COVERAGE=<path> records every op name
 # dispatched in this process and writes the set at exit — consumed by
 # tools/gen_ops_coverage.py to mark ops as exercised by the test suite.
@@ -145,6 +152,11 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
 
     if _coverage_sink is not None:
         _coverage_sink.add(name)
+
+    if capture_sink is not None:
+        for t in inputs:
+            if isinstance(t, Tensor) and not t.stop_gradient:
+                capture_sink(t)
 
     if static_recorder is not None:
         out = static_recorder(fn, name, inputs, attrs, nondiff)

@@ -41,6 +41,12 @@ _exec_cache: OrderedDict = OrderedDict()
 _EXEC_CACHE_MAX = 512
 _counters = {"materializations": 0, "cache_hits": 0, "nodes_built": 0}
 
+# The lazy ON/OFF state is thread-local but the caches above are shared;
+# concurrent materialization from two threads would interleave OrderedDict
+# LRU surgery and dict size-then-clear sequences (ADVICE r3). One lock over
+# the tiny mutation sections — compilation and replay run outside it.
+_lock = threading.Lock()
+
 
 def enabled():
     return getattr(_state, "on", False)
@@ -101,7 +107,8 @@ def fn_key(fn):
     if len(_pinned) > 8192:
         return None  # runaway distinct callables: stop pinning/caching
     if code is None:
-        _pinned[id(fn)] = fn
+        with _lock:
+            _pinned[id(fn)] = fn
         return ("id", id(fn))
     cells = ()
     if fn.__closure__:
@@ -110,7 +117,8 @@ def fn_key(fn):
             hash(cells)
         except (ValueError, TypeError):
             return None  # empty cell / unhashable capture (e.g. an array)
-    _pinned[id(code)] = code  # dynamically-created code can be GC'd too
+    with _lock:
+        _pinned[id(code)] = code  # dynamically-created code can be GC'd too
     return (id(code), cells)
 
 
@@ -126,16 +134,18 @@ def _infer_avals(fn, key, attrs, inputs, attrs_key):
     if key is not None and attrs_key is not None:
         ck = (key, attrs_key,
               tuple((a.shape, str(a.dtype)) for a in in_avals))
-        hit = _aval_cache.get(ck)
+        with _lock:
+            hit = _aval_cache.get(ck)
         if hit is not None:
             return hit
     out_aval = jax.eval_shape(lambda *xs: fn(*xs, **attrs), *in_avals)
     multi = isinstance(out_aval, (tuple, list))
     res = (multi, tuple(out_aval) if multi else (out_aval,))
     if ck is not None:
-        if len(_aval_cache) > 8192:
-            _aval_cache.clear()
-        _aval_cache[ck] = res
+        with _lock:
+            if len(_aval_cache) > 8192:
+                _aval_cache.clear()
+            _aval_cache[ck] = res
     return res
 
 
@@ -365,17 +375,19 @@ def _materialize(root):
     key, leaves = _signature(topo)
     if key is not None:
         key = (key, keep)
-    _counters["materializations"] += 1
-    compiled = _exec_cache.get(key) if key is not None else None
-    if compiled is not None:
-        _exec_cache.move_to_end(key)
-        _counters["cache_hits"] += 1
-    else:
-        compiled = _make_replay(topo, keep)
+    with _lock:
+        _counters["materializations"] += 1
+        compiled = _exec_cache.get(key) if key is not None else None
+        if compiled is not None:
+            _exec_cache.move_to_end(key)
+            _counters["cache_hits"] += 1
+    if compiled is None:
+        compiled = _make_replay(topo, keep)  # compile outside the lock
         if key is not None:
-            _exec_cache[key] = compiled
-            if len(_exec_cache) > _EXEC_CACHE_MAX:
-                _exec_cache.popitem(last=False)
+            with _lock:
+                _exec_cache[key] = compiled
+                if len(_exec_cache) > _EXEC_CACHE_MAX:
+                    _exec_cache.popitem(last=False)
     outs = compiled(leaves)
     kept = [n for n, k in zip(topo, keep) if k]
     for n, vals in zip(kept, outs):

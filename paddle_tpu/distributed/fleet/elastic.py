@@ -40,7 +40,7 @@ class ElasticStatus:
 
 class ElasticManager:
     def __init__(self, args=None, store=None, rank=None, world_size=None,
-                 heartbeat_interval=2.0, lease_ttl=10.0):
+                 heartbeat_interval=2.0, lease_ttl=10.0, claim_ttl=None):
         from ..store import TCPStore
 
         self.rank = rank if rank is not None else int(
@@ -56,6 +56,10 @@ class ElasticManager:
                                   world_size=self.world_size)
         self.heartbeat_interval = heartbeat_interval
         self.lease_ttl = lease_ttl
+        # how long an unfulfilled generation claim may sit before another
+        # survivor takes it over (the claimant itself may die mid-publish)
+        self.claim_ttl = claim_ttl if claim_ttl is not None else 2 * lease_ttl
+        self._claim_seen: dict = {}  # gen -> first unfulfilled observation
         self._stop = threading.Event()
         self._hb_thread = None
         self.need_restart = False
@@ -148,15 +152,59 @@ class ElasticManager:
                 # bumps exactly once per generation (a double bump would
                 # point past the last members/<g> key and wedge everyone)
                 if int(self.store.add(f"elastic/claim/{new_gen}", 1)) == 1:
-                    self.store.set(f"elastic/members/{new_gen}",
-                                   ",".join(str(r) for r in sorted(alive)))
-                    self.store.add("elastic/gen", 1)
+                    self._publish(new_gen, alive)
+                else:
+                    # claim taken but unfulfilled: the claimant may have
+                    # died between winning the claim and publishing
+                    # (ADVICE r3 — previously the survivors HELD forever).
+                    # The claim is a LEASE: after claim_ttl without
+                    # members/<g+1> appearing, one takeover attempt per
+                    # claim_ttl window is allowed via an attempt-indexed
+                    # claim key.
+                    self._maybe_take_over_claim(new_gen, alive)
             self._pending_dead = set(alive)
             # the publish lands for everyone (including the leader) via
             # _sync_generation on the next watch tick
         else:
             self._pending_dead = None
         return ElasticStatus.HOLD
+
+    def _publish(self, new_gen, alive):
+        """Fulfill a won claim: write the membership, then bump the
+        generation pointer. BOTH store-ops are guarded on the generation
+        still being ours: a stale claimant resuming after a takeover must
+        neither overwrite the membership other ranks already adopted
+        (split-brain world sizes) nor double-bump the pointer. The
+        remaining check-then-act window is a fraction of a tick, vs the
+        ≥claim_ttl the claimant was already silent."""
+        if int(self.store.add("elastic/gen", 0)) != self.gen:
+            return  # superseded while we were stalled
+        self.store.set(f"elastic/members/{new_gen}",
+                       ",".join(str(r) for r in sorted(alive)))
+        if int(self.store.add("elastic/gen", 0)) == self.gen:
+            self.store.add("elastic/gen", 1)
+
+    def _maybe_take_over_claim(self, new_gen, alive):
+        if int(self.store.add("elastic/gen", 0)) != self.gen:
+            # the world moved on — nothing to take over
+            self._claim_seen.pop(new_gen, None)
+            self._claim_seen.pop(("bump", new_gen), None)
+            return
+        if self.store.check(f"elastic/members/{new_gen}"):
+            # membership written but the gen pointer never moved: the
+            # claimant died BETWEEN the two publish store-ops. Finish the
+            # publish for it (same claim_ttl patience + guarded bump).
+            first = self._claim_seen.setdefault(("bump", new_gen),
+                                                time.time())
+            if time.time() - first >= self.claim_ttl and \
+                    int(self.store.add("elastic/gen", 0)) == self.gen:
+                self.store.add("elastic/gen", 1)
+            return
+        first = self._claim_seen.setdefault(new_gen, time.time())
+        attempt = int((time.time() - first) // self.claim_ttl)
+        if attempt >= 1 and int(self.store.add(
+                f"elastic/claim/{new_gen}/retry{attempt}", 1)) == 1:
+            self._publish(new_gen, alive)
 
     # -- trainer lifecycle ----------------------------------------------------
     def local_rank_and_world(self):

@@ -128,19 +128,23 @@ def _rendezvous(args):
                      world_size=args.nnodes)
     my_ip = _local_ip(m_ip)
     ports = [_free_port() for _ in range(nproc)]
-    store.set(f"launch/node/{args.rank}",
-              json.dumps({"ip": my_ip, "ports": ports}).encode())
+    rec = {"ip": my_ip, "ports": ports}
+    if args.rank == 0:
+        # jax.distributed coordinator: served by trainer global-rank 0 on
+        # node 0 — a verified-free port PUBLISHED through the store, not
+        # an assumed master_port+1 which may be taken (ADVICE r3; the
+        # remaining bind-time race window matches the reference launcher's
+        # own port reservation semantics)
+        rec["coord_port"] = _free_port()
+    store.set(f"launch/node/{args.rank}", json.dumps(rec).encode())
     endpoints = []
-    node0_ip = None
+    coord = None
     for r in range(args.nnodes):
         store.wait([f"launch/node/{r}"])
         info = json.loads(store.get(f"launch/node/{r}"))
         if r == 0:
-            node0_ip = info["ip"]
+            coord = f"{info['ip']}:{info['coord_port']}"
         endpoints.extend(f"{info['ip']}:{p}" for p in info["ports"])
-    # jax.distributed coordinator: served by trainer global-rank 0 on
-    # node 0 — a distinct port from the TCPStore
-    coord = f"{node0_ip}:{int(m_port) + 1}"
     return endpoints, coord, store
 
 

@@ -1,27 +1,51 @@
 """Dy2static AST transforms — pythonic control flow to compiled control flow.
 
-Reference: `python/paddle/jit/dy2static/{ifelse,loop}_transformer.py` +
-`convert_operators.py` (`convert_ifelse`, `convert_while_loop`): user
-functions are AST-rewritten so `if`/`while` over TENSOR values become
-runtime-dispatched conversion calls; a bool predicate keeps plain Python
-semantics, a tensor predicate builds graph control flow.
+Reference: `python/paddle/jit/dy2static/{ifelse,loop}_transformer.py`,
+`break_continue_transformer.py` + `convert_operators.py` (`convert_ifelse`,
+`convert_while_loop`, `convert_for`): user functions are AST-rewritten so
+`if`/`while`/`for` over TENSOR values become runtime-dispatched conversion
+calls; a bool predicate keeps plain Python semantics, a tensor predicate
+builds graph control flow.
 
 TPU re-design: the conversion targets are `jax.lax.cond` /
-`jax.lax.while_loop` instead of the reference's cond/while ops. Dispatch is
-three-way at runtime:
+`jax.lax.while_loop` / `jax.lax.scan` instead of the reference's
+cond/while ops. Dispatch is three-way at runtime:
   * python value        → plain Python branch/loop (zero overhead),
   * CONCRETE Tensor     → `bool()` materializes it and Python branches —
                           eager dygraph keeps the full tape/hook semantics,
-  * TRACED Tensor       → `lax.cond`/`lax.while_loop` over the assigned
-                          variables (inside `jit.to_static`/`jax.jit`,
-                          where data-dependent Python branching is
-                          impossible by construction).
+  * TRACED Tensor       → `lax.cond`/`lax.scan`/`lax.while_loop` over the
+                          assigned variables (inside `jit.to_static` /
+                          `jax.jit`, where data-dependent Python branching
+                          is impossible by construction).
 
-The transformer intentionally covers the reference's core contract
-(branch/loop variable hoisting by assignment analysis) without its full
-breadth (no for-over-tensor, no break/continue rewriting); any function it
-cannot rewrite falls back to the original, matching the reference's
-fallback-to-dygraph behavior (`program_translator.py` error recovery).
+Differentiability of the traced paths (ADVICE r3 medium finding — silently
+zero gradients are never acceptable):
+  * `lax.cond` and `lax.scan` regions are routed through
+    `core.dispatch.forward`, so the eager tape records ONE differentiable
+    GradNode for the whole region (jax reverse-differentiates cond/scan
+    natively) — a to_static forward with tensor `if`s or bounded `for`s
+    trains correctly under `jit.TrainStep`.
+  * `lax.while_loop` is NOT reverse-differentiable (unbounded trip count);
+    when gradients are required through a traced `while` (or a `for` over a
+    traced-length range) a clear NotImplementedError is raised instead of
+    silently detaching — rewrite as a bounded `for` (lowered to scan) or
+    compute under `paddle.no_grad()`.
+
+Loop breadth (reference `loop_transformer.py` + `break_continue_transformer.py`):
+  * `for` over range()/tensors/arrays lowers to `lax.scan` when the trip
+    count is static (differentiable) and a counter `lax.while_loop` when a
+    range bound is itself traced.
+  * `break`/`continue` inside `for`/`while` are eliminated by the classic
+    flag-variable transform: `break` sets a loop-carried bool consumed by
+    the loop condition (or a scan step select), `continue` sets a
+    body-local bool, and following statements are guarded by `if` on the
+    flags — the guards then compose with the ordinary ifelse transform.
+
+Any function the transformer cannot rewrite (return inside a loop,
+try/with around break, for/else, ...) falls back to the original,
+matching the reference's fallback-to-dygraph behavior
+(`program_translator.py` error recovery); a tensor predicate then fails
+loudly at trace time instead of mis-executing.
 """
 from __future__ import annotations
 
@@ -30,9 +54,10 @@ import inspect
 import textwrap
 
 import jax
+import jax.numpy as jnp
 
 __all__ = ["ast_transform", "convert_ifelse", "convert_while_loop",
-           "UNDEF"]
+           "convert_for", "convert_range_for", "UNDEF"]
 
 
 class _Undefined:
@@ -44,6 +69,14 @@ class _Undefined:
 
 
 UNDEF = _Undefined()
+
+
+def _is_undef(o):
+    """UNDEF sentinel, or a NaN-placeholder Tensor an ENCLOSING region
+    already materialized for an UNDEF slot (nested control flow: the inner
+    region must still treat it as reseedable, or its loop-carry seed keeps
+    the outer scalar-f32 aval and scan/while typing fails)."""
+    return o is UNDEF or getattr(o, "_dy2s_undef", False)
 
 
 def _is_traced(x):
@@ -65,98 +98,551 @@ def _to_pred(x):
     return arr.astype(bool).reshape(())
 
 
-def convert_ifelse(pred, true_fn, false_fn, operands):
+def _tensorish(x):
+    from ..core.tensor import Tensor
+
+    return isinstance(x, (Tensor, jax.Array)) or hasattr(x, "dtype")
+
+
+def _bool_of(x):
+    """Materialize a flag value to a python bool (concrete paths only)."""
+    from ..core.tensor import Tensor
+
+    if isinstance(x, Tensor):
+        return bool(x.numpy())
+    return bool(x)
+
+
+# Runtime logical helpers for generated guard/condition expressions: python
+# `not`/`and`/`or` on a Tensor flag would call __bool__ and explode under a
+# trace, so generated code calls these instead (tensor-aware, python-cheap).
+def cf_not(x):
+    if _tensorish(x):
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.logical_not(jnp.asarray(_unwrap(x)).astype(bool)))
+    return not x
+
+
+def cf_and(a, b):
+    if _tensorish(a) or _tensorish(b):
+        from ..core.tensor import Tensor
+
+        return Tensor(jnp.logical_and(
+            jnp.asarray(_unwrap(a)).astype(bool),
+            jnp.asarray(_unwrap(b)).astype(bool)))
+    return a and b
+
+
+def cf_noflag(*flags):
+    """True while no break/continue flag is set (guard predicate)."""
+    out = True
+    for f in flags:
+        out = cf_and(out, cf_not(f))
+    return out
+
+
+def _strong(x):
+    """Normalize to a strongly-typed jax array. Loop carries must be
+    type-stable; python scalars (break/continue flags, counters) enter as
+    weakly-typed scalars but come back strong after one in-body op, which
+    lax.while_loop/scan reject as an aval mismatch — so every seed and
+    every body output is strong-cast once."""
+    x = jnp.asarray(x)
+    if getattr(x, "weak_type", False):
+        return jax.lax.convert_element_type(x, x.dtype)
+    return x
+
+
+def _grads_required(operands):
+    from ..core import autograd as ag
+    from ..core.tensor import Tensor
+
+    return ag.is_grad_enabled() and any(
+        isinstance(o, Tensor) and not o.stop_gradient for o in operands)
+
+
+def _seed_arrays(operands):
+    """Raw arrays per operand; UNDEF slots become a scalar NaN placeholder
+    (any read before assignment poisons visibly — reference UndefinedVar
+    contract)."""
+    return tuple(jnp.float32(jnp.nan) if _is_undef(o)
+                 else _strong(_unwrap(o)) for o in operands)
+
+
+def _rewrap(xs, operands):
+    """Wrap region outputs back per the original operand kinds. UNDEF-slot
+    outputs are marked as placeholders so NESTED regions recognize them
+    (see _is_undef)."""
+    from ..core.tensor import Tensor
+
+    out = []
+    for x, o in zip(xs, operands):
+        if isinstance(o, Tensor):
+            out.append(Tensor(x))
+        elif _is_undef(o):
+            t = Tensor(x)
+            t._dy2s_undef = True
+            out.append(t)
+        else:
+            out.append(x)
+    return tuple(out)
+
+
+def _split_reads(reads):
+    """Partition read-only hoisted values into (tensor-ish, static).
+
+    Tensor/array reads become extra region INPUTS so the tape records
+    their grad edges (a branch reading a closure tensor must still get a
+    cotangent — ADVICE r3 medium finding); plain python values stay static
+    closure constants so they keep python semantics downstream (a python
+    int must not come back as an array).
+
+    Returns (slots, tensor_reads): slots[i] is ("t", index-into-read-args)
+    for tensor reads or ("s", raw static value).
+    """
+    slots, tensor_reads = [], []
+    for r in reads:
+        if _tensorish(r):
+            slots.append(("t", len(tensor_reads)))
+            tensor_reads.append(r)
+        else:
+            slots.append(("s", r))
+    return slots, tensor_reads
+
+
+def _discover_captures(fn, input_arrays, known_ids):
+    """Abstractly trace `fn` once with a dispatch hook recording every
+    grad-requiring Tensor an op inside touches that is NOT among the
+    declared region inputs — i.e. closure tensors reached via attribute /
+    container access (`self.fc(x)` inside a branch). Bare-name reads are
+    hoisted syntactically; these can only be found dynamically."""
+    from ..core import autograd, dispatch
+
+    cap = {}
+
+    def sink(t):
+        if id(t) not in known_ids:
+            cap.setdefault(id(t), t)
+
+    old = dispatch.capture_sink
+    dispatch.capture_sink = sink
+    try:
+        with autograd._scoped(False):  # probe must not tape
+            jax.eval_shape(fn, *[jax.ShapeDtypeStruct(jnp.shape(x),
+                                                      jnp.result_type(x))
+                                 for x in input_arrays])
+    finally:
+        dispatch.capture_sink = old
+    return list(cap.values())
+
+
+def _raise_if_closure_grads(body, arrs, kind):
+    """Traced while-style regions have no reverse-mode rule; a closure
+    tensor with grads used inside would silently detach — fail loudly
+    instead (the operand/read grads are checked by the caller already)."""
+    from ..core import autograd as ag
+
+    if not ag.is_grad_enabled():
+        return
+    cap = _discover_captures(lambda *xs: body(tuple(xs)), list(arrs),
+                             known_ids=set())
+    if cap:
+        raise NotImplementedError(
+            f"dy2static: gradients through a traced `{kind}` are not "
+            "supported (dynamic trip count has no reverse-mode rule), and "
+            "the loop body reads gradient-requiring tensors (e.g. layer "
+            "parameters). Rewrite as a bounded `for` (lowered to "
+            "lax.scan, differentiable) or run under paddle.no_grad().")
+
+
+def _region_forward(name, region_fn, operands, extra=(), tensor_reads=(),
+                    out_undef_mask=None):
+    """Run a traced control-flow region through the single op-dispatch
+    point so the tape records one differentiable GradNode for it (the
+    dygraph engine then reverse-differentiates through lax.cond/lax.scan
+    exactly like any other op).
+
+    region_fn(*extra_arrays, *operand_arrays, *read_arrays) -> tuple of
+    arrays, one per operand. Returns operand outputs rewrapped per their
+    original kinds.
+
+    Closure tensors with grads (layer params reached via `self.<attr>`
+    inside a branch) are discovered by an abstract capture pass and
+    functionalized into extra region inputs, TrainStep-style: their _data
+    is swapped for the traced argument while the region runs, so jax.vjp
+    differentiates w.r.t. them and the tape records their edges — without
+    this their gradients would silently vanish.
+    """
+    from ..core import autograd as ag
+    from ..core import dispatch
+    from ..core.tensor import Tensor
+
+    arrs = _seed_arrays(operands)
+    # pass the original Tensor where one exists so forward() sees its grad
+    # edge; raw arrays (python values, UNDEF seeds) carry no edge
+    inputs = (list(extra) +
+              [o if isinstance(o, Tensor) else a
+               for o, a in zip(operands, arrs)] +
+              list(tensor_reads))
+    captured = []
+    if ag.is_grad_enabled():
+        known = {id(t) for t in inputs if isinstance(t, Tensor)}
+        captured = _discover_captures(
+            region_fn, [_unwrap(x) for x in inputs], known)
+    if captured:
+        n_base = len(inputs)
+
+        def region_sw(*all_args):
+            base, caps = all_args[:n_base], all_args[n_base:]
+            saved = [t._data for t in captured]
+            for t, a in zip(captured, caps):
+                t._data = a
+            try:
+                return region_fn(*base)
+            finally:
+                for t, s in zip(captured, saved):
+                    t._data = s
+
+        outs = dispatch.forward(region_sw, inputs + captured, name=name)
+    else:
+        outs = dispatch.forward(region_fn, inputs, name=name)
+    if not isinstance(outs, tuple):
+        outs = (outs,)
+    raw = tuple(o._data if isinstance(o, Tensor) else o for o in outs)
+    wrapped = _rewrap(raw, operands)
+    # keep the tape edges: for Tensor-kind outputs reuse the dispatched
+    # Tensor itself (it carries _grad_node/_out_idx); others stay raw.
+    # The UNDEF placeholder mark survives the region ONLY where the value
+    # may genuinely still be the seed (out_undef_mask) — marking a
+    # definitely-assigned output would make a LATER region's reseed
+    # silently replace its real value with NaN (review r4 round 3).
+    if out_undef_mask is None:
+        out_undef_mask = [_is_undef(o) for o in operands]
+    final = []
+    for t, w, o, mk in zip(outs, wrapped, operands, out_undef_mask):
+        if isinstance(w, Tensor):
+            if mk:
+                t._dy2s_undef = True
+            final.append(t)
+        else:
+            final.append(w)
+    return tuple(final)
+
+
+def _read_values(slots, read_args, reads):
+    """Rebuild per-call read values inside a region: tensor slots come
+    from the region's traced args (wrapped back per original kind),
+    static slots are the original python values."""
+    from ..core.tensor import Tensor
+
+    out = []
+    for (kind, v), orig in zip(slots, reads):
+        if kind == "t":
+            out.append(Tensor(read_args[v]) if isinstance(orig, Tensor)
+                       else read_args[v])
+        else:
+            out.append(v)
+    return tuple(out)
+
+
+def convert_ifelse(pred, true_fn, false_fn, operands, reads=(),
+                   definite=None):
     """Reference convert_operators.convert_ifelse. operands: current values
-    of every name either branch assigns; returns their new values."""
+    of every name either branch assigns (returned as their new values);
+    reads: values of every OTHER local either branch only reads — tensor
+    reads become grad-visible region inputs. definite[i]: the AST saw
+    operand i assigned in BOTH branches, so its output is definitely real
+    (the UNDEF placeholder mark must not survive)."""
     from ..core.tensor import Tensor
 
     if not _is_traced(pred):
         if isinstance(pred, Tensor):
             pred = bool(pred.numpy())
-        return true_fn(*operands) if pred else false_fn(*operands)
-
-    # a name first created INSIDE both branches has no pre-value: feed a
-    # NaN placeholder (any read before assignment poisons visibly —
-    # reference UndefinedVar contract) and wrap its output as a Tensor
-    import jax.numpy as jnp
-
-    arrs = tuple(jnp.float32(jnp.nan) if o is UNDEF else _unwrap(o)
-                 for o in operands)
-
-    def wrap(fn):
-        def g(xs):
-            ins = tuple(Tensor(x) if isinstance(o, Tensor) or o is UNDEF
-                        else x for x, o in zip(xs, operands))
-            outs = fn(*ins)
-            if not isinstance(outs, tuple):
-                outs = (outs,)
-            return tuple(_unwrap(o) for o in outs)
-
-        return g
+        return (true_fn(*operands, *reads) if pred
+                else false_fn(*operands, *reads))
 
     from ..core import autograd
 
-    with autograd._scoped(False):  # lax.cond regions are jax-differentiated
-        outs = jax.lax.cond(_to_pred(pred), wrap(true_fn), wrap(false_fn),
-                            arrs)
-    return tuple(Tensor(x) if isinstance(o, Tensor) or o is UNDEF else x
-                 for x, o in zip(outs, operands))
+    slots, tensor_reads = _split_reads(reads)
+    n = len(operands)
+
+    def wrap(fn, read_args):
+        def g(xs):
+            ins = _rewrap(xs, operands)
+            with autograd._scoped(False):  # jax differentiates the region
+                outs = fn(*ins, *_read_values(slots, read_args, reads))
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            # strong-cast: both branches must produce identical avals
+            return tuple(_strong(_unwrap(o)) for o in outs)
+
+        return g
+
+    def region(pred_arr, *xs):
+        ops, read_args = tuple(xs[:n]), xs[n:]
+        tf = wrap(true_fn, read_args)
+        ff = wrap(false_fn, read_args)
+        if any(_is_undef(o) for o in operands):
+            # a name created inside ONE branch: the passthrough branch
+            # returns the placeholder seed while the other returns the
+            # real value — reseed the placeholder to the real aval so the
+            # branch outputs agree (cond-side analog of _reseed_undef)
+            for _ in range(2):
+                ta = jax.eval_shape(tf, ops)
+                fa = jax.eval_shape(ff, ops)
+                new_ops, dirty = [], False
+                for x, o, t_, f_ in zip(ops, operands, ta, fa):
+                    if _is_undef(o) and (t_.shape != f_.shape or
+                                         t_.dtype != f_.dtype):
+                        cur = (jnp.shape(x), jnp.result_type(x))
+                        real = (t_ if (f_.shape, f_.dtype) == cur else f_)
+                        x = _seed_like(real)
+                        dirty = True
+                    new_ops.append(x)
+                ops = tuple(new_ops)
+                if not dirty:
+                    break
+        return jax.lax.cond(pred_arr.astype(bool).reshape(()), tf, ff, ops)
+
+    # original Tensor objects go straight to dispatch so their grad edges
+    # are recorded (forward() unwraps internally)
+    mask = [_is_undef(o) and not (definite and definite[i])
+            for i, o in enumerate(operands)]
+    return _region_forward("dy2static_cond", region, operands,
+                           extra=(_unwrap(pred),),
+                           tensor_reads=tensor_reads,
+                           out_undef_mask=mask)
 
 
-def convert_while_loop(cond_fn, body_fn, operands):
+def convert_while_loop(cond_fn, body_fn, operands, reads=()):
     """Reference convert_operators.convert_while_loop."""
     from ..core.tensor import Tensor
     from ..core import autograd
 
-    probe = cond_fn(*operands)
+    probe = cond_fn(*operands, *reads)
     if not _is_traced(probe):
         vals = tuple(operands)
         cur = probe
-        while (bool(cur.numpy()) if isinstance(cur, Tensor) else bool(cur)):
-            vals = body_fn(*vals)
+        while True:
+            if _is_traced(cur):
+                # the condition BECAME traced mid-loop (`while True` whose
+                # break flag is set by a traced ifelse): the python
+                # iterations so far are a concrete prefix — hand the now-
+                # traced carry to the lax lowering for the rest
+                return convert_while_loop(cond_fn, body_fn, vals, reads)
+            if not (bool(cur.numpy()) if isinstance(cur, Tensor)
+                    else bool(cur)):
+                return vals
+            vals = body_fn(*vals, *reads)
             if not isinstance(vals, tuple):
                 vals = (vals,)
-            cur = cond_fn(*vals)
-        return vals
+            cur = cond_fn(*vals, *reads)
 
-    import jax.numpy as jnp
+    if _grads_required(tuple(operands) + tuple(reads)):
+        raise NotImplementedError(
+            "dy2static: gradients through a traced `while` are not "
+            "supported (lax.while_loop has no reverse-mode rule — the trip "
+            "count is unbounded). Rewrite the loop as a bounded `for` over "
+            "range()/a tensor (lowered to lax.scan, differentiable), or "
+            "run it under paddle.no_grad() / on stop_gradient inputs.")
 
     # loop-created names get a NaN placeholder like convert_ifelse —
     # but a while carry must be TYPE-STABLE, so placeholder slots are
     # re-seeded from the body's OUTPUT aval (the steady-state type),
     # discovered with eval_shape; one fixpoint refinement covers slots
     # whose first output still depended on the scalar seed
-    arrs = tuple(jnp.float32(jnp.nan) if o is UNDEF else _unwrap(o)
-                 for o in operands)
-
-    def rewrap(xs):
-        return tuple(Tensor(x) if isinstance(o, Tensor) or o is UNDEF
-                     else x for x, o in zip(xs, operands))
+    arrs = _seed_arrays(operands)
+    slots, tensor_reads = _split_reads(reads)
+    rvals = _read_values(slots, [_unwrap(t) for t in tensor_reads], reads)
 
     def c(xs):
-        return _to_pred(cond_fn(*rewrap(xs)))
+        return _to_pred(cond_fn(*_rewrap(xs, operands), *rvals))
 
     def b(xs):
-        outs = body_fn(*rewrap(xs))
+        outs = body_fn(*_rewrap(xs, operands), *rvals)
         if not isinstance(outs, tuple):
             outs = (outs,)
-        return tuple(_unwrap(o) for o in outs)
+        return tuple(_strong(_unwrap(o)) for o in outs)
 
+    _raise_if_closure_grads(b, arrs, "while")
     with autograd._scoped(False):
-        if any(o is UNDEF for o in operands):
-            for _ in range(2):
-                out_avals = jax.eval_shape(b, arrs)
-                reseeded = tuple(
-                    jnp.full(a.shape, jnp.nan, a.dtype)
-                    if o is UNDEF else x
-                    for x, a, o in zip(arrs, out_avals, operands))
-                if all(x.shape == a.shape and x.dtype == a.dtype
-                       for x, a in zip(reseeded, out_avals)):
-                    arrs = reseeded
-                    break
-                arrs = reseeded
+        arrs = _reseed_undef(b, arrs, operands)
         outs = jax.lax.while_loop(c, b, arrs)
-    return rewrap(outs)
+    return _rewrap(outs, operands)
+
+
+def _seed_like(aval):
+    """Placeholder value of a given aval: NaN poison for floats; non-float
+    placeholders (flags, counters) can't carry a poison value — zero."""
+    if jnp.issubdtype(aval.dtype, jnp.floating):
+        return jnp.full(aval.shape, jnp.nan, aval.dtype)
+    return jnp.zeros(aval.shape, aval.dtype)
+
+
+def _reseed_undef(body, arrs, operands):
+    """Re-seed UNDEF placeholder slots from the body's output avals so the
+    loop carry is type-stable (see convert_while_loop docstring)."""
+    if not any(_is_undef(o) for o in operands):
+        return arrs
+    for _ in range(2):
+        out_avals = jax.eval_shape(body, arrs)
+        reseeded = tuple(
+            _seed_like(a) if _is_undef(o) else x
+            for x, a, o in zip(arrs, out_avals, operands))
+        if all(x.shape == a.shape and x.dtype == a.dtype
+               for x, a in zip(reseeded, out_avals)):
+            return reseeded
+        arrs = reseeded
+    return arrs
+
+
+def convert_for(iterable, body_fn, operands, break_idx=None, reads=()):
+    """`for <tgt> in iterable: <body>` lowering (reference
+    convert_operators.convert_for / loop_transformer.py).
+
+    body_fn(cur_item, *operands, *reads) -> new operand values; the loop
+    target is one of the operands (assigned from cur_item at body top).
+    break_idx: operand index of the break flag when the body contained
+    `break` — in the scan lowering an iteration whose incoming flag is set
+    keeps the old carry (select), in the python lowering the loop exits.
+    """
+    from ..core.tensor import Tensor
+
+    it = iterable
+    arr = it._data if isinstance(it, Tensor) else it
+    is_array = isinstance(it, Tensor) or isinstance(arr, jax.Array) or \
+        hasattr(arr, "ndim")
+    traced = _is_traced(it) or any(
+        o is not UNDEF and _is_traced(o) for o in operands) or any(
+        _is_traced(r) for r in reads)
+
+    if not traced or not (is_array or isinstance(it, range)):
+        # python iteration: concrete tensors (row views keep eager tape
+        # semantics), ranges, lists, generators
+        vals = tuple(operands)
+        if isinstance(it, Tensor):
+            seq = (it[i] for i in range(it.shape[0]))
+        else:
+            seq = it
+        for cur in seq:
+            vals = body_fn(cur, *vals, *reads)
+            if not isinstance(vals, tuple):
+                vals = (vals,)
+            if break_idx is not None and _bool_of(vals[break_idx]):
+                break
+        return vals
+
+    # traced: lax.scan over the leading axis / the materialized range —
+    # static trip count, reverse-differentiable
+    if isinstance(it, range):
+        xs = jnp.arange(it.start, it.stop, it.step)
+    else:
+        xs = arr
+    if xs.shape[0] == 0:
+        # static zero trip count: python semantics — nothing runs, every
+        # name keeps its pre-loop value (the body may not even be
+        # traceable, e.g. it indexes the empty axis)
+        return tuple(operands)
+
+    from ..core import autograd
+
+    slots, tensor_reads = _split_reads(reads)
+    n = len(operands)
+
+    def region(xs_arr, *rest):
+        carry_seed, read_args = rest[:n], rest[n:]
+        rvals = _read_values(slots, read_args, reads)
+
+        def step(carry, x):
+            ins = _rewrap(carry, operands)
+            with autograd._scoped(False):
+                outs = body_fn(Tensor(x), *ins, *rvals)
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            new = tuple(_strong(_unwrap(o)) for o in outs)
+            if break_idx is not None:
+                done = carry[break_idx].astype(bool).reshape(())
+                new = tuple(jnp.where(done, c, n_)
+                            for c, n_ in zip(carry, new))
+            return new, None
+
+        # probe item: a zeros element of xs's aval, NOT xs_arr[0] — the
+        # scan still type-checks its body at trip count 0
+        x0 = jnp.zeros(xs_arr.shape[1:], xs_arr.dtype)
+        with autograd._scoped(False):
+            carry_seed = _reseed_undef(
+                lambda c: step(c, x0)[0], carry_seed, operands)
+        final, _ = jax.lax.scan(step, tuple(carry_seed), xs_arr)
+        return final
+
+    # pass the ORIGINAL Tensor iterable so dispatch records its grad edge
+    # (scan differentiates w.r.t. xs): `for row in h` with h requiring
+    # grads must backprop through the rows. Trip count is static and > 0
+    # here, so every carried name was definitely assigned — no output
+    # keeps the UNDEF placeholder mark.
+    xs_in = it if isinstance(it, Tensor) else xs
+    return _region_forward("dy2static_for", region, operands,
+                           extra=(xs_in,), tensor_reads=tensor_reads,
+                           out_undef_mask=[False] * len(operands))
+
+
+def convert_range_for(start, stop, step, body_fn, operands, break_idx=None,
+                      reads=()):
+    """`for i in range(...)` lowering. Static bounds route to convert_for
+    (python loop eagerly, lax.scan under a trace); a TRACED bound needs a
+    counter lax.while_loop (dynamic trip count — no scan, no gradients)."""
+    from ..core.tensor import Tensor
+    from ..core import autograd
+
+    if not any(_is_traced(v) for v in (start, stop, step)):
+        def as_int(v):
+            return int(v.numpy()) if isinstance(v, Tensor) else int(v)
+
+        return convert_for(range(as_int(start), as_int(stop), as_int(step)),
+                           body_fn, operands, break_idx, reads)
+
+    if _grads_required(tuple(operands) + tuple(reads)):
+        raise NotImplementedError(
+            "dy2static: gradients through `for i in range(<traced value>)` "
+            "are not supported (dynamic trip count lowers to "
+            "lax.while_loop, which has no reverse-mode rule). Make the "
+            "bound static (e.g. a python int / tensor.shape[k]) so the "
+            "loop lowers to lax.scan, or run under paddle.no_grad().")
+
+    lo = _unwrap(start)
+    hi = _unwrap(stop)
+    st = _unwrap(step)
+    arrs = _seed_arrays(operands)
+    slots, tensor_reads = _split_reads(reads)
+    rvals = _read_values(slots, [_unwrap(t) for t in tensor_reads], reads)
+    # counter seed in the PROMOTED dtype of start/step and strong-typed,
+    # or `i + st` drifts the while carry aval (int64 seed vs int32 body)
+    i0 = _strong(jnp.asarray(lo).astype(jnp.result_type(lo, st)))
+
+    def cond(state):
+        i, carry = state
+        alive = jnp.where(jnp.asarray(st) >= 0, i < hi, i > hi)
+        if break_idx is not None:
+            alive = jnp.logical_and(
+                alive, jnp.logical_not(
+                    carry[break_idx].astype(bool).reshape(())))
+        return alive.reshape(())
+
+    def body(state):
+        i, carry = state
+        outs = body_fn(Tensor(i), *_rewrap(carry, operands), *rvals)
+        if not isinstance(outs, tuple):
+            outs = (outs,)
+        new_i = _strong(jnp.asarray(i + st).astype(i0.dtype))
+        return new_i, tuple(_strong(_unwrap(o)) for o in outs)
+
+    _raise_if_closure_grads(lambda c: body((i0, tuple(c)))[1], arrs,
+                            "for over a traced range bound")
+    with autograd._scoped(False):
+        arrs = _reseed_undef(lambda c: body((i0, c))[1], arrs, operands)
+        _, outs = jax.lax.while_loop(cond, body, (i0, arrs))
+    return _rewrap(outs, operands)
 
 
 # ============================ AST transformer ================================
@@ -183,10 +669,74 @@ def _store(name):
     return ast.Name(id=name, ctx=ast.Store())
 
 
+def _assign(name, value):
+    return ast.Assign(targets=[_store(name)], value=value)
+
+
+def _const(v):
+    return ast.Constant(value=v)
+
+
+_LOOP_OR_DEF = (ast.For, ast.AsyncFor, ast.While, ast.FunctionDef,
+                ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+
+
+def _contains_bc(node):
+    """break/continue belonging to the CURRENT loop level in this subtree
+    (nested loops and function defs own theirs)."""
+    if isinstance(node, (ast.Break, ast.Continue)):
+        return True
+    if isinstance(node, _LOOP_OR_DEF):
+        return False
+    return any(_contains_bc(ch) for ch in ast.iter_child_nodes(node))
+
+
+def _eliminate_break_continue(stmts, brk, cont):
+    """Rewrite `break`/`continue` in `stmts` into flag assignments with
+    guard-`if`s over the remaining statements (reference
+    break_continue_transformer.py). Returns the new statement list, or
+    None when the shape is unsupported (break under try/with — bail so the
+    whole loop stays python).
+
+    brk/cont: flag variable names (either may be None when that statement
+    kind is absent)."""
+    out = []
+    for idx, st in enumerate(stmts):
+        if isinstance(st, ast.Break):
+            out.append(_assign(brk, _const(True)))
+            return out  # following statements are unreachable
+        if isinstance(st, ast.Continue):
+            out.append(_assign(cont, _const(True)))
+            return out
+        if not _contains_bc(st):
+            out.append(st)
+            continue
+        if isinstance(st, ast.If):
+            body = _eliminate_break_continue(st.body, brk, cont)
+            orelse = _eliminate_break_continue(st.orelse, brk, cont)
+            if body is None or orelse is None:
+                return None
+            out.append(ast.If(test=st.test, body=body or [ast.Pass()],
+                              orelse=orelse))
+            rest = _eliminate_break_continue(stmts[idx + 1:], brk, cont)
+            if rest is None:
+                return None
+            if rest:
+                flags = [_load(f) for f in (brk, cont) if f is not None]
+                out.append(ast.If(
+                    test=ast.Call(func=_load("__dy2static_noflag"),
+                                  args=flags, keywords=[]),
+                    body=rest, orelse=[]))
+            return out
+        return None  # break/continue under try/with/...: unsupported
+    return out
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
-    """Rewrites `if`/`while` statements into convert_* calls (reference
-    IfElseTransformer/LoopTransformer collapsed: one hoisting strategy —
-    every name assigned in a branch/body becomes an operand and a return)."""
+    """Rewrites `if`/`while`/`for` statements into convert_* calls
+    (reference IfElse/Loop/BreakContinue transformers collapsed: one
+    hoisting strategy — every name assigned in a branch/body becomes an
+    operand and a return)."""
 
     def __init__(self, local_names):
         self._counter = 0
@@ -196,11 +746,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def _fresh(self, kind):
         self._counter += 1
-        return f"__dy2static_{kind}_{self._counter}"
+        return f"__dy2s_{kind}_{self._counter}"
 
-    def _make_branch_fn(self, name, body, var_names):
+    def _make_branch_fn(self, name, body, var_names, extra_args=(),
+                        extra_reads=()):
         args = ast.arguments(
-            posonlyargs=[], args=[ast.arg(arg=v) for v in var_names],
+            posonlyargs=[],
+            args=[ast.arg(arg=v)
+                  for v in (*extra_args, *var_names, *extra_reads)],
             kwonlyargs=[], kw_defaults=[], defaults=[])
         ret = ast.Return(value=ast.Tuple(
             elts=[_load(v) for v in var_names], ctx=ast.Load()))
@@ -211,14 +764,14 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return fn
 
     @staticmethod
-    def _has_escape(nodes):
-        """return/break/continue ESCAPING a hoisted region would silently
-        change semantics (the generated branch fn swallows them): leave
-        such statements untransformed — a tensor pred then fails loudly at
-        trace time instead of mis-executing (documented narrowness).
-        Scoped scan: nested function/class definitions (including our own
-        generated branch fns) own their returns, and break/continue inside
-        a loop nested WITHIN the region don't escape it."""
+    def _has_escape(nodes, allow_bc=False):
+        """return (always) / break/continue (unless allow_bc) ESCAPING a
+        hoisted region would silently change semantics (the generated
+        branch fn swallows them): leave such statements untransformed — a
+        tensor pred then fails loudly at trace time instead of
+        mis-executing (documented narrowness). Scoped scan: nested
+        function/class definitions own their returns, and break/continue
+        inside a loop nested WITHIN the region don't escape it."""
 
         def scan(node, in_loop):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -226,7 +779,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 return False
             if isinstance(node, ast.Return):
                 return True
-            if isinstance(node, (ast.Break, ast.Continue)) and not in_loop:
+            if isinstance(node, (ast.Break, ast.Continue)) and not in_loop \
+                    and not allow_bc:
                 return True
             nested = in_loop or isinstance(
                 node, (ast.For, ast.AsyncFor, ast.While))
@@ -235,6 +789,32 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
         return any(scan(n, False) for n in nodes)
 
+    def _filter(self, names):
+        # generated __dy2s_* locals (break/continue flags of NESTED
+        # regions) participate in hoisting BY DESIGN — they are loop/branch
+        # state like any user variable. Only the __dy2static_* runtime
+        # helpers are off-limits, and those are global Loads that never
+        # appear as assignment targets; the filter is a guard against a
+        # future transform accidentally storing under that prefix.
+        return [n for n in names if not n.startswith("__dy2static")]
+
+    def _read_names(self, nodes, exclude):
+        """fn-local names the region READS but does not assign — hoisted
+        as trailing args so tensor reads become grad-visible region inputs
+        (a branch reading a closure tensor must still get a cotangent)."""
+        out = []
+        seen = set(exclude)
+        for node in nodes:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and \
+                        isinstance(sub.ctx, ast.Load) and \
+                        sub.id in self._locals and \
+                        sub.id not in seen and \
+                        not sub.id.startswith("__"):
+                    seen.add(sub.id)
+                    out.append(sub.id)
+        return out
+
     def visit_If(self, node):
         self.generic_visit(node)
         if self._has_escape(node.body) or self._has_escape(node.orelse):
@@ -242,14 +822,23 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         names = _assigned_names(node.body) + [
             n for n in _assigned_names(node.orelse)
             if n not in _assigned_names(node.body)]
-        names = [n for n in names if not n.startswith("__dy2static")]
+        names = self._filter(names)
         if not names:
             return node  # no state: leave it (pred must then be python)
+        reads = self._read_names(node.body + node.orelse, names)
+        # names assigned in BOTH branches are definitely real afterwards —
+        # their outputs must shed any UNDEF placeholder mark
+        both = set(_assigned_names(node.body)) & \
+            set(_assigned_names(node.orelse))
+        definite = tuple(n in both for n in names)
         self.changed = True
         self.hoisted.update(names)
+        self.hoisted.update(reads)
         tname, fname = self._fresh("true"), self._fresh("false")
-        true_fn = self._make_branch_fn(tname, node.body, names)
-        false_fn = self._make_branch_fn(fname, node.orelse, names)
+        true_fn = self._make_branch_fn(tname, node.body, names,
+                                       extra_reads=reads)
+        false_fn = self._make_branch_fn(fname, node.orelse, names,
+                                        extra_reads=reads)
         call = ast.Assign(
             targets=[ast.Tuple(elts=[_store(n) for n in names],
                                ctx=ast.Store())],
@@ -257,36 +846,85 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 func=_load("__dy2static_convert_ifelse"),
                 args=[node.test, _load(tname), _load(fname),
                       ast.Tuple(elts=[_load(n) for n in names],
+                                ctx=ast.Load()),
+                      ast.Tuple(elts=[_load(n) for n in reads],
+                                ctx=ast.Load()),
+                      ast.Tuple(elts=[_const(bool(d)) for d in definite],
                                 ctx=ast.Load())],
                 keywords=[]))
         return [true_fn, false_fn, call]
 
+    def _eliminate_bc(self, node):
+        """Shared break/continue elimination for while/for. Returns
+        (new_body, brk_name, inits) or (None, None, None) on unsupported
+        shapes; new_body is break/continue-free."""
+        has_b = self._scoped_has(node.body, ast.Break)
+        has_c = self._scoped_has(node.body, ast.Continue)
+        if not has_b and not has_c:
+            return node.body, None, []
+        brk = self._fresh("brk") if has_b else None
+        cont = self._fresh("cont") if has_c else None
+        body = _eliminate_break_continue(node.body, brk, cont)
+        if body is None:
+            return None, None, None
+        inits = []
+        if cont is not None:
+            # reset at every iteration top
+            body = [_assign(cont, _const(False))] + body
+        if brk is not None:
+            inits.append(_assign(brk, _const(False)))
+        self.changed = True
+        return body, brk, inits
+
+    @staticmethod
+    def _scoped_has(stmts, kind):
+        def scan(node):
+            if isinstance(node, kind):
+                return True
+            if isinstance(node, _LOOP_OR_DEF):
+                return False
+            return any(scan(ch) for ch in ast.iter_child_nodes(node))
+
+        return any(scan(s) for s in stmts)
+
     def visit_While(self, node):
-        self.generic_visit(node)
-        if node.orelse or self._has_escape(node.body):
-            return node  # while/else, break/continue: keep python
-        names = _assigned_names(node.body)
-        names = [n for n in names if not n.startswith("__dy2static")]
-        # LOCAL loop-condition reads must be loop-carried too (globals /
-        # closure modules stay free variables of the generated functions)
-        for sub in ast.walk(node.test):
-            if isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load):
-                if sub.id not in names and sub.id in self._locals and \
-                        not sub.id.startswith("__"):
-                    names.append(sub.id)
+        if node.orelse or self._has_escape(node.body, allow_bc=True):
+            self.generic_visit(node)
+            return node  # while/else, return-in-body: keep python
+        body, brk, inits = self._eliminate_bc(node)
+        if body is None:
+            self.generic_visit(node)
+            return node
+        test = node.test
+        if brk is not None:
+            test = ast.Call(func=_load("__dy2static_loop_alive"),
+                            args=[test, _load(brk)], keywords=[])
+        node = ast.While(test=test, body=body, orelse=[])
+        self.generic_visit(node)  # transform nested (now bc-free) stmts
+        names = self._filter(_assigned_names(node.body))
+        if brk is not None and brk not in names:
+            names.append(brk)
         if not names:
             return node
+        # locals READ by the condition or body but never assigned: trailing
+        # read args (tensor reads become grad-visible; loop-invariant by
+        # construction so passing initial values is exact)
+        reads = self._read_names(node.body + [ast.Expr(value=node.test)],
+                                 names)
         self.changed = True
         self.hoisted.update(names)
+        self.hoisted.update(reads)
         cname, bname = self._fresh("cond"), self._fresh("body")
         args = ast.arguments(
-            posonlyargs=[], args=[ast.arg(arg=v) for v in names],
+            posonlyargs=[],
+            args=[ast.arg(arg=v) for v in (*names, *reads)],
             kwonlyargs=[], kw_defaults=[], defaults=[])
         cond_fn = ast.FunctionDef(
             name=cname, args=args,
             body=[ast.Return(value=node.test)], decorator_list=[],
             returns=None, type_params=[])
-        body_fn = self._make_branch_fn(bname, node.body, names)
+        body_fn = self._make_branch_fn(bname, node.body, names,
+                                       extra_reads=reads)
         call = ast.Assign(
             targets=[ast.Tuple(elts=[_store(n) for n in names],
                                ctx=ast.Store())],
@@ -294,9 +932,90 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 func=_load("__dy2static_convert_while"),
                 args=[_load(cname), _load(bname),
                       ast.Tuple(elts=[_load(n) for n in names],
+                                ctx=ast.Load()),
+                      ast.Tuple(elts=[_load(n) for n in reads],
                                 ctx=ast.Load())],
                 keywords=[]))
-        return [cond_fn, body_fn, call]
+        return inits + [cond_fn, body_fn, call]
+
+    def visit_For(self, node):
+        if node.orelse or self._has_escape(node.body, allow_bc=True) or \
+                not isinstance(node.target, (ast.Name, ast.Tuple)):
+            self.generic_visit(node)
+            return node  # for/else, return-in-body: keep python
+        body, brk, inits = self._eliminate_bc(node)
+        if body is None:
+            self.generic_visit(node)
+            return node
+        # loop target assigned from the per-iteration item at body top
+        cur = self._fresh("item")
+        tgt_assign = ast.Assign(
+            targets=[node.target],
+            value=_load(cur))
+        node = ast.For(target=node.target, iter=node.iter,
+                       body=[tgt_assign] + body, orelse=[])
+        self.generic_visit(node)  # transform nested (now bc-free) stmts
+        names = self._filter(_assigned_names(node.body))
+        if brk is not None and brk not in names:
+            names.append(brk)
+        if not names:
+            return node
+        reads = self._read_names(node.body, names)
+        self.changed = True
+        self.hoisted.update(names)
+        self.hoisted.update(reads)
+        bname = self._fresh("forbody")
+        body_fn = self._make_branch_fn(bname, node.body, names,
+                                       extra_args=(cur,),
+                                       extra_reads=reads)
+        break_arg = (_const(names.index(brk)) if brk is not None
+                     else _const(None))
+        names_tup = ast.Tuple(elts=[_load(n) for n in names],
+                              ctx=ast.Load())
+        reads_tup = ast.Tuple(elts=[_load(n) for n in reads],
+                              ctx=ast.Load())
+        it = node.iter
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) and \
+                it.func.id == "range" and not it.keywords and \
+                1 <= len(it.args) <= 3 and \
+                not any(isinstance(a, ast.Starred) for a in it.args):
+            # range(...) special form: bounds may be tensors, so they are
+            # passed unevaluated-by-range to the runtime converter
+            a = it.args
+            start = a[0] if len(a) >= 2 else _const(0)
+            stop = a[1] if len(a) >= 2 else a[0]
+            step = a[2] if len(a) == 3 else _const(1)
+            conv = ast.Call(
+                func=_load("__dy2static_convert_range_for"),
+                args=[start, stop, step, _load(bname), names_tup,
+                      break_arg, reads_tup],
+                keywords=[])
+        else:
+            conv = ast.Call(
+                func=_load("__dy2static_convert_for"),
+                args=[it, _load(bname), names_tup, break_arg, reads_tup],
+                keywords=[])
+        call = ast.Assign(
+            targets=[ast.Tuple(elts=[_store(n) for n in names],
+                               ctx=ast.Store())],
+            value=conv)
+        return inits + [body_fn, call]
+
+
+def _loop_alive(test, brk):
+    """while-condition augmentation when the body contained `break`."""
+    return cf_and(test, cf_not(brk))
+
+
+_RUNTIME_HELPERS = {
+    "__dy2static_convert_ifelse": convert_ifelse,
+    "__dy2static_convert_while": convert_while_loop,
+    "__dy2static_convert_for": convert_for,
+    "__dy2static_convert_range_for": convert_range_for,
+    "__dy2static_noflag": cf_noflag,
+    "__dy2static_loop_alive": _loop_alive,
+    "__dy2static_UNDEF": UNDEF,
+}
 
 
 def ast_transform(fn):
@@ -357,9 +1076,7 @@ def ast_transform(fn):
         # monkeypatched) after decoration resolve exactly like they would
         # in the untransformed function
         glb = fn.__globals__
-    glb["__dy2static_convert_ifelse"] = convert_ifelse
-    glb["__dy2static_convert_while"] = convert_while_loop
-    glb["__dy2static_UNDEF"] = UNDEF
+    glb.update(_RUNTIME_HELPERS)
     try:
         code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
                        mode="exec")

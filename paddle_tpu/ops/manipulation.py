@@ -609,7 +609,10 @@ def _rebuild_index(spec, dyn_arrays):
 def getitem(x, idx):
     spec, dyn = _split_index(idx)
     # boolean-mask indexing produces dynamic shapes → eager numpy path
-    if builtins.any(np.asarray(unwrap(d)).dtype == np.bool_ for d in dyn):
+    # (dtype probed without materializing: an index can be a TRACER, e.g. a
+    # dy2static scan counter indexing a closure tensor)
+    if builtins.any(jnp.issubdtype(jnp.result_type(unwrap(d)), jnp.bool_)
+                    for d in dyn):
         arr = np.asarray(unwrap(x))
         np_idx = _rebuild_index(spec, [np.asarray(unwrap(d)) for d in dyn])
         return Tensor(arr[np_idx if len(np_idx) > 1 else np_idx[0]])

@@ -34,9 +34,24 @@ __all__ = ["save_inference_model", "load_inference_model", "save", "load",
 # attr-capturing closures by value), so a TRAINING program — including its
 # recorded minimize request and optimizer hyperparams — survives the
 # process and can load-and-continue.
+#
+# FORMAT DIVERGENCE + TRUST BOUNDARY (ADVICE r3): despite the shared
+# `.pdmodel` extension this is NOT the reference's ProgramDesc protobuf —
+# there is no interop with real Paddle model files in either direction. A
+# magic header marks the format so foreign files fail fast, and because
+# cloudpickle EXECUTES code on load, `load_program` must only ever be fed
+# checkpoints from a trusted source (same trust model as torch.load or the
+# reference's own pickle-based paddle.save payloads).
+
+_PROGRAM_MAGIC = b"#PADDLE_TPU_PROGRAM_V1\n"
+
 
 def save(program, path_prefix, scope=None):
-    """`paddle.static.save`: persist program + params + optimizer state."""
+    """`paddle.static.save`: persist program + params + optimizer state.
+
+    The `.pdmodel` written here is a paddle_tpu-native cloudpickle blob
+    behind a magic header — not a reference ProgramDesc protobuf (see
+    module comment for the format/trust notes)."""
     import cloudpickle
 
     scope = scope or global_scope()
@@ -46,6 +61,7 @@ def save(program, path_prefix, scope=None):
     for v in program.vars.values():
         v.__dict__.pop("_replay_value", None)
     with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(_PROGRAM_MAGIC)
         cloudpickle.dump(program, f)
     params = {pv.name: np.asarray(scope.vars[pv.name])
               for pv, _ in program.params if pv.name in scope.vars}
@@ -74,11 +90,33 @@ def load_program(path_prefix, scope=None, load_state=True):
     """Deserialize a training Program saved by `save` (reference
     deserialize_program, io.py). Returns the Program; with load_state the
     saved params + optimizer state are installed into the scope so
-    Executor.run continues the trajectory."""
+    Executor.run continues the trajectory.
+
+    SECURITY: the payload is cloudpickle — loading EXECUTES code from the
+    file. Only load checkpoints you produced or otherwise trust."""
     import cloudpickle
 
     with open(path_prefix + ".pdmodel", "rb") as f:
+        head = f.read(len(_PROGRAM_MAGIC))
+        if head != _PROGRAM_MAGIC:
+            if head[:1] == b"\x80":
+                # legacy paddle_tpu checkpoint written before the magic
+                # header existed: a bare pickle stream starts with the
+                # PROTO opcode — still loadable (same trust boundary)
+                f.seek(0)
+                program = cloudpickle.load(f)
+                return _finish_load(program, path_prefix, scope, load_state)
+            raise ValueError(
+                f"{path_prefix}.pdmodel is not a paddle_tpu training "
+                "Program (missing magic header). Real PaddlePaddle "
+                ".pdmodel protobufs and jit.save StableHLO artifacts are "
+                "different formats — use paddle.inference / jit.load for "
+                "those.")
         program = cloudpickle.load(f)
+    return _finish_load(program, path_prefix, scope, load_state)
+
+
+def _finish_load(program, path_prefix, scope, load_state):
     # keep the Variable id counter ahead of every loaded vid so new
     # Variables recorded after the load cannot collide
     max_vid = max((v.vid for v in program.vars.values()), default=0)

@@ -153,7 +153,9 @@ class TestReviewRegressions:
         np.testing.assert_allclose(t(x, True).numpy(), [100.0, 100.0])
         np.testing.assert_allclose(t(x, False).numpy(), [-100.0, -100.0])
 
-    def test_break_keeps_python_while(self):
+    def test_break_python_while_still_exact(self):
+        # break now transforms (flag variable); the python/concrete path
+        # must keep exact eager semantics
         def f(x, n):
             while True:
                 x = x + 1.0
@@ -193,6 +195,356 @@ class TestReviewRegressions:
         # the untransformed original still works eagerly (concrete pred)
         out = fn(paddle.to_tensor(np.ones(2, np.float32)))
         np.testing.assert_allclose(out.numpy(), [3.0, 3.0], rtol=1e-6)
+
+
+class TestConvertFor:
+    """Loop breadth (VERDICT r3 #6): for-over-range/tensor lowers to
+    lax.scan under a trace (reference loop_transformer.py)."""
+
+    def test_for_range_traced_matches_eager(self):
+        def f(x):
+            acc = paddle.zeros_like(x)
+            for i in range(4):
+                acc = acc + x * float(2.0)
+            return acc
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        eager = f(x)
+        out = paddle.jit.to_static(f)(x)
+        np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-6)
+
+    def test_for_over_tensor_traced(self):
+        def f(t):
+            acc = paddle.zeros([2], "float32")
+            for row in t:
+                acc = acc + row
+            return acc
+
+        t = paddle.to_tensor(np.arange(8, dtype=np.float32).reshape(4, 2))
+        eager = f(t)
+        out = paddle.jit.to_static(f)(t)
+        np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-6)
+
+    def test_for_shape_bound_with_break(self):
+        # the VERDICT done criterion: for i in range(t.shape[0]) + break
+        # compiles under to_static and matches eager
+        def f(t):
+            acc = paddle.zeros([], "float32")
+            for i in range(t.shape[0]):
+                acc = acc + paddle.sum(t[i])
+                if acc > 10.0:
+                    break
+            return acc
+
+        t = paddle.to_tensor(np.full((6, 2), 2.0, np.float32))
+        eager = f(t)  # 4, 8, 12 -> stops after 3rd row
+        assert float(eager.numpy()) == 12.0
+        out = paddle.jit.to_static(f)(t)
+        np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-6)
+
+    def test_for_with_continue(self):
+        def f(t):
+            acc = paddle.zeros([], "float32")
+            for i in range(t.shape[0]):
+                if paddle.sum(t[i]) < 0:
+                    continue
+                acc = acc + paddle.sum(t[i])
+            return acc
+
+        rows = np.array([[1.0], [-5.0], [2.0], [-1.0], [3.0]], np.float32)
+        t = paddle.to_tensor(rows)
+        eager = f(t)
+        assert float(eager.numpy()) == 6.0
+        out = paddle.jit.to_static(f)(t)
+        np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-6)
+
+    def test_while_with_break_traced(self):
+        def f(x):
+            n = paddle.zeros([], "float32")
+            while paddle.max(x) > 1.0:
+                x = x / 2.0
+                n = n + 1.0
+                if n > 1.5:
+                    break
+            return x, n
+
+        x = paddle.to_tensor(np.full((2,), 32.0, np.float32))
+        e_x, e_n = f(x)
+        assert float(e_n.numpy()) == 2.0
+        s_x, s_n = paddle.jit.to_static(f)(x)
+        np.testing.assert_allclose(s_x.numpy(), e_x.numpy(), rtol=1e-6)
+        assert float(s_n.numpy()) == 2.0
+
+    def test_for_range_tensor_bound_traced(self):
+        # range(<traced scalar>) lowers to a counter while_loop
+        def f(x, n):
+            acc = paddle.zeros_like(x)
+            for i in range(n):
+                acc = acc + x
+            return acc
+
+        fn = paddle.jit.to_static(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        n = paddle.to_tensor(np.int32(3))
+        out = fn(x, n)
+        np.testing.assert_allclose(out.numpy(), [3.0, 3.0], rtol=1e-6)
+
+    def test_for_python_list_untouched(self):
+        def f(x, items):
+            for it in items:
+                x = x + it
+            return x
+
+        t = ast_transform(f)
+        out = t(paddle.to_tensor(np.zeros(2, np.float32)), [1.0, 2.0])
+        np.testing.assert_allclose(out.numpy(), [3.0, 3.0])
+
+
+class TestControlFlowGradients:
+    """ADVICE r3 medium: traced control-flow regions must be
+    differentiable (cond/scan) or fail loudly (while) — never silently
+    detach."""
+
+    def test_grad_through_traced_ifelse(self):
+        import paddle_tpu.nn as nn
+
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 3)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if paddle.mean(h) > 0:
+                    y = h * 2.0
+                else:
+                    y = -h
+                return y
+
+        paddle.seed(7)
+        layer = Gate()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=layer.parameters())
+
+        def step(x):
+            loss = paddle.mean(layer(x))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        train = paddle.jit.TrainStep(step, layer, opt)
+        w0 = layer.fc.weight.numpy().copy()
+        # wrap forward through to_static-style AST transform manually:
+        layer.forward = ast_transform(layer.forward)
+        train(paddle.to_tensor(np.ones((2, 3), np.float32)))
+        # parameters MUST move — silently-zero grads were the r3 bug
+        assert not np.allclose(layer.fc.weight.numpy(), w0)
+
+    def test_grad_through_traced_for_scan(self):
+        from paddle_tpu.jit.dy2static import ast_transform as tr
+
+        def f(x):
+            acc = paddle.zeros_like(x)
+            for i in range(3):
+                acc = acc + x * x
+            return paddle.sum(acc)
+
+        tf = tr(f)
+        x = paddle.to_tensor(np.full(2, 2.0, np.float32),
+                             stop_gradient=False)
+
+        import jax
+
+        def loss_via_trace(arr):
+            t = paddle.to_tensor(arr)
+            t.stop_gradient = False
+            out = tf(t)
+            out.backward()
+            return t.grad._data
+
+        g = jax.jit(loss_via_trace)(x._data)
+        # d/dx sum(3*x^2) = 6x = 12
+        np.testing.assert_allclose(np.asarray(g), [12.0, 12.0], rtol=1e-5)
+
+    def test_grad_param_accessed_inside_branch(self):
+        # review r4 finding 1: a Layer whose param is REACHED only inside
+        # the branch (self.fc(x) under the if) must still train — closure
+        # capture discovery functionalizes it into a region input
+        import paddle_tpu.nn as nn
+
+        class Gate(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(3, 3)
+
+            def forward(self, x):
+                if paddle.mean(x) > 0:
+                    y = self.fc(x) * 2.0
+                else:
+                    y = self.fc(x) * -1.0
+                return y
+
+        paddle.seed(11)
+        layer = Gate()
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=layer.parameters())
+
+        def step(x):
+            loss = paddle.mean(layer(x))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        train = paddle.jit.TrainStep(step, layer, opt)
+        w0 = layer.fc.weight.numpy().copy()
+        layer.forward = ast_transform(layer.forward)
+        train(paddle.to_tensor(np.ones((2, 3), np.float32)))
+        assert not np.allclose(layer.fc.weight.numpy(), w0)
+
+    def test_nested_for_in_for_traced(self):
+        # review r4 finding 2: nested loops — inner region must recognize
+        # the outer region's UNDEF placeholders
+        def f(t):
+            acc = paddle.zeros([], "float32")
+            for i in range(t.shape[0]):
+                for j in range(t.shape[1]):
+                    acc = acc + t[i][j]
+            return acc
+
+        t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        eager = f(t)
+        out = paddle.jit.to_static(f)(t)
+        np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-6)
+
+    def test_for_in_tensor_if_traced(self):
+        def f(t):
+            s = paddle.zeros([], "float32")
+            if paddle.sum(t) > 0:
+                for i in range(t.shape[0]):
+                    s = s + paddle.sum(t[i])
+            else:
+                s = s - 1.0
+            return s
+
+        t = paddle.to_tensor(np.ones((3, 2), np.float32))
+        eager = f(t)
+        out = paddle.jit.to_static(f)(t)
+        np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-6)
+
+    def test_traced_range_step(self):
+        # review r4 finding 3: traced `step` must not drift the counter aval
+        def f(x, s):
+            acc = paddle.zeros_like(x)
+            for i in range(0, 6, s):
+                acc = acc + x
+            return acc
+
+        fn = paddle.jit.to_static(f)
+        out = fn(paddle.to_tensor(np.ones(2, np.float32)),
+                 paddle.to_tensor(np.int32(2)))
+        np.testing.assert_allclose(out.numpy(), [3.0, 3.0], rtol=1e-6)
+
+    def test_zero_length_for_traced(self):
+        # review r4 finding 4: zero trip count must compile (loop-created
+        # name stays a placeholder)
+        def f(t):
+            acc = paddle.zeros([], "float32")
+            for i in range(t.shape[0]):
+                y = paddle.sum(t[i])
+                acc = acc + y
+            return acc
+
+        t = paddle.to_tensor(np.zeros((0, 2), np.float32))
+        out = paddle.jit.to_static(f)(t)
+        assert float(out.numpy()) == 0.0
+
+    def test_grad_through_iterated_tensor(self):
+        # review r4 round 2: `for row in h` with h requiring grads must
+        # backprop through the rows (the iterable is a region input)
+        from paddle_tpu.jit.dy2static import ast_transform as tr
+
+        def f(h):
+            acc = paddle.zeros([2], "float32")
+            for row in h:
+                acc = acc + row * row
+            return paddle.sum(acc)
+
+        tf = tr(f)
+
+        import jax
+
+        def run(arr):
+            t = paddle.to_tensor(arr)
+            t.stop_gradient = False
+            out = tf(t)
+            out.backward()
+            return t.grad._data
+
+        arr = np.arange(6, dtype=np.float32).reshape(3, 2)
+        g = jax.jit(run)(arr)
+        np.testing.assert_allclose(np.asarray(g), 2 * arr, rtol=1e-5)
+
+    def test_while_true_tensor_break_traced(self):
+        # review r4 round 2: `while True` whose break flag turns traced
+        # mid-loop must hand off to the lax lowering, not crash
+        def f(x):
+            n = paddle.zeros([], "float32")
+            while True:
+                x = x / 2.0
+                n = n + 1.0
+                if paddle.max(x) < 1.0:
+                    break
+            return x, n
+
+        x = paddle.to_tensor(np.full((2,), 8.0, np.float32))
+        e_x, e_n = f(x)
+        s_x, s_n = paddle.jit.to_static(f)(x)
+        np.testing.assert_allclose(s_x.numpy(), e_x.numpy(), rtol=1e-6)
+        assert float(s_n.numpy()) == float(e_n.numpy()) == 4.0
+
+    def test_cond_assigned_value_survives_later_loop(self):
+        # review r4 round 3: a variable assigned in BOTH branches of a
+        # tensor if, then updated in a later traced loop, must keep its
+        # real value (the UNDEF placeholder mark must not leak out of the
+        # cond and trigger a NaN reseed)
+        def f(x):
+            if paddle.mean(x) > 0:
+                y = x * 2.0
+            else:
+                y = x + 1.0
+            for i in range(3):
+                y = y + 1.0
+            return y
+
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        eager = f(x)
+        np.testing.assert_allclose(eager.numpy(), [5.0, 5.0])
+        out = paddle.jit.to_static(f)(x)
+        np.testing.assert_allclose(out.numpy(), eager.numpy(), rtol=1e-6)
+
+    def test_grad_through_traced_while_raises(self):
+        from paddle_tpu.jit.dy2static import ast_transform as tr
+
+        def f(x):
+            while paddle.max(x) > 1.0:
+                x = x / 2.0
+            return paddle.sum(x)
+
+        tf = tr(f)
+
+        import jax
+
+        def run(arr):
+            t = paddle.to_tensor(arr)
+            t.stop_gradient = False
+            out = tf(t)
+            out.backward()
+            return t.grad._data
+
+        with pytest.raises(NotImplementedError, match="while"):
+            jax.jit(run)(np.full(2, 8.0, np.float32))
 
 
 @paddle.jit.to_static

@@ -120,3 +120,59 @@ def test_kill_one_of_three_resumes_at_world_two(tmp_path):
         assert steps[-1] == 80
         assert all(b <= a + 1e-9 for a, b in zip(losses, losses[1:])), \
             f"host{r} loss regressed across restart"
+
+
+def test_stale_claim_taken_over():
+    """ADVICE r4: a leader that wins the generation claim but dies before
+    publishing must not wedge the survivors — the claim is a lease, and
+    after claim_ttl another survivor takes it over."""
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    from paddle_tpu.distributed.store import TCPStore
+
+    port = _free_port()
+    store = TCPStore("127.0.0.1", port, is_master=True, world_size=1)
+    m = ElasticManager(store=store, rank=1, world_size=3,
+                       heartbeat_interval=0.1, lease_ttl=0.6, claim_ttl=0.4)
+    # rank 0 (the would-be leader) died AFTER winning the gen-1 claim but
+    # BEFORE publishing members/1 + bumping the gen pointer:
+    assert int(store.add("elastic/claim/1", 1)) == 1
+    status = None
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        # ranks 1 and 2 are alive (manual heartbeats; no threads in-test)
+        store.set("elastic/host/0/1", str(time.time()))
+        store.set("elastic/host/0/2", str(time.time()))
+        status = m.watch()
+        if status == ElasticStatus.RESTART:
+            break
+        time.sleep(0.1)
+    assert status == ElasticStatus.RESTART, "survivors held forever"
+    assert m.gen == 1
+    assert m.members == [1, 2]
+
+
+def test_claim_fulfilled_but_gen_not_bumped():
+    """Review r4: claimant wrote members/<g+1> but died before bumping
+    elastic/gen — survivors must complete the bump after claim_ttl."""
+    from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                      ElasticStatus)
+    from paddle_tpu.distributed.store import TCPStore
+
+    port = _free_port()
+    store = TCPStore("127.0.0.1", port, is_master=True, world_size=1)
+    m = ElasticManager(store=store, rank=1, world_size=3,
+                       heartbeat_interval=0.1, lease_ttl=0.6, claim_ttl=0.4)
+    assert int(store.add("elastic/claim/1", 1)) == 1
+    store.set("elastic/members/1", "1,2")  # written, but gen never bumped
+    status = None
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        store.set("elastic/host/0/1", str(time.time()))
+        store.set("elastic/host/0/2", str(time.time()))
+        status = m.watch()
+        if status == ElasticStatus.RESTART:
+            break
+        time.sleep(0.1)
+    assert status == ElasticStatus.RESTART, "bump never completed"
+    assert m.gen == 1 and m.members == [1, 2]
