@@ -124,6 +124,23 @@ def distributed_optimizer(optimizer, strategy=None):
     """fleet.distributed_optimizer (fleet.py:1058) — wraps the inner
     optimizer; cross-group grad sync/clip is compiled into the engine step
     (HybridParallelOptimizer, hybrid_parallel_optimizer.py:186, collapses)."""
+    strat = strategy or _fleet_state.get("strategy")
+    for flag, hint in (
+            ("lars", "paddle_tpu.optimizer.Lars"),
+            ("lamb", "paddle_tpu.optimizer.Lamb"),
+            ("localsgd", "the static-mode localsgd pass"),
+            ("fp16_allreduce", "the static-mode fp16_allreduce pass")):
+        if strat is not None and getattr(strat, flag, False):
+            import warnings
+
+            # these meta-optimizer flags are honored by the static-mode
+            # pass pipeline (distributed.passes.apply_pass_by_strategy);
+            # the dygraph engine path does not consume them
+            warnings.warn(
+                f"DistributedStrategy.{flag} is honored in static mode "
+                f"via apply_pass_by_strategy; this dygraph "
+                f"distributed_optimizer ignores it — use {hint} directly",
+                stacklevel=2)
     _fleet_state["optimizer"] = optimizer
 
     class _DistOpt:

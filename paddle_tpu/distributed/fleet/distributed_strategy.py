@@ -17,10 +17,6 @@ __all__ = ["DistributedStrategy"]
 # field -> why it is inert here / what to use instead
 _INERT_TOGGLES = {
     "dgc": "deep gradient compression has no XLA collective equivalent",
-    "localsgd": "use dp_degree with a larger batch instead",
-    "fp16_allreduce": "grads already reduce in the compute dtype (bf16)",
-    "lars": "pass a LARS-wrapped optimizer explicitly",
-    "lamb": "use paddle_tpu.optimizer.Lamb as the inner optimizer",
     "a_sync": "async PS mode is out of scope (see distributed/ps)",
     "heter_ccl_mode": "heterogeneous collectives are not supported",
 }
@@ -49,11 +45,20 @@ class DistributedStrategy:
         self.sharding_configs = {"stage": 1, "offload": False}
         self.gradient_merge = False
         self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        # optimizer-swap / comm meta-optimizers, honored by
+        # distributed.passes.apply_pass_by_strategy in static mode
         self.lamb = False
+        self.lamb_configs = {"lamb_weight_decay": 0.01}
         self.lars = False
+        self.lars_configs = {"lars_coeff": 0.001,
+                             "lars_weight_decay": 0.0005}
         self.dgc = False
         self.localsgd = False
+        self.localsgd_configs = {"k_steps": 4, "begin_step": 1}
         self.fp16_allreduce = False
+        # bfloat16 is the natural TPU reduce dtype; float16 is the
+        # reference default
+        self.fp16_allreduce_configs = {"dtype": "float16"}
         self.find_unused_parameters = False
         self.gradient_scale_configs = {"scale_strategy": "avg"}
         self.fuse_all_reduce_ops = True

@@ -193,11 +193,17 @@ class ElasticManager:
         if self.store.check(f"elastic/members/{new_gen}"):
             # membership written but the gen pointer never moved: the
             # claimant died BETWEEN the two publish store-ops. Finish the
-            # publish for it (same claim_ttl patience + guarded bump).
+            # publish for it (same claim_ttl patience). The bump itself is
+            # guarded by an exclusive attempt-indexed key — two survivors
+            # with divergent alive-views can BOTH reach this path in the
+            # same window, and unguarded concurrent add()s would advance
+            # gen past the last members/<g> key and wedge every rank.
             first = self._claim_seen.setdefault(("bump", new_gen),
                                                 time.time())
-            if time.time() - first >= self.claim_ttl and \
-                    int(self.store.add("elastic/gen", 0)) == self.gen:
+            attempt = int((time.time() - first) // self.claim_ttl)
+            if attempt >= 1 and int(self.store.add(
+                    f"elastic/bump/{new_gen}/retry{attempt}", 1)) == 1 \
+                    and int(self.store.add("elastic/gen", 0)) == self.gen:
                 self.store.add("elastic/gen", 1)
             return
         first = self._claim_seen.setdefault(new_gen, time.time())

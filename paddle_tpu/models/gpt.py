@@ -69,9 +69,24 @@ class GPTConfig:
         return V * d + self.seq_len * d + L * (12 * d * d + 13 * d) + 2 * d
 
     def flops_per_token(self):
-        """Training FLOPs/token ≈ 6N + attention term (scaling-book rule)."""
-        N = self.num_params() - self.vocab_size * self.d_model
-        return 6 * N + 12 * self.n_layer * self.d_model * self.seq_len
+        """Training (fwd+bwd) model FLOPs per token, standard accounting.
+
+        Matches the convention shared by Megatron-LM's formula
+        96*B*s*L*h^2*(1 + s/(6h) + V/(16Lh)) — whose V/(16Lh) term IS the
+        vocab projection — and PaLM appendix B / nanoGPT `estimate_mfu`
+        (6 FLOPs per parameter participating in a matmul, + the O(T)
+        attention score/value term). Concretely:
+          * transformer blocks + final LN: 6 FLOPs/param,
+          * tied LM head: 6*V*d — the [*,d]x[d,V] logits matmul and its
+            two backward matmuls are real MXU work (the tied embedding
+            weight participates; its forward *lookup* is a gather and
+            contributes nothing),
+          * position embeddings: excluded (pure lookup),
+          * attention scores+values: 12*L*d*T fwd+bwd.
+        """
+        d, L, V = self.d_model, self.n_layer, self.vocab_size
+        block_params = L * (12 * d * d + 13 * d) + 2 * d
+        return 6 * (block_params + V * d) + 12 * L * d * self.seq_len
 
 
 class GPTAttention(nn.Layer):

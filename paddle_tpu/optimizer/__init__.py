@@ -2,5 +2,5 @@
 from . import lr  # noqa: F401
 from .adam import (Adam, AdamW, Adamax, Adadelta, Adagrad,  # noqa: F401
                    Lamb, RMSProp)
-from .optimizer import SGD, Momentum, Optimizer  # noqa: F401
+from .optimizer import SGD, Lars, Momentum, Optimizer  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401

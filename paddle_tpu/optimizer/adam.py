@@ -245,6 +245,8 @@ class Lamb(Optimizer):
     """Reference `python/paddle/optimizer/lamb.py` + lamb_kernel.cu; layerwise
     trust ratio on top of Adam — the LAMB used by BERT large-batch pretrain."""
 
+    _STATIC_ACCS = ["moment1", "moment2"]
+
     def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
                  beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
                  exclude_from_weight_decay_fn=None, name=None):

@@ -14,7 +14,7 @@ from ..core.dispatch import forward
 from ..core.tensor import Parameter, Tensor
 from .lr import LRScheduler
 
-__all__ = ["Optimizer", "SGD", "Momentum"]
+__all__ = ["Optimizer", "SGD", "Momentum", "Lars"]
 
 
 class Optimizer:
@@ -229,5 +229,55 @@ class Momentum(Optimizer):
             return w_new, v_new
 
         new_p, new_v = forward(f, (p, g, vel), name="momentum", nondiff=True)
+        p._data = new_p._data
+        vel._data = new_v._data
+
+
+class Lars(Momentum):
+    """LARS momentum: layer-wise adaptive rate scaling for large-batch SGD
+    (reference `python/paddle/fluid/optimizer.py` LarsMomentumOptimizer +
+    `phi/kernels/gpu/lars_momentum_kernel.cu`):
+
+        local_lr = lr * lars_coeff * ||w|| / (||g|| + wd * ||w|| + eps)
+        v_new    = mu * v + local_lr * (g + wd * w)
+        w_new    = w - v_new
+
+    Norms accumulate in fp32 regardless of param dtype (the CUDA kernel's
+    MT=float master-type path)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, epsilon=1e-9,
+                 exclude_from_weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, momentum, parameters,
+                         use_nesterov=False, weight_decay=None,
+                         grad_clip=grad_clip, name=name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+        self._exclude = list(exclude_from_weight_decay or [])
+
+    def _apply_one(self, p, g):
+        lr = self._lr_for(p)
+        mu, coeff, eps = self._momentum, self._lars_coeff, self._eps
+        wd = self._lars_wd
+        pname = getattr(p, "name", "") or ""
+        if any(k in pname for k in self._exclude):
+            wd = 0.0
+        vel = self._acc("velocity", p)
+
+        def f(w, gg, v):
+            wf = w.astype(jnp.float32)
+            gf = gg.astype(jnp.float32)
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
+            local_lr = jnp.where(
+                (w_norm > 0) & (g_norm > 0),
+                lr * coeff * w_norm / (g_norm + wd * w_norm + eps),
+                jnp.float32(lr))
+            v_new = mu * v.astype(jnp.float32) + local_lr * (gf + wd * wf)
+            return (wf - v_new).astype(w.dtype), v_new.astype(v.dtype)
+
+        new_p, new_v = forward(f, (p, g, vel), name="lars_momentum",
+                               nondiff=True)
         p._data = new_p._data
         vel._data = new_v._data

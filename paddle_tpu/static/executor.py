@@ -74,11 +74,45 @@ class _CompiledStep:
         # sharding pass: compile the step over a 'sharding' mesh —
         # built lazily at first run (shardings depend on feed shapes)
         self.sharding_degree = int(getattr(program, "sharding_degree", 1))
+        # localsgd / fp16_allreduce passes: GSPMD's implicit grad reduce
+        # can neither be skipped k-1 of k steps nor dtype-annotated, so
+        # these compile the step under shard_map with explicit collectives
+        # over a 'dp' axis (degree = sharding_degree) instead
+        self.localsgd_k = int(getattr(program, "localsgd_k", 1))
+        self.localsgd_begin = int(getattr(program, "localsgd_begin", 1))
+        self.fp16_ar_low = {"float16": jnp.float16,
+                            "bfloat16": jnp.bfloat16}.get(
+            getattr(program, "fp16_allreduce_dtype", None))
+        self._replica_mode = self.localsgd_k > 1 or \
+            self.fp16_ar_low is not None
+        self._replica_trace = False
+        if self._replica_mode:
+            if self.sharding_degree < 2:
+                raise ValueError(
+                    "localsgd/fp16_allreduce need the sharding pass "
+                    "(sharding_degree >= 2) to define the replica axis")
+            if self.amp_dtype or getattr(program, "grad_merge_k", 1) > 1:
+                raise ValueError(
+                    "localsgd/fp16_allreduce do not compose with amp O2 "
+                    "or gradient merge in this build")
+            if self.localsgd_k > 1 and self.fp16_ar_low is not None:
+                raise ValueError(
+                    "localsgd takes purely local steps — there is no "
+                    "per-step grad reduce for fp16_allreduce to apply to; "
+                    "enable one or the other")
         self._jitted = None if self.sharding_degree > 1 \
             else jax.jit(self._step)
 
     # ---------------------------------------------------------------- state
     def _init_opt_state(self):
+        if getattr(self.program, "localsgd_k", 1) > 1:
+            # two BOUNDED fp32 counters (an ever-growing step count would
+            # freeze at 2^24): @lsgd@cyc cycles mod k like @gm@runs,
+            # @lsgd@warm saturates at begin_step+1
+            for nm in ("@lsgd@cyc", "@lsgd@warm"):
+                if nm not in self.scope.vars:
+                    self.scope.set(nm, jnp.zeros((), jnp.float32))
+                self.opt_state_names.append(nm)
         k = getattr(self.program, "grad_merge_k", 1)
         if k > 1:
             if len(self.program.minimize_reqs) != 1:
@@ -173,6 +207,21 @@ class _CompiledStep:
                 loss_t.backward()
                 trainables = [pv for pv in self.param_vars
                               if not pv.stop_gradient]
+                if self._replica_trace and self.localsgd_k == 1 and \
+                        self.fp16_ar_low is not None:
+                    # fp16_allreduce pass: the dp grad reduce crosses the
+                    # interconnect in half precision (explicit pmean —
+                    # inside shard_map there is no implicit GSPMD reduce,
+                    # so skipping this would silently train on local grads)
+                    for pv in trainables:
+                        pt = param_tensors[pv.name]
+                        if pt.grad is None:
+                            continue
+                        g = pt.grad._data if isinstance(pt.grad, Tensor) \
+                            else jnp.asarray(pt.grad)
+                        g = jax.lax.pmean(g.astype(self.fp16_ar_low),
+                                          "dp").astype(g.dtype)
+                        pt.grad = Tensor(g)
                 if gm_k > 1:
                     self._grad_merge_apply(oi, opt, trainables,
                                            param_tensors, new_opt, gm_k)
@@ -332,15 +381,170 @@ class _CompiledStep:
             in_shardings=(feed_sh, param_sh, opt_sh),
             out_shardings=(fetch_sh, param_sh, opt_sh))
 
+    # ------------------------------------------------------- replica mode
+    def _replica_step(self, feed_arrays, param_arrays, opt_arrays):
+        """shard_map body for localsgd / fp16_allreduce: each 'dp' mesh
+        slot runs the full step on its batch shard with explicit
+        collectives. Under localsgd, params/optimizer state arrive with a
+        leading per-replica axis (sharded over 'dp' → one copy per device,
+        same device memory as replication) and may diverge between syncs;
+        every k-th run resyncs them with a pmean gated in-graph
+        (reference localsgd_optimizer.py's cond-block c_allreduce). The
+        per-replica copies live ONLY under reserved @lsgd@rep@ scope names;
+        alongside the tiled outputs the step returns replicated mean
+        snapshots that run() writes back under the canonical names, so
+        every other scope consumer (static.save, eval programs, startup
+        reinit) keeps seeing ordinary untiled arrays."""
+        lsgd = self.localsgd_k > 1
+        if lsgd:
+            params = tuple(a[0] for a in param_arrays)
+            opts = tuple(a[0] for a in opt_arrays)
+        else:
+            params, opts = param_arrays, opt_arrays
+        self._replica_trace = True
+        try:
+            fetches, new_params, new_opt = self._step(feed_arrays, params,
+                                                      opts)
+        finally:
+            self._replica_trace = False
+        mean_params = mean_opt = ()
+        if lsgd:
+            no = dict(zip(self.opt_state_names, new_opt))
+            cyc = no["@lsgd@cyc"] + 1.0
+            warm = jnp.minimum(no["@lsgd@warm"] + 1.0,
+                               float(self.localsgd_begin) + 1.0)
+            sync = (warm <= float(self.localsgd_begin)) | \
+                jnp.equal(cyc, float(self.localsgd_k))
+            new_params = tuple(
+                jnp.where(sync, jax.lax.pmean(p, "dp"), p)
+                for p in new_params)
+            no["@lsgd@cyc"] = jnp.where(
+                jnp.equal(cyc, float(self.localsgd_k)),
+                jnp.zeros_like(cyc), cyc)
+            no["@lsgd@warm"] = warm
+            new_opt = tuple(no[n] for n in self.opt_state_names)
+            mean_params = tuple(
+                jax.lax.pmean(p.astype(jnp.float32), "dp").astype(p.dtype)
+                for p in new_params)
+            mean_opt = tuple(
+                jax.lax.pmean(o.astype(jnp.float32), "dp").astype(o.dtype)
+                if jnp.issubdtype(jnp.asarray(o).dtype, jnp.inexact)
+                else jax.lax.pmax(o, "dp")
+                for o in new_opt)
+            new_params = tuple(p[None] for p in new_params)
+            new_opt = tuple(o[None] for o in new_opt)
+
+        def merge_fetch(f, batch_aligned):
+            # the program's recorded shape decides (leading dim -1 =
+            # batch): batch fetches reassemble to the global batch;
+            # reduced values average (loss/metrics) or max (flags) — a
+            # non-batch fetch whose leading dim merely coincides with the
+            # local batch size must NOT be gathered
+            f = jnp.asarray(f)
+            if batch_aligned and f.ndim >= 1:
+                return jax.lax.all_gather(f, "dp", axis=0, tiled=True)
+            if jnp.issubdtype(f.dtype, jnp.inexact):
+                return jax.lax.pmean(f, "dp")
+            return jax.lax.pmax(f, "dp")
+
+        aligned = tuple(
+            bool(getattr(v, "_static_shape", None)) and
+            v._static_shape[0] == -1 for v in self.fetch_vars)
+        return (tuple(merge_fetch(f, a) for f, a in zip(fetches, aligned)),
+                new_params, new_opt, mean_params, mean_opt)
+
+    def _build_replica_jit(self, feed_arrays):
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        deg = self.sharding_degree
+        devs = jax.devices()
+        if len(devs) < deg:
+            raise RuntimeError(
+                f"sharding_degree={deg} needs {deg} devices, have "
+                f"{len(devs)}")
+        mesh = Mesh(np.array(devs[:deg]), ("dp",))
+
+        def feed_spec(a):
+            a = np.asarray(a)
+            if a.ndim >= 1 and a.shape[0] % deg == 0 and a.shape[0] > 0:
+                return P("dp")
+            return P()
+
+        feed_specs = tuple(feed_spec(a) for a in feed_arrays)
+        if feed_specs and feed_specs[0] == P():
+            # a replicated primary feed means every replica trains on the
+            # full batch — no data parallelism at all, and batch-shaped
+            # fetches would gather duplicated rows; fail loudly instead
+            raise ValueError(
+                "localsgd/fp16_allreduce need the first feed's batch "
+                f"dim divisible by the replica degree ({deg}); got shape "
+                f"{np.asarray(feed_arrays[0]).shape}")
+        lsgd = self.localsgd_k > 1
+        state_spec = P("dp") if lsgd else P()
+        param_specs = tuple(state_spec for _ in self.param_vars)
+        opt_specs = tuple(state_spec for _ in self.opt_state_names)
+        fetch_specs = tuple(P() for _ in self.fetch_vars)
+        mean_p_specs = tuple(P() for _ in self.param_vars) if lsgd else ()
+        mean_o_specs = tuple(P() for _ in self.opt_state_names) if lsgd \
+            else ()
+        # check_vma=False: the body's replication facts (pmean'd grads →
+        # identical updates) exceed what the rep checker can prove through
+        # the taped dispatch graph
+        self._jitted = jax.jit(jax.shard_map(
+            self._replica_step, mesh=mesh,
+            in_specs=(feed_specs, tuple(param_specs), tuple(opt_specs)),
+            out_specs=(fetch_specs, tuple(param_specs), tuple(opt_specs),
+                       mean_p_specs, mean_o_specs),
+            check_vma=False))
+
+    def _lsgd_inputs(self, param_arrays, opt_arrays):
+        """Assemble the tiled per-replica inputs: the @lsgd@rep@ copy when
+        one exists with the expected shape, else the canonical array
+        broadcast to every replica (first run, or after a checkpoint load
+        / startup reinit cleared the copies — training then resumes from
+        the synced state)."""
+        deg = self.sharding_degree
+
+        def pick(name, canonical):
+            canonical = jnp.asarray(canonical)
+            rep = self.scope.vars.get("@lsgd@rep@" + name)
+            if rep is not None and tuple(rep.shape) == \
+                    (deg,) + tuple(canonical.shape):
+                return rep
+            return jnp.broadcast_to(canonical[None],
+                                    (deg,) + tuple(canonical.shape))
+
+        return (tuple(pick(pv.name, a)
+                      for pv, a in zip(self.param_vars, param_arrays)),
+                tuple(pick(n, a)
+                      for n, a in zip(self.opt_state_names, opt_arrays)))
+
     # ----------------------------------------------------------------- run
     def run(self, feed):
         from ..core import flags as _flags
 
+        lsgd = self._replica_mode and self.localsgd_k > 1
+        if lsgd:
+            # a startup reinit / checkpoint load clears @lsgd@ state;
+            # re-seed the counters before the scope reads below
+            for n in self.opt_state_names:
+                if n.startswith("@lsgd@") and n not in self.scope.vars:
+                    self.scope.set(n, jnp.zeros((), jnp.float32))
         feed_arrays = tuple(np.asarray(feed[n]) for n in self.feed_names)
         param_arrays = tuple(self.scope.vars[pv.name]
                              for pv in self.param_vars)
         opt_arrays = tuple(self.scope.vars[n] for n in self.opt_state_names)
-        if self._jitted is None:
+        if self._replica_mode:
+            if _flags._FLAGS["FLAGS_check_nan_inf"]:
+                raise RuntimeError(
+                    "FLAGS_check_nan_inf per-op replay cannot run inside "
+                    "the localsgd/fp16_allreduce shard_map step")
+            if lsgd:
+                param_arrays, opt_arrays = self._lsgd_inputs(param_arrays,
+                                                             opt_arrays)
+            if self._jitted is None:
+                self._build_replica_jit(feed_arrays)
+        elif self._jitted is None:
             self._build_sharded_jit(feed_arrays, param_arrays, opt_arrays)
         if _flags._FLAGS["FLAGS_check_nan_inf"]:
             # debug mode: replay per-op eagerly so dispatch's finite check
@@ -348,6 +552,22 @@ class _CompiledStep:
             # nan_inf_utils_detail.cc per-op scan semantics)
             fetches, new_params, new_opt = self._step(
                 feed_arrays, param_arrays, opt_arrays)
+        elif self._replica_mode:
+            fetches, rep_params, rep_opt, mean_params, mean_opt = \
+                self._jitted(feed_arrays, param_arrays, opt_arrays)
+            if lsgd:
+                # canonical names keep the replicated mean snapshot; the
+                # divergent per-replica copies live only under @lsgd@rep@
+                for pv, rep, mean in zip(self.param_vars, rep_params,
+                                         mean_params):
+                    self.scope.set("@lsgd@rep@" + pv.name, rep)
+                    self.scope.set(pv.name, mean)
+                for n, rep, mean in zip(self.opt_state_names, rep_opt,
+                                        mean_opt):
+                    self.scope.set("@lsgd@rep@" + n, rep)
+                    self.scope.set(n, mean)
+                return [np.asarray(f) for f in fetches]
+            new_params, new_opt = rep_params, rep_opt
         else:
             fetches, new_params, new_opt = self._jitted(
                 feed_arrays, param_arrays, opt_arrays)
@@ -372,6 +592,11 @@ class Executor:
         # startup program: (re)initialize parameters into the scope
         if program is prog_mod.default_startup_program() or (
                 not program.ops and program.params and not fetch_list):
+            # reinit must not leave stale localsgd replica copies or
+            # counters behind — the next localsgd run re-broadcasts from
+            # the canonical params and restarts its sync cycle
+            for n in [n for n in scope.vars if n.startswith("@lsgd@")]:
+                del scope.vars[n]
             for pv, init in prog_mod.default_main_program().params:
                 if scope.find_var(pv.name) is None:
                     scope.set(pv.name, init)

@@ -63,12 +63,16 @@ def save(program, path_prefix, scope=None):
     with open(path_prefix + ".pdmodel", "wb") as f:
         f.write(_PROGRAM_MAGIC)
         cloudpickle.dump(program, f)
+    # canonical scope entries are always untiled — under localsgd the
+    # executor keeps divergent per-replica copies only under @lsgd@rep@
+    # names (skipped here: a checkpoint records the replicated mean
+    # snapshot, i.e. the state the next sync would produce)
     params = {pv.name: np.asarray(scope.vars[pv.name])
               for pv, _ in program.params if pv.name in scope.vars}
     with open(path_prefix + ".pdparams", "wb") as f:
         pickle.dump(params, f, protocol=4)
     opt_state = {n: np.asarray(v) for n, v in scope.vars.items()
-                 if n.startswith("@")}
+                 if n.startswith("@") and not n.startswith("@lsgd@")}
     with open(path_prefix + ".pdopt", "wb") as f:
         pickle.dump(opt_state, f, protocol=4)
 
@@ -77,6 +81,10 @@ def load(program, path_prefix, executor=None, var_list=None, scope=None):
     """`paddle.static.load`: restore params (+ optimizer state) into the
     scope for `program`. Training resumes exactly where `save` left off."""
     scope = scope or global_scope()
+    # per-replica localsgd copies are not checkpointed (see save) — drop
+    # any live ones so the loaded canonical state re-broadcasts cleanly
+    for n in [n for n in scope.vars if n.startswith("@lsgd@")]:
+        del scope.vars[n]
     with open(path_prefix + ".pdparams", "rb") as f:
         for name, arr in pickle.load(f).items():
             scope.set(name, jnp.asarray(arr))

@@ -342,3 +342,326 @@ class TestGradClipPass:
                 new_pass("auto_parallel_grad_clip").apply([prog])
         finally:
             paddle.disable_static()
+
+
+class TestOptimizerSwapPasses:
+    """auto_parallel_lars / auto_parallel_lamb (reference
+    fleet/meta_optimizers/{lars,lamb}_optimizer.py inner-optimizer swap)."""
+
+    def _parity(self, pass_name, inner_cls, direct_cls):
+        paddle.seed(51)
+        paddle.static.global_scope().vars.clear()
+        main, startup, loss = _build_mlp_program(opt_cls=inner_cls)
+        ctx = new_pass(pass_name).apply([main])
+        assert ctx.get_attr(f"{pass_name}:swapped") == 1
+        swapped = _run_steps(main, startup, loss, 4, seed=5)
+
+        paddle.seed(51)
+        paddle.static.global_scope().vars.clear()
+        main2, startup2, loss2 = _build_mlp_program(opt_cls=direct_cls)
+        direct = _run_steps(main2, startup2, loss2, 4, seed=5)
+        np.testing.assert_allclose(swapped, direct, rtol=1e-5, atol=1e-6)
+        # the swapped update rule is actually live: params moved
+        scope = paddle.static.global_scope()
+        moved = [pv.name for pv, init in main2.params
+                 if not np.allclose(np.asarray(scope.vars[pv.name]),
+                                    np.asarray(init))]
+        assert moved
+
+    def test_lars_pass_matches_direct_lars(self):
+        try:
+            self._parity("auto_parallel_lars", paddle.optimizer.Momentum,
+                         paddle.optimizer.Lars)
+        finally:
+            paddle.disable_static()
+
+    def test_lamb_pass_matches_direct_lamb(self):
+        try:
+            # the pass copies the inner Adam's epsilon (1e-8), like the
+            # reference lamb_optimizer; match it in the direct build
+            self._parity(
+                "auto_parallel_lamb", paddle.optimizer.Adam,
+                lambda learning_rate: paddle.optimizer.Lamb(
+                    learning_rate=learning_rate, epsilon=1e-8))
+        finally:
+            paddle.disable_static()
+
+    def test_lars_rejects_adam_inner(self):
+        try:
+            paddle.static.global_scope().vars.clear()
+            main, _, _ = _build_mlp_program(opt_cls=paddle.optimizer.Adam)
+            with pytest.raises(ValueError, match="Momentum inner"):
+                new_pass("auto_parallel_lars").apply([main])
+        finally:
+            paddle.disable_static()
+
+    def test_lamb_rejects_adamw_and_weight_decay(self):
+        try:
+            paddle.static.global_scope().vars.clear()
+            main, _, _ = _build_mlp_program(opt_cls=paddle.optimizer.AdamW)
+            with pytest.raises(ValueError, match="Adam inner"):
+                new_pass("auto_parallel_lamb").apply([main])
+            paddle.static.global_scope().vars.clear()
+            main2, _, _ = _build_mlp_program(
+                opt_cls=lambda learning_rate: paddle.optimizer.Adam(
+                    learning_rate=learning_rate, weight_decay=1e-4))
+            with pytest.raises(ValueError, match="weight_decay"):
+                new_pass("auto_parallel_lamb").apply([main2])
+        finally:
+            paddle.disable_static()
+
+    def test_strategy_flags_compose(self):
+        from paddle_tpu.distributed.passes import apply_pass_by_strategy
+        from paddle_tpu.distributed import fleet
+
+        try:
+            paddle.static.global_scope().vars.clear()
+            main, _, _ = _build_mlp_program(opt_cls=paddle.optimizer.Adam)
+            strategy = fleet.DistributedStrategy()
+            strategy.lamb = True
+            ctx = apply_pass_by_strategy(main, strategy)
+            assert ctx.get_attr("auto_parallel_lamb:swapped") == 1
+            from paddle_tpu.optimizer import Lamb
+
+            assert isinstance(main.minimize_reqs[0][0], Lamb)
+        finally:
+            paddle.disable_static()
+
+
+class TestLocalSGDPass:
+    """auto_parallel_localsgd (reference
+    fleet/meta_optimizers/localsgd_optimizer.py): k local steps per
+    replica, periodic parameter averaging."""
+
+    def test_duplicated_shards_match_smaller_batch_run(self):
+        # both replicas see identical rows -> local steps identical ->
+        # the periodic average is a no-op and the run must equal a
+        # single-replica run on one shard's data
+        try:
+            rng = np.random.default_rng(7)
+            xs = [rng.normal(size=(4, 16)).astype(np.float32)
+                  for _ in range(5)]
+            ys = [rng.normal(size=(4, 1)).astype(np.float32)
+                  for _ in range(5)]
+
+            paddle.seed(61)
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program()
+            new_pass("auto_parallel_sharding",
+                     {"sharding_degree": 2}).apply([main])
+            new_pass("auto_parallel_localsgd",
+                     {"k_steps": 2}).apply([main])
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            dup = [float(exe.run(main,
+                                 feed={"x": np.concatenate([x, x]),
+                                       "y": np.concatenate([y, y])},
+                                 fetch_list=[loss])[0])
+                   for x, y in zip(xs, ys)]
+
+            paddle.seed(61)
+            paddle.static.global_scope().vars.clear()
+            main2, startup2, loss2 = _build_mlp_program()
+            exe2 = paddle.static.Executor()
+            exe2.run(startup2)
+            solo = [float(exe2.run(main2, feed={"x": x, "y": y},
+                                   fetch_list=[loss2])[0])
+                    for x, y in zip(xs, ys)]
+            np.testing.assert_allclose(dup, solo, rtol=1e-4, atol=1e-5)
+        finally:
+            paddle.disable_static()
+
+    def test_periodic_param_sync(self):
+        # different shards -> replicas diverge between syncs and are
+        # identical right after every k-th run (begin_step=1: run 1 syncs)
+        try:
+            paddle.seed(62)
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program()
+            new_pass("auto_parallel_sharding",
+                     {"sharding_degree": 2}).apply([main])
+            new_pass("auto_parallel_localsgd",
+                     {"k_steps": 3, "begin_step": 1}).apply([main])
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            scope = paddle.static.global_scope()
+            rng = np.random.default_rng(8)
+            pnames = [pv.name for pv, _ in main.params
+                      if not pv.stop_gradient]
+
+            def replicas_equal():
+                # divergent per-replica copies live under @lsgd@rep@;
+                # canonical names always hold the untiled mean snapshot
+                for n in pnames:
+                    assert tuple(np.asarray(scope.vars[n]).shape) == tuple(
+                        np.asarray(scope.vars["@lsgd@rep@" + n]).shape[1:])
+                return all(
+                    np.allclose(np.asarray(scope.vars["@lsgd@rep@" + n])[0],
+                                np.asarray(scope.vars["@lsgd@rep@" + n])[1])
+                    for n in pnames)
+
+            for run in range(1, 7):
+                exe.run(main,
+                        feed={"x": rng.normal(size=(8, 16)).astype(
+                            np.float32),
+                            "y": rng.normal(size=(8, 1)).astype(
+                                np.float32)},
+                        fetch_list=[loss])
+                if run == 1 or run % 3 == 0:
+                    assert replicas_equal(), f"run {run}: expected sync"
+                else:
+                    assert not replicas_equal(), \
+                        f"run {run}: expected divergence"
+        finally:
+            paddle.disable_static()
+
+
+class TestFP16AllreducePass:
+    """auto_parallel_fp16_allreduce (reference
+    fleet/meta_optimizers/fp16_allreduce_optimizer.py): the dp grad
+    reduce runs in half precision."""
+
+    def test_matches_plain_run_within_half_precision(self):
+        try:
+            rng = np.random.default_rng(9)
+            feeds = [{"x": rng.normal(size=(8, 16)).astype(np.float32),
+                      "y": rng.normal(size=(8, 1)).astype(np.float32)}
+                     for _ in range(4)]
+
+            paddle.seed(71)
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program()
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            base = [float(exe.run(main, feed=f, fetch_list=[loss])[0])
+                    for f in feeds]
+
+            paddle.seed(71)
+            paddle.static.global_scope().vars.clear()
+            main2, startup2, loss2 = _build_mlp_program()
+            new_pass("auto_parallel_sharding",
+                     {"sharding_degree": 2}).apply([main2])
+            ctx = new_pass("auto_parallel_fp16_allreduce").apply([main2])
+            assert ctx.get_attr("fp16_allreduce:dtype") == "float16"
+            exe2 = paddle.static.Executor()
+            exe2.run(startup2)
+            half = [float(exe2.run(main2, feed=f, fetch_list=[loss2])[0])
+                    for f in feeds]
+            np.testing.assert_allclose(base, half, rtol=5e-2, atol=5e-3)
+        finally:
+            paddle.disable_static()
+
+
+class TestReplicaModeGuards:
+    def test_localsgd_plus_fp16_allreduce_raises(self):
+        try:
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program()
+            new_pass("auto_parallel_sharding",
+                     {"sharding_degree": 2}).apply([main])
+            new_pass("auto_parallel_localsgd", {"k_steps": 2}).apply([main])
+            new_pass("auto_parallel_fp16_allreduce").apply([main])
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            with pytest.raises(ValueError, match="purely local"):
+                exe.run(main, feed={"x": np.zeros((8, 16), np.float32),
+                                    "y": np.zeros((8, 1), np.float32)},
+                        fetch_list=[loss])
+        finally:
+            paddle.disable_static()
+
+    def test_indivisible_batch_raises(self):
+        try:
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program()
+            new_pass("auto_parallel_sharding",
+                     {"sharding_degree": 2}).apply([main])
+            new_pass("auto_parallel_fp16_allreduce").apply([main])
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            with pytest.raises(ValueError, match="divisible"):
+                exe.run(main, feed={"x": np.zeros((7, 16), np.float32),
+                                    "y": np.zeros((7, 1), np.float32)},
+                        fetch_list=[loss])
+        finally:
+            paddle.disable_static()
+
+
+class TestLocalSGDCheckpoint:
+    def test_save_collapses_replica_axis_and_load_resumes(self, tmp_path):
+        import pickle
+
+        try:
+            paddle.seed(63)
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program()
+            new_pass("auto_parallel_sharding",
+                     {"sharding_degree": 2}).apply([main])
+            new_pass("auto_parallel_localsgd",
+                     {"k_steps": 3, "begin_step": 0}).apply([main])
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            rng = np.random.default_rng(10)
+
+            def step():
+                return float(exe.run(
+                    main,
+                    feed={"x": rng.normal(size=(8, 16)).astype(np.float32),
+                          "y": rng.normal(size=(8, 1)).astype(np.float32)},
+                    fetch_list=[loss])[0])
+
+            step(); step()  # mid-interval: replicas have diverged
+            prefix = str(tmp_path / "ck")
+            paddle.static.save(main, prefix)
+            with open(prefix + ".pdparams", "rb") as f:
+                saved = pickle.load(f)
+            scope = paddle.static.global_scope()
+            for pv, _ in main.params:
+                canon = np.asarray(scope.vars[pv.name])
+                rep = np.asarray(scope.vars["@lsgd@rep@" + pv.name])
+                # canonical scope entry is untiled; replica copies are
+                # divergent and live only under the reserved name
+                assert rep.shape == (2,) + canon.shape
+                assert not np.allclose(rep[0], rep[1])
+                np.testing.assert_allclose(canon, rep.mean(axis=0),
+                                           rtol=1e-4, atol=1e-6)
+                # the checkpoint records exactly the canonical snapshot
+                assert saved[pv.name].shape == canon.shape
+                np.testing.assert_allclose(saved[pv.name], canon, rtol=1e-6)
+            opt_saved = pickle.load(open(prefix + ".pdopt", "rb"))
+            assert not any(n.startswith("@lsgd@") for n in opt_saved)
+            # load back into the live scope and keep training: replica
+            # copies are dropped, training resumes from the synced state
+            paddle.static.load(main, prefix)
+            assert "@lsgd@rep@" + main.params[0][0].name not in scope.vars
+            assert np.isfinite(step())
+        finally:
+            paddle.disable_static()
+
+    def test_startup_reinit_after_localsgd_runs_clean(self):
+        # re-running the startup program mid-training must drop replica
+        # copies/counters and keep working (review r4: this crashed with
+        # KeyError '@lsgd@cyc' when state outlived a reinit)
+        try:
+            paddle.seed(64)
+            paddle.static.global_scope().vars.clear()
+            main, startup, loss = _build_mlp_program()
+            new_pass("auto_parallel_sharding",
+                     {"sharding_degree": 2}).apply([main])
+            new_pass("auto_parallel_localsgd",
+                     {"k_steps": 2}).apply([main])
+            exe = paddle.static.Executor()
+            exe.run(startup)
+            feed = {"x": np.random.default_rng(0).normal(
+                size=(8, 16)).astype(np.float32),
+                "y": np.zeros((8, 1), np.float32)}
+            exe.run(main, feed=feed, fetch_list=[loss])
+            # reinit mid-training (the default startup program routes
+            # through the Executor's real startup branch)
+            exe.run(paddle.static.default_startup_program())
+            scope = paddle.static.global_scope()
+            assert not any(n.startswith("@lsgd@") for n in scope.vars)
+            r = exe.run(main, feed=feed, fetch_list=[loss])
+            assert np.isfinite(float(r[0]))
+        finally:
+            paddle.disable_static()
