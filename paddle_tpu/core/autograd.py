@@ -135,6 +135,7 @@ def _is_float(x) -> bool:
 
 def _accumulate_leaf(tensor, g):
     """GradNodeAccumulation: write/accumulate `.grad` on a leaf tensor."""
+    from . import lazy as _lazy
     from .tensor import Tensor
 
     if tensor._hooks:
@@ -142,10 +143,15 @@ def _accumulate_leaf(tensor, g):
             out = h(Tensor(g, stop_gradient=True))
             if out is not None:
                 g = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+    # keep-mask note: the Tensor._data setter registers the new .grad as
+    # a lazy owner — a .grad someone still holds at materialization time
+    # becomes an executable output; one cleared before the segment runs
+    # stays a fused internal
     if tensor.grad is None:
         tensor.grad = Tensor(g, stop_gradient=True)
     else:
-        tensor.grad = Tensor(tensor.grad._data + g, stop_gradient=True)
+        tensor.grad = Tensor(_lazy.lazy_add(tensor.grad._data, g),
+                             stop_gradient=True)
 
 
 def _run_engine(seeds, retain_graph=False, capture=None):
@@ -176,8 +182,10 @@ def _run_engine(seeds, retain_graph=False, capture=None):
                     stack.append(tgt)
 
     def _add(node, slot, g):
+        from . import lazy as _lazy
+
         h = holders.setdefault(node, [None] * len(node.out_avals))
-        h[slot] = g if h[slot] is None else h[slot] + g
+        h[slot] = g if h[slot] is None else _lazy.lazy_add(h[slot], g)
 
     for node, slot, g in seeds:
         _add(node, slot, g)
@@ -227,7 +235,9 @@ def _run_engine(seeds, retain_graph=False, capture=None):
                 t = e[1]
                 if captured is not None and id(t) in capture:
                     if id(t) in captured:
-                        captured[id(t)] = captured[id(t)] + g
+                        from . import lazy as _lazy
+
+                        captured[id(t)] = _lazy.lazy_add(captured[id(t)], g)
                     else:
                         captured[id(t)] = g
                 else:

@@ -189,8 +189,15 @@ class LazyArray:
 
         self.node = node
         self.idx = idx
-        self.owners = weakref.WeakSet()  # Tensors holding this payload
+        # Tensors holding this payload, keyed by id: a WeakSet would hash
+        # and ==-compare Tensors, and Tensor.__eq__ is an elementwise OP
+        # (a duplicate add would dispatch it and recurse)
+        self.owners = weakref.WeakValueDictionary()
         node.refs.add(self)
+
+    def own(self, tensor):
+        """Register a Tensor currently holding this payload (keep-mask)."""
+        self.owners[id(tensor)] = tensor
 
     # ---- metadata (no materialization) ----
     @property
@@ -250,12 +257,33 @@ class LazyArray:
     def __bool__(self):
         return bool(self._force())
 
+    # engine-facing arithmetic: stays deferred (see lazy_add). Anything
+    # richer goes through the framework's op layer, not the payload type.
+    def __add__(self, other):
+        return lazy_add(self, other)
+
+    def __radd__(self, other):
+        return lazy_add(other, self)
+
 
 def force(x):
     """Concrete array for x (materializing a LazyArray)."""
     if isinstance(x, LazyArray):
         return x._force()
     return x
+
+
+def lazy_add(a, b):
+    """a + b staying deferred when either side is a pending LazyArray —
+    the backward engine's cotangent accumulations (GradTensorHolder `+`)
+    must not force mid-backward, or the one-round-trip property of the
+    lazy grad path dies at every multi-consumer output (residual adds)."""
+    a_pending = isinstance(a, LazyArray) and a.node.values is None
+    b_pending = isinstance(b, LazyArray) and b.node.values is None
+    if not (a_pending or b_pending):
+        return force(a) + force(b)
+    return build(jnp.add, "grad_accumulate", [a, b], {},
+                 fn_key(jnp.add), ())
 
 
 def build(fn, name, input_arrays, attrs, key, attrs_key):

@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 
 from . import dtype as dtypes
+from . import lazy as _lazy
 from .place import current_place, jax_device, place_of, Place
 
 
@@ -43,8 +44,8 @@ def _to_array(data, dtype=None, place=None):
 
 class Tensor:
     __slots__ = (
-        "_data", "stop_gradient", "grad", "_grad_node", "_out_idx", "name",
-        "persistable", "_hooks", "__weakref__", "__dict__",
+        "_payload", "stop_gradient", "grad", "_grad_node", "_out_idx",
+        "name", "persistable", "_hooks", "__weakref__", "__dict__",
     )
 
     def __init__(self, data=None, dtype=None, place=None, stop_gradient=True,
@@ -57,6 +58,21 @@ class Tensor:
         self.name = name
         self.persistable = False
         self._hooks = []
+
+    @property
+    def _data(self):
+        return self._payload
+
+    @_data.setter
+    def _data(self, value):
+        # lazy keep-mask: registering every holding Tensor here (not just
+        # dispatch outputs) is what lets `p._data = new_lazy` in an
+        # optimizer mark the update node as live — without it the segment
+        # never records the node's values and every later iteration
+        # re-executes the whole history (round-4 lazy-grad lesson)
+        self._payload = value
+        if isinstance(value, _lazy.LazyArray):
+            value.own(self)
 
     # -- basic introspection --------------------------------------------------
     @property

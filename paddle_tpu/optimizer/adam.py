@@ -36,17 +36,21 @@ class Adam(Optimizer):
             mw._data = p._data.astype(jnp.float32)
 
     def _apply_one(self, p, g):
-        lr = self._lr_for(p)
         b1, b2, eps = self._beta1, self._beta2, self._eps
-        t = self._opt_step
         self._create_accumulators(p)
         m = self._acc("moment1", p, dtype=jnp.float32)
         v = self._acc("moment2", p, dtype=jnp.float32)
         use_master = self._multi_precision and p._data.dtype != jnp.float32
         mw = self._acc("master_weight", p, dtype=jnp.float32) if use_master \
             else None
+        # lr and the step count are DYNAMIC: passed as op inputs rather
+        # than closure constants, so the lazy grad path's segment
+        # signature (keyed on the kernel's code + captured cells) stays
+        # identical across steps and its compiled executable caches
+        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
+        t_t = Tensor(jnp.asarray(self._opt_step, jnp.float32))
 
-        def f(w, gg, mm, vv, *master):
+        def f(w, gg, mm, vv, lr, t, *master):
             gf = gg.astype(jnp.float32)
             mm = b1 * mm + (1 - b1) * gf
             vv = b2 * vv + (1 - b2) * jnp.square(gf)
@@ -59,7 +63,7 @@ class Adam(Optimizer):
                 outs += (new,)
             return outs
 
-        ins = (p, g, m, v) + ((mw,) if use_master else ())
+        ins = (p, g, m, v, lr_t, t_t) + ((mw,) if use_master else ())
         outs = forward(f, ins, name="adam", nondiff=True)
         p._data = outs[0]._data
         m._data = outs[1]._data
@@ -81,21 +85,22 @@ class AdamW(Adam):
         self._apply_decay_param_fun = apply_decay_param_fun
 
     def _apply_one(self, p, g):
-        lr = self._lr_for(p)
         b1, b2, eps = self._beta1, self._beta2, self._eps
         wd = self._wd_coeff
         if self._apply_decay_param_fun is not None and \
                 not self._apply_decay_param_fun(p.name):
             wd = 0.0
-        t = self._opt_step
         self._create_accumulators(p)
         m = self._acc("moment1", p, dtype=jnp.float32)
         v = self._acc("moment2", p, dtype=jnp.float32)
         use_master = self._multi_precision and p._data.dtype != jnp.float32
         mw = self._acc("master_weight", p, dtype=jnp.float32) if use_master \
             else None
+        # dynamic lr/step as inputs — see Adam._apply_one
+        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
+        t_t = Tensor(jnp.asarray(self._opt_step, jnp.float32))
 
-        def f(w, gg, mm, vv, *master):
+        def f(w, gg, mm, vv, lr, t, *master):
             gf = gg.astype(jnp.float32)
             base = master[0] if master else w.astype(jnp.float32)
             base = base * (1 - lr * wd)
@@ -109,7 +114,7 @@ class AdamW(Adam):
                 outs += (new,)
             return outs
 
-        ins = (p, g, m, v) + ((mw,) if use_master else ())
+        ins = (p, g, m, v, lr_t, t_t) + ((mw,) if use_master else ())
         outs = forward(f, ins, name="adamw", nondiff=True)
         p._data = outs[0]._data
         m._data = outs[1]._data
@@ -256,15 +261,16 @@ class Lamb(Optimizer):
         self._exclude_fn = exclude_from_weight_decay_fn
 
     def _apply_one(self, p, g):
-        lr = self._lr_for(p)
         b1, b2, eps = self._beta1, self._beta2, self._eps
         wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
             else self._wd
-        t = self._opt_step
         m = self._acc("moment1", p, dtype=jnp.float32)
         v = self._acc("moment2", p, dtype=jnp.float32)
+        # dynamic lr/step as inputs — see Adam._apply_one
+        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
+        t_t = Tensor(jnp.asarray(self._opt_step, jnp.float32))
 
-        def f(w, gg, mm, vv):
+        def f(w, gg, mm, vv, lr, t):
             gf = gg.astype(jnp.float32)
             wf = w.astype(jnp.float32)
             mm = b1 * mm + (1 - b1) * gf
@@ -278,5 +284,6 @@ class Lamb(Optimizer):
             new = wf - lr * trust * r
             return new.astype(w.dtype), mm, vv
 
-        outs = forward(f, (p, g, m, v), name="lamb", nondiff=True)
+        outs = forward(f, (p, g, m, v, lr_t, t_t), name="lamb",
+                       nondiff=True)
         p._data, m._data, v._data = outs[0]._data, outs[1]._data, outs[2]._data

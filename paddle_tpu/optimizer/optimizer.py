@@ -194,9 +194,12 @@ class SGD(Optimizer):
                          name)
 
     def _apply_one(self, p, g):
-        lr = self._lr_for(p)
-        new_p = forward(lambda w, gg: w - lr * gg.astype(w.dtype), (p, g),
-                        name="sgd", nondiff=True)
+        # dynamic lr as an input (not a closure cell) keeps the lazy grad
+        # path's segment signature stable across steps — see Adam
+        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
+        new_p = forward(
+            lambda w, gg, lr: w - (lr * gg.astype(jnp.float32)).astype(
+                w.dtype), (p, g, lr_t), name="sgd", nondiff=True)
         p._data = new_p._data
 
 
@@ -215,12 +218,13 @@ class Momentum(Optimizer):
         self._acc("velocity", p)
 
     def _apply_one(self, p, g):
-        lr = self._lr_for(p)
         mu = self._momentum
         vel = self._acc("velocity", p)
+        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
 
-        def f(w, gg, v):
+        def f(w, gg, v, lr):
             gg = gg.astype(w.dtype)
+            lr = lr.astype(w.dtype)
             v_new = mu * v + gg
             if self._nesterov:
                 w_new = w - lr * (gg + mu * v_new)
@@ -228,7 +232,8 @@ class Momentum(Optimizer):
                 w_new = w - lr * v_new
             return w_new, v_new
 
-        new_p, new_v = forward(f, (p, g, vel), name="momentum", nondiff=True)
+        new_p, new_v = forward(f, (p, g, vel, lr_t), name="momentum",
+                               nondiff=True)
         p._data = new_p._data
         vel._data = new_v._data
 
@@ -257,27 +262,26 @@ class Lars(Momentum):
         self._exclude = list(exclude_from_weight_decay or [])
 
     def _apply_one(self, p, g):
-        lr = self._lr_for(p)
         mu, coeff, eps = self._momentum, self._lars_coeff, self._eps
         wd = self._lars_wd
         pname = getattr(p, "name", "") or ""
         if any(k in pname for k in self._exclude):
             wd = 0.0
         vel = self._acc("velocity", p)
+        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
 
-        def f(w, gg, v):
+        def f(w, gg, v, lr):
             wf = w.astype(jnp.float32)
             gf = gg.astype(jnp.float32)
             w_norm = jnp.sqrt(jnp.sum(jnp.square(wf)))
             g_norm = jnp.sqrt(jnp.sum(jnp.square(gf)))
             local_lr = jnp.where(
                 (w_norm > 0) & (g_norm > 0),
-                lr * coeff * w_norm / (g_norm + wd * w_norm + eps),
-                jnp.float32(lr))
+                lr * coeff * w_norm / (g_norm + wd * w_norm + eps), lr)
             v_new = mu * v.astype(jnp.float32) + local_lr * (gf + wd * wf)
             return (wf - v_new).astype(w.dtype), v_new.astype(v.dtype)
 
-        new_p, new_v = forward(f, (p, g, vel), name="lars_momentum",
+        new_p, new_v = forward(f, (p, g, vel, lr_t), name="lars_momentum",
                                nondiff=True)
         p._data = new_p._data
         vel._data = new_v._data

@@ -464,21 +464,35 @@ class _CompiledStep:
                 f"{len(devs)}")
         mesh = Mesh(np.array(devs[:deg]), ("dp",))
 
-        def feed_spec(a):
+        # only feeds the PROGRAM recorded as batch-leading (dynamic dim0,
+        # _static_shape[0] == -1) shard over 'dp': inside shard_map a spec
+        # is a real slice, not a layout hint, so sharding a non-batch feed
+        # whose leading dim merely divides the degree would hand each
+        # replica partial data and silently corrupt training
+        def feed_spec(name, a):
             a = np.asarray(a)
-            if a.ndim >= 1 and a.shape[0] % deg == 0 and a.shape[0] > 0:
-                return P("dp")
-            return P()
+            var = self.program.feed_vars.get(name)
+            batch_leading = bool(getattr(var, "_static_shape", None)) and \
+                var._static_shape[0] == -1
+            if not batch_leading:
+                return P()
+            if a.ndim < 1 or a.shape[0] == 0 or a.shape[0] % deg:
+                raise ValueError(
+                    f"localsgd/fp16_allreduce need feed '{name}' batch "
+                    f"dim divisible by the replica degree ({deg}); got "
+                    f"shape {a.shape}")
+            return P("dp")
 
-        feed_specs = tuple(feed_spec(a) for a in feed_arrays)
+        feed_specs = tuple(feed_spec(n, a)
+                           for n, a in zip(self.feed_names, feed_arrays))
         if feed_specs and feed_specs[0] == P():
             # a replicated primary feed means every replica trains on the
             # full batch — no data parallelism at all, and batch-shaped
             # fetches would gather duplicated rows; fail loudly instead
             raise ValueError(
-                "localsgd/fp16_allreduce need the first feed's batch "
-                f"dim divisible by the replica degree ({deg}); got shape "
-                f"{np.asarray(feed_arrays[0]).shape}")
+                "localsgd/fp16_allreduce need a batch-leading first feed "
+                f"(got static shape "
+                f"{getattr(self.program.feed_vars.get(self.feed_names[0]), '_static_shape', None)})")
         lsgd = self.localsgd_k > 1
         state_spec = P("dp") if lsgd else P()
         param_specs = tuple(state_spec for _ in self.param_vars)

@@ -1,0 +1,112 @@
+"""Lazy eager GRAD path (round-4): a plain eager train loop — forward,
+loss.backward(), opt.step() — under paddle.incubate.lazy_eval() collapses
+to one compiled fwd+bwd+update segment per iteration (SURVEY §7 hard part
+#1; round-3 VERDICT weak #2: laziness previously excluded training)."""
+import contextlib
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core import lazy
+
+
+class _Residual(nn.Layer):
+    """Multi-consumer activations: exercises deferred cotangent
+    accumulation (lazy_add) at the fan-in."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 16)
+        self.fc2 = nn.Linear(16, 16)
+        self.head = nn.Linear(16, 1)
+
+    def forward(self, x):
+        h = paddle.nn.functional.relu(self.fc1(x))
+        h = h + self.fc2(h)  # h consumed twice
+        return self.head(h)
+
+
+def _train(lazy_on, opt_cls, steps=10, seed=11):
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(32, 16)).astype(np.float32)
+    Y = (X @ rng.normal(size=(16, 1))).astype(np.float32)
+    paddle.seed(seed)
+    net = _Residual()
+    opt = opt_cls(parameters=net.parameters())
+    xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+    ctx = paddle.incubate.lazy_eval if lazy_on else contextlib.nullcontext
+    losses = []
+    for _ in range(steps):
+        with ctx():
+            loss = ((net(xt) - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        losses.append(float(loss))
+    params = [np.asarray(lazy.force(p._data)) for p in net.parameters()]
+    return losses, params
+
+
+class TestLazyTrainLoop:
+    def test_adam_parity_and_single_roundtrip_per_step(self):
+        l_eager, p_eager = _train(
+            False, lambda parameters: optimizer.Adam(
+                learning_rate=0.05, parameters=parameters))
+        s0 = lazy.stats()
+        l_lazy, p_lazy = _train(
+            True, lambda parameters: optimizer.Adam(
+                learning_rate=0.05, parameters=parameters))
+        s1 = lazy.stats()
+        np.testing.assert_allclose(l_eager, l_lazy, rtol=2e-4, atol=1e-5)
+        for a, b in zip(p_eager, p_lazy):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+        mats = s1["materializations"] - s0["materializations"]
+        hits = s1["cache_hits"] - s0["cache_hits"]
+        # one loss read per step + the warmup segment + final param reads
+        assert mats <= 10 + 8, f"not O(1) round trips/step: {mats}"
+        # steady state reuses the compiled fwd+bwd+update executable
+        assert hits >= 6, f"segment cache not reused: {hits}"
+
+    def test_momentum_with_weight_decay_parity(self):
+        mk = lambda parameters: optimizer.Momentum(
+            learning_rate=0.05, momentum=0.9, weight_decay=1e-3,
+            parameters=parameters)
+        l_eager, p_eager = _train(False, mk, steps=6)
+        l_lazy, p_lazy = _train(True, mk, steps=6)
+        np.testing.assert_allclose(l_eager, l_lazy, rtol=2e-4, atol=1e-5)
+        for a, b in zip(p_eager, p_lazy):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+    def test_grad_clip_in_lazy_loop(self):
+        mk = lambda parameters: optimizer.AdamW(
+            learning_rate=0.05, parameters=parameters,
+            grad_clip=paddle.nn.ClipGradByGlobalNorm(0.5))
+        l_eager, p_eager = _train(False, mk, steps=5)
+        l_lazy, p_lazy = _train(True, mk, steps=5)
+        np.testing.assert_allclose(l_eager, l_lazy, rtol=2e-4, atol=1e-5)
+        for a, b in zip(p_eager, p_lazy):
+            np.testing.assert_allclose(a, b, rtol=2e-4, atol=1e-5)
+
+    def test_paddle_grad_under_lazy(self):
+        x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+        x.stop_gradient = False
+        with paddle.incubate.lazy_eval():
+            y = (x * x).sum()
+            (g,) = paddle.grad([y], [x])
+        np.testing.assert_allclose(np.asarray(g.numpy()),
+                                   2 * np.arange(4, dtype=np.float32))
+
+    def test_lazy_int_input_falls_back(self):
+        # embedding lookups: int tokens are stop_gradient, weight is not;
+        # the deferred pullback must produce correct weight grads
+        paddle.seed(5)
+        emb = nn.Embedding(10, 8)
+        tok = paddle.to_tensor(np.array([[1, 2, 3]], dtype=np.int64))
+        with paddle.incubate.lazy_eval():
+            loss = emb(tok).sum()
+            loss.backward()
+        g = np.asarray(lazy.force(emb.weight.grad._data))
+        assert g.shape == (10, 8)
+        np.testing.assert_allclose(g[1:4], np.ones((3, 8)), atol=1e-6)
+        np.testing.assert_allclose(g[5:], np.zeros((5, 8)), atol=1e-6)
