@@ -1,0 +1,41 @@
+"""Shared jax.export shape-polymorphism helpers (used by jit.save and
+static.io.save_inference_model)."""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["symbolic_feed_shapes"]
+
+
+def symbolic_feed_shapes(shapes_dtypes):
+    """[(shape_list, np_dtype)] -> [ShapeDtypeStruct], with None/-1 dims
+    exported symbolically so one artifact serves any batch size.
+
+    LEADING dynamic dims share one symbol ("b"): the feeds of a model
+    almost always share their batch dim, and ops combining two feeds
+    (loss vs labels, concat) are only provably shape-correct under
+    polymorphism when the symbols are equal. Non-leading dynamic dims get
+    fresh symbols (s0, s1, ...) — nothing forces, say, two variable
+    sequence lengths to agree."""
+    from jax import export as jax_export
+
+    # one SymbolicScope for the whole feed list: same-named symbols from
+    # different scopes are DIFFERENT dimensions to the export machinery
+    scope = jax_export.SymbolicScope()
+    out = []
+    n_sym = 0
+    for shape, np_dtype in shapes_dtypes:
+        dims = []
+        for i, s in enumerate(shape):
+            if s in (None, -1):
+                if i == 0:
+                    dims.append("b")
+                else:
+                    dims.append(f"s{n_sym}")
+                    n_sym += 1
+            else:
+                dims.append(str(int(s)))
+        sym = jax_export.symbolic_shape(",".join(dims), scope=scope) \
+            if dims else ()
+        out.append(jax.ShapeDtypeStruct(sym, np_dtype))
+    return out

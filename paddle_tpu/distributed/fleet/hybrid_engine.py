@@ -569,40 +569,26 @@ class HybridParallelEngine:
             return self._pipeline_loss_and_grads(params, tokens, labels,
                                                  scale)
 
-        if self._offload:
-            # Reference GroupSharded offload semantics
-            # (group_sharded_stage2.py `offload=True`): optimizer states —
-            # and the master copy of the params the update produces — live
-            # on HOST; the device executable computes only (loss, grads),
-            # grads stream to host, the update runs as a CPU executable,
-            # and fresh params stream back to the mesh. Trades step time
-            # for device memory, exactly the reference trade.
-            self._dev_grads = jax.jit(
-                loss_and_grads,
-                in_shardings=(p_sh, b_sh, b_sh),
-                out_shardings=(scalar, p_sh))
-            self._host_update = jax.jit(self._apply_updates)
-            self._step = None
-        elif self._scaler is not None:
-            # GradScaler path (reference HybridParallelGradScaler,
-            # dygraph_optimizer/hybrid_parallel_optimizer.py:51 +
-            # grad_scaler.py:602): loss is scaled IN-GRAPH before backward,
-            # grads are unscaled by one fused fp32 reduction, found_inf
-            # gates the update with jnp.where — because engine state is
-            # global SPMD arrays, one nonfinite shard anywhere makes every
-            # logical rank skip (the reference needs an explicit allreduce
-            # of found_inf for this; here the check spans all shards by
-            # construction). The dynamic scale/good/bad bookkeeping runs
-            # inside the same XLA executable: ZERO host syncs per step.
+        def make_scaled_update():
+            """The GradScaler state machine (reference
+            HybridParallelGradScaler, dygraph_optimizer/
+            hybrid_parallel_optimizer.py:51 + grad_scaler.py:602):
+            unscale grads by one fused fp32 reduction, found_inf gates
+            the update with jnp.where — because engine state is global
+            SPMD arrays, one nonfinite shard anywhere makes every logical
+            rank skip (the reference needs an explicit allreduce of
+            found_inf; here the check spans all shards by construction) —
+            then the dynamic scale/good/bad bookkeeping. ONE definition
+            serves both the on-device step and the offload host update,
+            so the two paths cannot drift."""
             sc = self._scaler
             incr_n = float(sc._incr_every_n_steps)
             decr_n = float(sc._decr_every_n_nan_or_inf)
             incr_r, decr_r = float(sc._incr_ratio), float(sc._decr_ratio)
             dynamic = bool(sc._dynamic)
 
-            def step(params, accs, step_count, sstate, tokens, labels):
+            def scaled_update(params, accs, step_count, sstate, grads):
                 scale = sstate["scale"]
-                loss, grads = loss_and_grads(params, tokens, labels, scale)
                 found = jnp.zeros((), jnp.bool_)
                 unscaled = []
                 for g in grads:
@@ -627,7 +613,49 @@ class HybridParallelEngine:
                         jnp.where(inc, scale * incr_r, scale))
                     bad = jnp.where(dec, 0.0, bad)
                     good = jnp.where(inc, 0.0, good)
-                new_sstate = {"scale": scale, "good": good, "bad": bad}
+                return (new_params, new_accs, new_count,
+                        {"scale": scale, "good": good, "bad": bad}, found)
+
+            return scaled_update
+
+        if self._offload:
+            # Reference GroupSharded offload semantics
+            # (group_sharded_stage2.py `offload=True`): optimizer states —
+            # and the master copy of the params the update produces — live
+            # on HOST; the device executable computes only (loss, grads),
+            # grads stream to host, the update runs as a CPU executable,
+            # and fresh params stream back to the mesh. Trades step time
+            # for device memory, exactly the reference trade.
+            if self._scaler is not None:
+                # GradScaler × offload (round-4, VERDICT item 10): the
+                # loss is scaled in-graph on DEVICE; the scaled grads
+                # ride the existing grad transfer, and the whole scaler
+                # state machine runs inside the HOST update executable —
+                # scaler state is host-resident in this mode, so the
+                # check costs no extra device round trip.
+                self._dev_grads = jax.jit(
+                    loss_and_grads,
+                    in_shardings=(p_sh, b_sh, b_sh, scalar),
+                    out_shardings=(scalar, p_sh))
+                self._host_update = jax.jit(make_scaled_update())
+            else:
+                self._dev_grads = jax.jit(
+                    loss_and_grads,
+                    in_shardings=(p_sh, b_sh, b_sh),
+                    out_shardings=(scalar, p_sh))
+                self._host_update = jax.jit(self._apply_updates)
+            self._step = None
+        elif self._scaler is not None:
+            # on-device GradScaler path: loss scaled in-graph before
+            # backward, then the shared state machine — ZERO host syncs
+            scaled_update = make_scaled_update()
+
+            def step(params, accs, step_count, sstate, tokens, labels):
+                loss, grads = loss_and_grads(params, tokens, labels,
+                                             sstate["scale"])
+                (new_params, new_accs, new_count, new_sstate,
+                 found) = scaled_update(params, accs, step_count, sstate,
+                                        grads)
                 return (loss, new_params, new_accs, new_count, new_sstate,
                         found)
 
@@ -656,11 +684,6 @@ class HybridParallelEngine:
         use_scaler = scaler is not None and scaler.is_enable()
         if not self._built:
             if use_scaler:
-                if self._offload:
-                    raise NotImplementedError(
-                        "GradScaler with sharding offload is not supported: "
-                        "offload already splits the step; run bf16 instead "
-                        "(no scaling needed on TPU)")
                 self._scaler = scaler
                 self._scaler_state = {
                     "scale": jnp.float32(scaler._scale),
@@ -681,13 +704,31 @@ class HybridParallelEngine:
         tokens = jax.device_put(tokens, b_sh)
         labels = jax.device_put(labels, b_sh)
         if self._offload:
-            loss, grads = self._dev_grads(self.param_arrays, tokens, labels)
             host = jax.devices("cpu")[0]
-            grads_h = [jax.device_put(g, host) for g in grads]
-            params_h = [jax.device_put(p, host) for p in self.param_arrays]
-            new_params, self.acc_arrays, self._step_count = \
-                self._host_update(params_h, self.acc_arrays,
-                                  self._step_count, grads_h)
+            if self._scaler is not None:
+                scale_dev = jax.device_put(
+                    self._scaler_state["scale"],
+                    NamedSharding(self.mesh, P()))
+                loss, grads = self._dev_grads(self.param_arrays, tokens,
+                                              labels, scale_dev)
+                grads_h = [jax.device_put(g, host) for g in grads]
+                params_h = [jax.device_put(p, host)
+                            for p in self.param_arrays]
+                sstate_h = {k: jax.device_put(v, host)
+                            for k, v in self._scaler_state.items()}
+                (new_params, self.acc_arrays, self._step_count,
+                 self._scaler_state, self._found_inf) = self._host_update(
+                    params_h, self.acc_arrays, self._step_count, sstate_h,
+                    grads_h)
+            else:
+                loss, grads = self._dev_grads(self.param_arrays, tokens,
+                                              labels)
+                grads_h = [jax.device_put(g, host) for g in grads]
+                params_h = [jax.device_put(p, host)
+                            for p in self.param_arrays]
+                new_params, self.acc_arrays, self._step_count = \
+                    self._host_update(params_h, self.acc_arrays,
+                                      self._step_count, grads_h)
             self.param_arrays = [
                 jax.device_put(p, NamedSharding(self.mesh, s))
                 for p, s in zip(new_params, self.param_specs)]

@@ -330,13 +330,16 @@ def save(layer, path, input_spec=None, **configs):
             for n in names:
                 state[n]._data = old[n]
 
-    arg_shapes = []
-    for spec in input_spec:
-        shape = tuple(1 if (s in (None, -1)) else int(s) for s in spec.shape)
-        from ..core import dtype as dtypes
+    # None/-1 dims export symbolically (jax.export shape polymorphism) so
+    # ONE artifact serves any batch size; leading dims share one symbol
+    # (see core/export_utils — same helper as save_inference_model)
+    from ..core import dtype as dtypes
+    from ..core.export_utils import symbolic_feed_shapes
 
-        arg_shapes.append(jax.ShapeDtypeStruct(
-            shape, dtypes.convert_dtype(getattr(spec, "dtype", "float32"))))
+    arg_shapes = symbolic_feed_shapes(
+        [(list(spec.shape),
+          dtypes.convert_dtype(getattr(spec, "dtype", "float32")))
+         for spec in input_spec])
     state_shapes = tuple(jax.ShapeDtypeStruct(state[n]._data.shape,
                                               state[n]._data.dtype)
                          for n in names)

@@ -21,10 +21,11 @@ from .quanters import (  # noqa: F401
 from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 from .wrapper import QuantedLayer  # noqa: F401
+from .int8_layers import Int8Conv2D, Int8Linear  # noqa: F401
 
 __all__ = ["QuantConfig", "SingleLayerConfig", "AbsmaxObserver",
            "AbsmaxObserverLayer", "PerChannelAbsmaxObserver",
            "PerChannelAbsmaxObserverLayer", "HistObserver",
            "HistObserverLayer", "FakeQuanterWithAbsMaxObserver",
            "FakeQuanterWithAbsMaxObserverLayer", "quant_dequant", "QAT",
-           "PTQ", "QuantedLayer"]
+           "PTQ", "QuantedLayer", "Int8Linear", "Int8Conv2D"]

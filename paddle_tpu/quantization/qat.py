@@ -28,14 +28,31 @@ class Quantization:
     def __init__(self, config: QuantConfig):
         self._config = config
 
-    def convert(self, model: Layer, inplace=False):
-        """Freeze observers into fixed-scale simulated-int8 layers."""
+    def convert(self, model: Layer, inplace=False, backend="fake"):
+        """Freeze observers into inference layers.
+
+        backend="fake" (default): simulated quant-dequant in float — the
+        reference `convert` semantics, bit-exact with QAT's forward.
+        backend="int8": REAL int8 execution — weights stored int8, the
+        contraction runs as an int8 `dot_general`/conv with an int32
+        accumulator and a float rescale epilogue (int8_layers.py).
+        Layers without an int8 lowering fall back to the fake form.
+        """
+        if backend not in ("fake", "int8"):
+            raise ValueError(f"convert backend must be 'fake' or 'int8', "
+                             f"got {backend!r}")
         m = model if inplace else copy.deepcopy(model)
 
         def conv(layer):
             for key, child in list(layer._sub_layers.items()):
                 if isinstance(child, QuantedLayer):
-                    layer._sub_layers[key] = ConvertedQuantedLayer(child)
+                    repl = None
+                    if backend == "int8":
+                        from .int8_layers import to_int8_layer
+
+                        repl = to_int8_layer(child)
+                    layer._sub_layers[key] = repl if repl is not None \
+                        else ConvertedQuantedLayer(child)
                 else:
                     conv(child)
 

@@ -167,21 +167,13 @@ def _export_program(program: Program, feed_vars, fetch_vars, scope):
         return tuple(env[v.vid]._data for v in fetch_vars)
 
     # symbolic batch dims for every -1 in a feed shape → artifact serves
-    # any batch size (jax.export shape polymorphism)
+    # any batch size; leading dims share one symbol (core/export_utils)
     from jax import export as jax_export
 
-    feed_shapes = []
-    n_sym = 0
-    for fv in feed_vars:
-        dims = []
-        for s in fv._static_shape:
-            if s in (-1, None):
-                dims.append(f"b{n_sym}")
-                n_sym += 1
-            else:
-                dims.append(str(s))
-        shape = jax_export.symbolic_shape(",".join(dims)) if dims else ()
-        feed_shapes.append(jax.ShapeDtypeStruct(shape, fv._np_dtype))
+    from ..core.export_utils import symbolic_feed_shapes
+
+    feed_shapes = symbolic_feed_shapes(
+        [(list(fv._static_shape), fv._np_dtype) for fv in feed_vars])
 
     param_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                          for a in param_arrays)

@@ -104,3 +104,63 @@ class TestEngineScaler:
         float(engine.train_batch([toks, labels], scaler=scaler))
         with pytest.raises(RuntimeError, match="scaler presence"):
             engine.train_batch([toks, labels])
+
+
+class TestScalerWithOffload:
+    """GradScaler × ZeRO offload (round-4, VERDICT item 10; reference
+    group_sharded_stage2 offload + HybridParallelGradScaler coexistence):
+    loss scales on device, the scaled grads ride the existing host
+    transfer, and unscale/found_inf/the gated update/dynamic bookkeeping
+    run in the host update executable."""
+
+    def _engine(self, offload, dtype="float16", seed=3):
+        from paddle_tpu.distributed.sharding import group_sharded_parallel
+        from paddle_tpu.models import (GPTConfig, GPTForPretraining,
+                                       GPTModel, GPTPretrainingCriterion)
+
+        paddle.seed(seed)
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 2}
+        fleet.init(is_collective=True, strategy=strategy)
+        hcg = fleet.get_hybrid_communicate_group()
+        cfg = GPTConfig.preset("gpt2-tiny", vocab_size=64, n_layer=2,
+                               seq_len=16, dropout=0.0, n_head=2,
+                               d_model=32, dtype=dtype)
+        model = GPTForPretraining(GPTModel(cfg))
+        opt = paddle.optimizer.AdamW(1e-3, multi_precision=True,
+                                     parameters=model.parameters())
+        model, opt, _ = group_sharded_parallel(model, opt, "os_g",
+                                               offload=offload)
+        engine = fleet.HybridParallelEngine(
+            model, opt, hcg, strategy, criterion=GPTPretrainingCriterion())
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 64, (16, 16)).astype(np.int64)
+        return engine, toks, np.roll(toks, -1, 1)
+
+    def test_offload_scaler_matches_non_offload(self):
+        runs = {}
+        for offload in (False, True):
+            scaler = paddle.amp.GradScaler(init_loss_scaling=2.0 ** 10)
+            engine, toks, labels = self._engine(offload)
+            runs[offload] = [
+                float(engine.train_batch([toks, labels], scaler=scaler))
+                for _ in range(4)]
+            engine.sync_scaler()
+            assert scaler._good_steps == 4 and not scaler._found_inf
+        np.testing.assert_allclose(runs[False], runs[True], rtol=2e-2,
+                                   atol=2e-2)
+
+    def test_offload_overflow_skips_update_and_decreases_scale(self):
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1.0e30)
+        engine, toks, labels = self._engine(True)
+        loss0 = float(engine.train_batch([toks, labels], scaler=scaler))
+        p0 = [np.asarray(p) for p in engine.param_arrays]
+        loss1 = float(engine.train_batch([toks, labels], scaler=scaler))
+        p1 = [np.asarray(p) for p in engine.param_arrays]
+        assert np.isfinite(loss0) and np.isfinite(loss1)  # loss unscaled
+        for a, b in zip(p0, p1):
+            np.testing.assert_array_equal(a, b)  # updates skipped
+        engine.sync_scaler()
+        assert scaler._found_inf
+        assert scaler._scale == pytest.approx(1.0e30 * 0.25, rel=1e-3)
