@@ -4,19 +4,20 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["symbolic_feed_shapes"]
+__all__ = ["symbolic_feed_shapes", "export_with_symbolic_feeds"]
 
 
-def symbolic_feed_shapes(shapes_dtypes):
+def symbolic_feed_shapes(shapes_dtypes, share_leading=False):
     """[(shape_list, np_dtype)] -> [ShapeDtypeStruct], with None/-1 dims
     exported symbolically so one artifact serves any batch size.
 
-    LEADING dynamic dims share one symbol ("b"): the feeds of a model
-    almost always share their batch dim, and ops combining two feeds
-    (loss vs labels, concat) are only provably shape-correct under
-    polymorphism when the symbols are equal. Non-leading dynamic dims get
-    fresh symbols (s0, s1, ...) — nothing forces, say, two variable
-    sequence lengths to agree."""
+    share_leading=False: every dynamic dim gets a fresh symbol — maximal
+    call-time flexibility (feeds may have independent dynamic leading
+    dims, e.g. images vs a variable region count).
+    share_leading=True: LEADING dynamic dims share one symbol ("b") —
+    required when the traced program combines two feeds (loss vs labels,
+    concat), which is only provably shape-correct under polymorphism
+    when the symbols are equal."""
     from jax import export as jax_export
 
     # one SymbolicScope for the whole feed list: same-named symbols from
@@ -28,7 +29,7 @@ def symbolic_feed_shapes(shapes_dtypes):
         dims = []
         for i, s in enumerate(shape):
             if s in (None, -1):
-                if i == 0:
+                if i == 0 and share_leading:
                     dims.append("b")
                 else:
                     dims.append(f"s{n_sym}")
@@ -39,3 +40,15 @@ def symbolic_feed_shapes(shapes_dtypes):
             if dims else ()
         out.append(jax.ShapeDtypeStruct(sym, np_dtype))
     return out
+
+
+def export_with_symbolic_feeds(do_export, shapes_dtypes):
+    """Run `do_export(feed_shapes)` with per-feed fresh symbols first
+    (keeps independent dynamic leading dims independent at call time);
+    when polymorphic tracing cannot prove the needed dim equalities
+    (programs combining feeds), retry with a shared leading symbol."""
+    try:
+        return do_export(symbolic_feed_shapes(shapes_dtypes))
+    except Exception:
+        return do_export(symbolic_feed_shapes(shapes_dtypes,
+                                              share_leading=True))

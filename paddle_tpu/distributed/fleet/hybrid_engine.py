@@ -339,12 +339,16 @@ class HybridParallelEngine:
         Reference equivalent: PipelineParallel.forward_backward_pipeline
         (fleet/meta_parallel/pipeline_parallel.py:117 — 1F1B) + p2p send/recv
         (pp_utils/p2p_communication.py), collapsed into one compiled SPMD
-        program. Schedule (lockstep; each tick = one fwd slot + one bwd
-        slot on every stage):
+        program. Schedule (lockstep):
 
           stage s runs fwd of microbatch i at tick  i + s
           stage s runs bwd of microbatch i at tick  i + 2(pp-1) - s
           (last stage: fwd and bwd of i in the SAME tick — classic 1F1B)
+
+        executed as THREE scans — pp−1 fwd-only warmup ticks, M
+        steady fwd+bwd ticks, pp−1 bwd-only drain ticks — so the fill
+        and drain phases don't pay for the slot kind no stage can use;
+        the resulting bubble is the classic 1F1B (pp−1)/(M+pp−1).
 
         Stage s therefore holds at most 2(pp-1-s)+1 ≤ 2·pp−1 in-flight
         microbatch INPUTS (not full activations: backward recomputes the
@@ -359,7 +363,8 @@ class HybridParallelEngine:
         divergent control flow, which the XLA partitioner rejects — see
         the lax.cond note below). The memory benefit interleave shares
         with 1F1B is already delivered by this schedule; raise
-        accumulate_steps M to shrink the (pp−1)/M bubble instead. Activations and
+        accumulate_steps M to shrink the (pp−1)/(M+pp−1) bubble instead.
+        Activations and
         cotangents move stage-to-stage via p2p ppermute only; the sole
         collectives are the final scalar-loss/shared-weight-grad psums over
         'pp' (the reference's tied-embedding allreduce,
@@ -415,9 +420,8 @@ class HybridParallelEngine:
                 jax.tree.map(jnp.zeros_like, other),        # shared grads
             )
 
-            def tick(carry, t):
+            def fwd_part(carry, t):
                 recv_f, recv_b, buf, loss_acc, d_local, d_other = carry
-                # ---------------------------------------------- fwd slot
                 fi = t - stage
                 fvalid = (fi >= 0) & (fi < M)
                 fic = jnp.clip(fi, 0, M - 1)
@@ -433,7 +437,11 @@ class HybridParallelEngine:
                                                    keepdims=False)
                 buf = jax.lax.dynamic_update_index_in_dim(
                     buf, jnp.where(fvalid, x_in, old), slot, 0)
-                # ---------------------------------------------- bwd slot
+                recv_f = jax.lax.ppermute(act, "pp", fwd_perm)
+                return (recv_f, recv_b, buf, loss_acc, d_local, d_other)
+
+            def bwd_part(carry, t):
+                recv_f, recv_b, buf, loss_acc, d_local, d_other = carry
                 bi = t - (2 * (pp - 1) - stage)
                 bvalid = (bi >= 0) & (bi < M)
                 bic = jnp.clip(bi, 0, M - 1)
@@ -472,14 +480,37 @@ class HybridParallelEngine:
                     d_other, d_oth_h, d_oth_e)
                 loss_acc = loss_acc + jnp.where(
                     bvalid & is_last, loss_b, 0.0)
-                # ------------------------------------------- p2p transfer
-                recv_f = jax.lax.ppermute(act, "pp", fwd_perm)
                 recv_b = jax.lax.ppermute(dx, "pp", bwd_perm)
-                return (recv_f, recv_b, buf, loss_acc, d_local,
-                        d_other), None
+                return (recv_f, recv_b, buf, loss_acc, d_local, d_other)
 
-            n_ticks = M + 2 * (pp - 1)
-            carry, _ = jax.lax.scan(tick, carry0, jnp.arange(n_ticks))
+            # Three-phase schedule (round 4): ticks 0..pp-2 have no valid
+            # bwd slot on ANY stage and the last pp-1 ticks no valid fwd —
+            # running them as fwd-only / bwd-only scans skips the dead
+            # compute the old single-scan lockstep paid, cutting the
+            # per-step cost from (M+2(pp-1))·(F+B) to
+            # (pp-1)·F + M·(F+B) + (pp-1)·B = (M+pp-1)·(F+B), i.e. the
+            # CLASSIC 1F1B bubble (pp-1)/(M+pp-1) — half the old
+            # 2(pp-1)/(M+2(pp-1)). Each phase is still one lockstep body
+            # for every stage: no per-device divergent control flow.
+            def warm_tick(c, t):
+                return fwd_part(c, t), None
+
+            def steady_tick(c, t):
+                return bwd_part(fwd_part(c, t), t), None
+
+            def drain_tick(c, t):
+                return bwd_part(c, t), None
+
+            carry = carry0
+            if pp > 1:
+                carry, _ = jax.lax.scan(warm_tick, carry,
+                                        jnp.arange(0, pp - 1))
+            carry, _ = jax.lax.scan(steady_tick, carry,
+                                    jnp.arange(pp - 1, M + pp - 1))
+            if pp > 1:
+                carry, _ = jax.lax.scan(
+                    drain_tick, carry,
+                    jnp.arange(M + pp - 1, M + 2 * (pp - 1)))
             _, _, _, loss_acc, d_local, d_other = carry
             loss = jax.lax.psum(loss_acc, "pp") / M
             # shared (embedding/head/norm) grads: tied-weight allreduce
@@ -711,9 +742,12 @@ class HybridParallelEngine:
                     NamedSharding(self.mesh, P()))
                 loss, grads = self._dev_grads(self.param_arrays, tokens,
                                               labels, scale_dev)
-                grads_h = [jax.device_put(g, host) for g in grads]
-                params_h = [jax.device_put(p, host)
-                            for p in self.param_arrays]
+            else:
+                loss, grads = self._dev_grads(self.param_arrays, tokens,
+                                              labels)
+            grads_h = [jax.device_put(g, host) for g in grads]
+            params_h = [jax.device_put(p, host) for p in self.param_arrays]
+            if self._scaler is not None:
                 sstate_h = {k: jax.device_put(v, host)
                             for k, v in self._scaler_state.items()}
                 (new_params, self.acc_arrays, self._step_count,
@@ -721,11 +755,6 @@ class HybridParallelEngine:
                     params_h, self.acc_arrays, self._step_count, sstate_h,
                     grads_h)
             else:
-                loss, grads = self._dev_grads(self.param_arrays, tokens,
-                                              labels)
-                grads_h = [jax.device_put(g, host) for g in grads]
-                params_h = [jax.device_put(p, host)
-                            for p in self.param_arrays]
                 new_params, self.acc_arrays, self._step_count = \
                     self._host_update(params_h, self.acc_arrays,
                                       self._step_count, grads_h)

@@ -331,20 +331,23 @@ def save(layer, path, input_spec=None, **configs):
                 state[n]._data = old[n]
 
     # None/-1 dims export symbolically (jax.export shape polymorphism) so
-    # ONE artifact serves any batch size; leading dims share one symbol
-    # (see core/export_utils — same helper as save_inference_model)
+    # ONE artifact serves any batch size (see core/export_utils — same
+    # helper as save_inference_model; independent symbols first, shared
+    # leading symbol when the program combines feeds)
     from ..core import dtype as dtypes
-    from ..core.export_utils import symbolic_feed_shapes
+    from ..core.export_utils import export_with_symbolic_feeds
 
-    arg_shapes = symbolic_feed_shapes(
-        [(list(spec.shape),
-          dtypes.convert_dtype(getattr(spec, "dtype", "float32")))
-         for spec in input_spec])
+    spec_sd = [(list(spec.shape),
+                dtypes.convert_dtype(getattr(spec, "dtype", "float32")))
+               for spec in input_spec]
     state_shapes = tuple(jax.ShapeDtypeStruct(state[n]._data.shape,
                                               state[n]._data.dtype)
                          for n in names)
 
-    exported = jax_export.export(jax.jit(pure))(state_shapes, *arg_shapes)
+    exported = export_with_symbolic_feeds(
+        lambda arg_shapes: jax_export.export(jax.jit(pure))(state_shapes,
+                                                            *arg_shapes),
+        spec_sd)
     blob = exported.serialize()
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path + ".pdmodel", "wb") as f:

@@ -28,6 +28,13 @@ __all__ = ["Int8Linear", "Int8Conv2D", "to_int8_layer"]
 _QMAX = 127.0
 
 
+class _NoInt8Lowering(ValueError):
+    """Config has no int8 lowering — to_int8_layer falls back to the
+    simulated quant-dequant layer. Distinct from plain ValueError so a
+    genuinely broken calibration (e.g. scale/weight shape mismatch)
+    still surfaces instead of being silently degraded."""
+
+
 def _quantize_weight(w, scale, axis):
     """float weight -> int8 array at convert time (one-shot)."""
     w = np.asarray(w, np.float32)
@@ -55,7 +62,7 @@ class Int8Linear(Layer):
         super().__init__()
         w = source.weight._data
         if w_axis not in (None, 1):
-            raise ValueError(
+            raise _NoInt8Lowering(
                 f"Int8Linear: per-channel axis must be the out-features "
                 f"axis (1); got {w_axis}")
         wq, ws = _quantize_weight(w, w_scale, w_axis)
@@ -92,9 +99,9 @@ class Int8Conv2D(Layer):
     def __init__(self, source, a_scale, w_scale, w_axis):
         super().__init__()
         if getattr(source, "_data_format", "NCHW") != "NCHW":
-            raise ValueError("Int8Conv2D supports NCHW only")
+            raise _NoInt8Lowering("Int8Conv2D supports NCHW only")
         if w_axis not in (None, 0):
-            raise ValueError(
+            raise _NoInt8Lowering(
                 f"Int8Conv2D: per-channel axis must be the out-channels "
                 f"axis (0); got {w_axis}")
         wq, ws = _quantize_weight(source.weight._data, w_scale, w_axis)
@@ -115,7 +122,7 @@ class Int8Conv2D(Layer):
                 all(isinstance(p, (int, np.integer)) for p in pad):
             self._padding = [(int(p), int(p)) for p in pad]
         else:
-            raise ValueError(
+            raise _NoInt8Lowering(
                 f"Int8Conv2D: unsupported padding form {pad!r}")
         self._groups = int(source._groups)
 
@@ -178,9 +185,11 @@ def to_int8_layer(quanted):
         if isinstance(src, Conv2D):
             return Int8Conv2D(src, a_scale.reshape(()), wq_ob.scales._data,
                               w_axis)
-    except ValueError:
+    except _NoInt8Lowering:
         # unsupported config (NHWC, exotic padding, unexpected quant
         # axis): honor the documented contract — fall back to the
-        # simulated quant-dequant layer instead of failing the convert
+        # simulated quant-dequant layer. Any OTHER error (e.g. a
+        # scale/weight shape mismatch from a broken calibration)
+        # propagates.
         return None
     return None

@@ -167,21 +167,21 @@ def _export_program(program: Program, feed_vars, fetch_vars, scope):
         return tuple(env[v.vid]._data for v in fetch_vars)
 
     # symbolic batch dims for every -1 in a feed shape → artifact serves
-    # any batch size; leading dims share one symbol (core/export_utils)
+    # any batch size; independent symbols first, shared leading symbol
+    # when the program combines feeds (core/export_utils)
     from jax import export as jax_export
 
-    from ..core.export_utils import symbolic_feed_shapes
-
-    feed_shapes = symbolic_feed_shapes(
-        [(list(fv._static_shape), fv._np_dtype) for fv in feed_vars])
+    from ..core.export_utils import export_with_symbolic_feeds
 
     param_shapes = tuple(jax.ShapeDtypeStruct(a.shape, a.dtype)
                          for a in param_arrays)
     prev = dispatch.static_recorder
     dispatch.static_recorder = None
     try:
-        exported = jax_export.export(jax.jit(pure))(param_shapes,
-                                                    *feed_shapes)
+        exported = export_with_symbolic_feeds(
+            lambda feed_shapes: jax_export.export(jax.jit(pure))(
+                param_shapes, *feed_shapes),
+            [(list(fv._static_shape), fv._np_dtype) for fv in feed_vars])
     finally:
         dispatch.static_recorder = prev
     return exported, param_arrays
