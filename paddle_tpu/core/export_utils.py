@@ -47,8 +47,17 @@ def export_with_symbolic_feeds(do_export, shapes_dtypes):
     (keeps independent dynamic leading dims independent at call time);
     when polymorphic tracing cannot prove the needed dim equalities
     (programs combining feeds), retry with a shared leading symbol."""
+    n_dyn_leading = sum(1 for shape, _ in shapes_dtypes
+                        if shape and shape[0] in (None, -1))
     try:
         return do_export(symbolic_feed_shapes(shapes_dtypes))
-    except Exception:
-        return do_export(symbolic_feed_shapes(shapes_dtypes,
-                                              share_leading=True))
+    except Exception as first_err:
+        if n_dyn_leading < 2:
+            raise  # sharing changes nothing; surface the real error
+        try:
+            return do_export(symbolic_feed_shapes(shapes_dtypes,
+                                                  share_leading=True))
+        except Exception as retry_err:
+            # keep the original failure in the chain: if the retry fails
+            # for a different reason the root cause must stay visible
+            raise retry_err from first_err

