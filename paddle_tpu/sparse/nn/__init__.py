@@ -43,3 +43,63 @@ class BatchNorm(Layer):
         vals = self._bn(Tensor(b.data, stop_gradient=x.stop_gradient))
         return SparseCooTensor(jsparse.BCOO((vals._data, b.indices),
                                             shape=b.shape), x.stop_gradient)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return functional.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return functional.leaky_relu(x, self._slope)
+
+
+class _SparseConv3DBase(Layer):
+    """Reference sparse/nn/layer/conv.py _Conv3D: weight
+    [kd, kh, kw, C_in/groups, C_out], NDHWC sparse COO activations."""
+
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__()
+        import numpy as np
+
+        from ...nn.initializer import KaimingUniform, Uniform
+
+        if groups != 1:
+            raise ValueError("sparse Conv3D/SubmConv3D support groups=1 "
+                             "only")
+        k = (kernel_size,) * 3 if isinstance(kernel_size, int) \
+            else tuple(kernel_size)
+        self._stride, self._padding, self._dilation = stride, padding, \
+            dilation
+        self._groups = groups
+        fan_in = in_channels * int(np.prod(k)) // groups
+        self.weight = self.create_parameter(
+            [*k, in_channels // groups, out_channels], attr=weight_attr,
+            default_initializer=KaimingUniform(fan_in=fan_in))
+        bound = 1.0 / np.sqrt(fan_in)
+        self.bias = self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=Uniform(-bound, bound))
+
+    def forward(self, x):
+        fn = functional.subm_conv3d if self._subm else functional.conv3d
+        return fn(x, self.weight, self.bias, stride=self._stride,
+                  padding=self._padding, dilation=self._dilation,
+                  groups=self._groups)
+
+
+class Conv3D(_SparseConv3DBase):
+    _subm = False
+
+
+class SubmConv3D(_SparseConv3DBase):
+    _subm = True

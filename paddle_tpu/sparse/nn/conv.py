@@ -1,0 +1,187 @@
+"""Sparse 3D convolution (reference `python/paddle/sparse/nn/functional/
+conv.py` conv3d/subm_conv3d over `phi/kernels/sparse/gpu/conv_kernel.cu`
+gather-gemm-scatter).
+
+TPU re-design: the reference builds a rulebook (per-kernel-offset
+gather/scatter index pairs) with dynamic sizes on the GPU. XLA wants
+static shapes, so:
+
+  * subm_conv3d — output coords == input coords (submanifold): for each
+    kernel offset, every input point looks up its shifted neighbor with a
+    `searchsorted` over the (sorted) linearized input coords — an
+    O(nnz·K·log nnz) static-shape match — and accumulates
+    neighbor_values @ W[offset] into its own row. One lax.scan over the
+    K kernel offsets; every step is gather + matmul, all MXU/VPU work.
+  * conv3d — output coords are data-dependent in the reference; here the
+    statically-bounded union (nnz·K contributions, one per point-offset
+    pair) is materialized as a BCOO and `sum_duplicates(nse=nnz·K)`
+    dedupes inside XLA. Out-of-range contributions are zeroed and
+    clamped, which sums harmlessly.
+
+Input layout matches the reference: x is a SparseCooTensor of shape
+[N, D, H, W, C] with 4 sparse dims + a dense channel dim (values
+[nnz, C]); weight is [kd, kh, kw, C_in, C_out].
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...core.dispatch import unwrap
+from ...core.tensor import Tensor
+from ..tensor import SparseCooTensor
+
+__all__ = ["conv3d", "subm_conv3d"]
+
+
+def _norm3(v):
+    return (int(v),) * 3 if isinstance(v, (int, np.integer)) \
+        else tuple(int(x) for x in v)
+
+
+def _prep(x, weight, stride, padding, dilation, groups):
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse conv3d expects a SparseCooTensor input")
+    if groups != 1:
+        raise ValueError("sparse conv3d supports groups=1 only")
+    b = x._bcoo.sum_duplicates(remove_zeros=False)
+    if b.indices.shape[1] != 4 or b.data.ndim != 2:
+        raise ValueError(
+            "sparse conv3d input must be [N, D, H, W, C] COO with a "
+            "dense channel dim (values [nnz, C])")
+    if int(np.prod(b.shape[:4])) >= 2 ** 31 and not \
+            jax.config.jax_enable_x64:
+        raise ValueError(
+            "sparse conv3d: N*D*H*W >= 2^31 overflows the int32 "
+            "linearized coordinate match; set PADDLE_TPU_X64=1")
+    w = unwrap(weight) if isinstance(weight, Tensor) else jnp.asarray(weight)
+    if w.ndim != 5:
+        raise ValueError("weight must be [kd, kh, kw, C_in, C_out]")
+    return b, w, _norm3(stride), _norm3(padding), _norm3(dilation)
+
+
+def _offsets(w, dilation):
+    kd, kh, kw = w.shape[:3]
+    offs = np.array([(z * dilation[0], y * dilation[1], x * dilation[2])
+                     for z in range(kd) for y in range(kh)
+                     for x in range(kw)], np.int32)
+    w_flat = w.reshape(kd * kh * kw, w.shape[3], w.shape[4])
+    return jnp.asarray(offs), w_flat
+
+
+def _linearize(coords, spatial):
+    """[n, 4] (n,d,h,w) -> linear index over [N, *spatial]. Computed in
+    the widest available int (int64 under x64, else int32 — _prep rejects
+    grids whose cell count would overflow int32)."""
+    c = coords.astype(jnp.int64 if jax.config.jax_enable_x64
+                      else jnp.int32)
+    sd, sh, sw = spatial
+    return ((c[:, 0] * sd + c[:, 1]) * sh + c[:, 2]) * sw + c[:, 3]
+
+
+def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
+                groups=1, data_format="NDHWC", key=None, name=None):
+    """Submanifold conv: output sparsity pattern == input pattern
+    (reference subm_conv3d; stride must be 1)."""
+    b, w, stride, padding, dilation = _prep(x, weight, stride, padding,
+                                            dilation, groups)
+    if stride != (1, 1, 1):
+        raise ValueError("subm_conv3d requires stride=1 (the submanifold "
+                         "pattern is position-preserving)")
+    N, D, H, W, C = b.shape
+    coords, vals = b.indices, b.data
+    nnz = coords.shape[0]
+    offs, w_flat = _offsets(w, dilation)
+    # kernel alignment matches the dense conv with the same padding: the
+    # neighbor sampled by tap o for the output at point p is p + (o - pad)
+    # (the standard subm setup uses padding == (k-1)*dilation/2, which
+    # centers the kernel on the point)
+    pad = jnp.asarray(padding, jnp.int32)
+
+    lin = _linearize(coords, (D, H, W))
+    order = jnp.argsort(lin)
+    lin_sorted = lin[order]
+    vals_sorted = vals[order]
+
+    def tap(acc, oi):
+        off, w_o = oi
+        nb = coords.at[:, 1:].add(off - pad)
+        inb = ((nb[:, 1] >= 0) & (nb[:, 1] < D) &
+               (nb[:, 2] >= 0) & (nb[:, 2] < H) &
+               (nb[:, 3] >= 0) & (nb[:, 3] < W))
+        lin_nb = _linearize(nb, (D, H, W))
+        pos = jnp.searchsorted(lin_sorted, lin_nb)
+        posc = jnp.clip(pos, 0, nnz - 1)
+        found = inb & (lin_sorted[posc] == lin_nb)
+        nb_vals = vals_sorted[posc] * found[:, None].astype(vals.dtype)
+        return acc + nb_vals @ w_o.astype(vals.dtype), None
+
+    out0 = jnp.zeros((nnz, w.shape[4]), vals.dtype)
+    out_vals, _ = jax.lax.scan(tap, out0, (offs, w_flat))
+    if bias is not None:
+        bb = unwrap(bias) if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out_vals = out_vals + bb.astype(out_vals.dtype)
+    out = jsparse.BCOO((out_vals, coords), shape=(N, D, H, W, w.shape[4]))
+    return SparseCooTensor(out, stop_gradient=x.stop_gradient)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NDHWC", name=None):
+    """Standard sparse conv: each input point scatters one contribution
+    per kernel tap to the strided output coordinate (reference conv3d)."""
+    b, w, stride, padding, dilation = _prep(x, weight, stride, padding,
+                                            dilation, groups)
+    N, D, H, W, C = b.shape
+    coords, vals = b.indices, b.data
+    nnz = coords.shape[0]
+    offs, w_flat = _offsets(w, dilation)
+    K = offs.shape[0]
+    kd, kh, kw = w.shape[:3]
+    out_sp = tuple(
+        (dim + 2 * padding[i] - (w.shape[i] - 1) * dilation[i] - 1)
+        // stride[i] + 1 for i, dim in enumerate((D, H, W)))
+    pad = jnp.asarray(padding, jnp.int32)
+    st = jnp.asarray(stride, jnp.int32)
+
+    def tap(oi):
+        off, w_o = oi
+        num = coords[:, 1:] + pad - off          # [nnz, 3]
+        oc = num // st
+        valid = ((num % st == 0).all(axis=1) &
+                 (oc >= 0).all(axis=1) &
+                 (oc[:, 0] < out_sp[0]) & (oc[:, 1] < out_sp[1]) &
+                 (oc[:, 2] < out_sp[2]))
+        contrib = (vals @ w_o.astype(vals.dtype)) * \
+            valid[:, None].astype(vals.dtype)
+        idx = jnp.concatenate(
+            [coords[:, :1], jnp.where(valid[:, None], oc, 0)], axis=1)
+        return idx, contrib
+
+    idxs, contribs = jax.vmap(tap)((offs, w_flat))
+    all_idx = idxs.reshape(K * nnz, 4)
+    all_val = contribs.reshape(K * nnz, w.shape[4])
+    out = jsparse.BCOO((all_val, all_idx),
+                       shape=(N,) + out_sp + (w.shape[4],))
+    # the true output site count is data-dependent; sum_duplicates pads
+    # to the static bound with out-of-bounds sentinel indices
+    out = out.sum_duplicates(nse=min(K * nnz,
+                                     N * int(np.prod(out_sp))))
+    if not isinstance(out.data, jax.core.Tracer):
+        # eager call: compact away the padding rows so nnz()/indices()
+        # report only real sites (inside jit the padded form stays —
+        # to_dense ignores sentinel rows either way)
+        keep = np.asarray(
+            (np.asarray(out.indices) <
+             np.asarray(out.shape[:4])).all(axis=1))
+        if not keep.all():
+            out = jsparse.BCOO(
+                (jnp.asarray(np.asarray(out.data)[keep]),
+                 jnp.asarray(np.asarray(out.indices)[keep])),
+                shape=out.shape)
+    if bias is not None:
+        bb = unwrap(bias) if isinstance(bias, Tensor) else jnp.asarray(bias)
+        out = jsparse.BCOO((out.data + bb.astype(out.data.dtype),
+                            out.indices), shape=out.shape)
+    return SparseCooTensor(out, stop_gradient=x.stop_gradient)

@@ -52,3 +52,18 @@ def attention(query, key, value, sparse_mask, key_padding_mask=None,
     scores = masked_matmul(Tensor(q / jnp.sqrt(d)), Tensor(k.T), sparse_mask)
     probs = softmax(scores)
     return Tensor(_coo(probs) @ v)
+
+
+def relu6(x, name=None):
+    from ..unary import _unary
+
+    return _unary(x, lambda a: jnp.clip(a, 0, 6))
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    from ..unary import _unary
+
+    return _unary(x, lambda a: jnp.where(a >= 0, a, negative_slope * a))
+
+
+from .conv import conv3d, subm_conv3d  # noqa: E402,F401
