@@ -103,3 +103,20 @@ class Conv3D(_SparseConv3DBase):
 
 class SubmConv3D(_SparseConv3DBase):
     _subm = True
+
+
+class MaxPool3D(Layer):
+    """Reference sparse/nn/layer/pooling.py MaxPool3D."""
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 return_mask=False, data_format="NDHWC", name=None):
+        super().__init__()
+        if return_mask:
+            raise ValueError("sparse MaxPool3D: return_mask is not "
+                             "supported")
+        self._k, self._stride = kernel_size, stride
+        self._padding, self._ceil = padding, ceil_mode
+
+    def forward(self, x):
+        return functional.max_pool3d(x, self._k, self._stride,
+                                     self._padding, self._ceil)

@@ -33,7 +33,7 @@ from ...core.dispatch import unwrap
 from ...core.tensor import Tensor
 from ..tensor import SparseCooTensor
 
-__all__ = ["conv3d", "subm_conv3d"]
+__all__ = ["conv3d", "subm_conv3d", "max_pool3d"]
 
 
 def _norm3(v):
@@ -184,4 +184,103 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         bb = unwrap(bias) if isinstance(bias, Tensor) else jnp.asarray(bias)
         out = jsparse.BCOO((out.data + bb.astype(out.data.dtype),
                             out.indices), shape=out.shape)
+    return SparseCooTensor(out, stop_gradient=x.stop_gradient)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               data_format="NDHWC", name=None):
+    """Sparse 3D max pooling (reference sparse/nn/functional/pooling.py
+    over `phi/kernels/sparse/gpu/pool_kernel.cu`).
+
+    TPU re-design, all static shapes: each active input cell contributes
+    to every pooling window containing it (K = kd·kh·kw contributions per
+    point). Contributions sort by linearized output coordinate; run
+    starts become segment ids by cumsum, and one `segment_max` reduces
+    each output cell — no dynamic rulebook, no densified grid."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("sparse max_pool3d expects a SparseCooTensor")
+    b = x._bcoo.sum_duplicates(remove_zeros=False)
+    if b.indices.shape[1] != 4 or b.data.ndim != 2:
+        raise ValueError("input must be [N, D, H, W, C] COO with dense "
+                         "channel values")
+    k = _norm3(kernel_size)
+    stride = _norm3(stride if stride is not None else kernel_size)
+    padding = _norm3(padding)
+    N, D, H, W, C = b.shape
+    coords, vals = b.indices, b.data
+    nnz = coords.shape[0]
+
+    def out_dim(i, dim):
+        num = dim + 2 * padding[i] - k[i]
+        return (num + stride[i] - 1) // stride[i] + 1 if ceil_mode \
+            else num // stride[i] + 1
+
+    out_sp = tuple(out_dim(i, d) for i, d in enumerate((D, H, W)))
+    if int(np.prod((N,) + out_sp)) >= 2 ** 31 and not \
+            jax.config.jax_enable_x64:
+        raise ValueError(
+            "sparse max_pool3d: output grid >= 2^31 cells overflows the "
+            "int32 linearized coordinate sort; set PADDLE_TPU_X64=1")
+    if nnz == 0:
+        # empty input -> empty output (the segment machinery below
+        # assumes at least one contribution row)
+        out = jsparse.BCOO(
+            (jnp.zeros((0, C), vals.dtype),
+             jnp.zeros((0, 4), coords.dtype)),
+            shape=(N,) + out_sp + (C,))
+        return SparseCooTensor(out, stop_gradient=x.stop_gradient)
+    offs = np.array([(z, y, xx) for z in range(k[0]) for y in range(k[1])
+                     for xx in range(k[2])], np.int32)
+    K = offs.shape[0]
+    pad = jnp.asarray(padding, jnp.int32)
+    st = jnp.asarray(stride, jnp.int32)
+
+    def tap(off):
+        num = coords[:, 1:] + pad - off
+        oc = num // st
+        valid = ((num % st == 0).all(axis=1) & (oc >= 0).all(axis=1) &
+                 (oc[:, 0] < out_sp[0]) & (oc[:, 1] < out_sp[1]) &
+                 (oc[:, 2] < out_sp[2]))
+        return jnp.where(valid[:, None], oc, -1), valid
+
+    ocs, valids = jax.vmap(tap)(jnp.asarray(offs))       # [K, nnz, 3]
+    oc_flat = ocs.reshape(K * nnz, 3)
+    val_ok = valids.reshape(K * nnz)
+    batch = jnp.tile(coords[:, 0], (K,))
+    lin = _linearize(
+        jnp.concatenate([batch[:, None], oc_flat], axis=1), out_sp)
+    lin = jnp.where(val_ok, lin, jnp.iinfo(lin.dtype).max)  # invalid last
+    order = jnp.argsort(lin)
+    lin_s = lin[order]
+    # tiled row j equals vals[j % nnz]: gather directly instead of
+    # materializing the [K*nnz, C] tile before the reorder
+    vals_s = vals[order % nnz]
+    ok_s = val_ok[order]
+    starts = jnp.concatenate(
+        [jnp.ones((1,), bool), lin_s[1:] != lin_s[:-1]])
+    seg = jnp.cumsum(starts) - 1                          # [K*nnz]
+    n_seg = K * nnz
+    pooled = jax.ops.segment_max(
+        jnp.where(ok_s[:, None], vals_s,
+                  jnp.full_like(vals_s, -jnp.inf)),
+        seg, num_segments=n_seg)
+    # one representative row per segment carries its coords + validity
+    first_idx = jnp.where(starts, jnp.arange(K * nnz), K * nnz - 1)
+    rep = jax.ops.segment_min(first_idx, seg, num_segments=n_seg)
+    repc = jnp.clip(rep, 0, K * nnz - 1)
+    seg_coord = jnp.concatenate([batch[order][repc][:, None],
+                                 oc_flat[order][repc]], axis=1)
+    seg_ok = ok_s[repc] & (jnp.arange(n_seg) <= seg.max())
+    out_vals = jnp.where(seg_ok[:, None], pooled, 0.0).astype(vals.dtype)
+    out_idx = jnp.where(seg_ok[:, None], seg_coord, jnp.asarray(
+        (N,) + out_sp, jnp.int32))  # sentinel OOB -> ignored by todense
+    out = jsparse.BCOO((out_vals, out_idx.astype(coords.dtype)),
+                       shape=(N,) + out_sp + (C,))
+    if not isinstance(out.data, jax.core.Tracer):
+        keep = np.asarray(seg_ok)
+        if not keep.all():
+            out = jsparse.BCOO(
+                (jnp.asarray(np.asarray(out.data)[keep]),
+                 jnp.asarray(np.asarray(out.indices)[keep])),
+                shape=out.shape)
     return SparseCooTensor(out, stop_gradient=x.stop_gradient)

@@ -67,3 +67,4 @@ def leaky_relu(x, negative_slope=0.01, name=None):
 
 
 from .conv import conv3d, subm_conv3d  # noqa: E402,F401
+from .conv import max_pool3d  # noqa: E402,F401

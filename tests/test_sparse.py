@@ -275,3 +275,44 @@ class TestSparseConv3D:
         np.testing.assert_allclose(np.asarray(lr.values().numpy()),
                                    np.where(vy >= 0, vy, 0.1 * vy),
                                    rtol=1e-6)
+
+    def test_max_pool3d_matches_active_site_oracle(self):
+        from paddle_tpu.sparse.nn import functional as sF
+        import paddle_tpu.sparse.nn as snn
+
+        rng = np.random.default_rng(3)
+        shape = (2, 6, 6, 6, 3)
+        x = self._rand_sparse(rng, shape=shape, nnz=30)
+        out = sF.max_pool3d(x, kernel_size=2, stride=2)
+        N, D, H, W, C = shape
+        xd = np.asarray(x.to_dense().numpy())
+        active = np.abs(xd).sum(-1) > 0
+        oD, oH, oW = D // 2, H // 2, W // 2
+        ref = np.zeros((N, oD, oH, oW, C), np.float32)
+        for n in range(N):
+            for z in range(oD):
+                for y in range(oH):
+                    for xx in range(oW):
+                        blk = xd[n, 2*z:2*z+2, 2*y:2*y+2, 2*xx:2*xx+2]
+                        act = active[n, 2*z:2*z+2, 2*y:2*y+2, 2*xx:2*xx+2]
+                        if act.any():
+                            # max over ACTIVE cells only (sparse
+                            # semantics: empty cells don't contribute 0)
+                            ref[n, z, y, xx] = blk[act].max(axis=0)
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                                   ref, rtol=1e-5, atol=1e-6)
+        # layer wrapper + compaction: indices in bounds
+        out2 = snn.MaxPool3D(2, 2)(x)
+        idx = np.asarray(out2.indices().numpy())
+        assert (idx.T < np.asarray(out2.shape[:4])).all()
+
+    def test_max_pool3d_empty_input(self):
+        from paddle_tpu.sparse.nn import functional as sF
+
+        x = paddle.sparse.sparse_coo_tensor(
+            np.zeros((4, 0), np.int32), np.zeros((0, 2), np.float32),
+            (1, 4, 4, 4, 2))
+        out = sF.max_pool3d(x, 2, 2)
+        assert out.shape == [1, 2, 2, 2, 2]
+        assert out.nnz == 0
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()), 0.0)
