@@ -114,6 +114,9 @@ class MaxPool3D(Layer):
         if return_mask:
             raise ValueError("sparse MaxPool3D: return_mask is not "
                              "supported")
+        from .conv import _check_layout
+
+        _check_layout(data_format, "MaxPool3D")
         self._k, self._stride = kernel_size, stride
         self._padding, self._ceil = padding, ceil_mode
 

@@ -41,6 +41,40 @@ def _norm3(v):
         else tuple(int(x) for x in v)
 
 
+def _check_layout(data_format, who):
+    if data_format != "NDHWC":
+        raise ValueError(f"sparse {who} supports data_format='NDHWC' "
+                         f"only; got {data_format!r}")
+
+
+def _window_tap(coords, out_sp, pad, st, off):
+    """Strided-window membership: output coord + validity for each point
+    under kernel/pool tap `off` (shared by conv3d and max_pool3d)."""
+    num = coords[:, 1:] + pad - off
+    oc = num // st
+    valid = ((num % st == 0).all(axis=1) & (oc >= 0).all(axis=1) &
+             (oc[:, 0] < out_sp[0]) & (oc[:, 1] < out_sp[1]) &
+             (oc[:, 2] < out_sp[2]))
+    return oc, valid
+
+
+def _compact_eager(out, keep=None):
+    """Drop sum_duplicates/sentinel padding rows from an EAGER BCOO so
+    nnz()/indices() report only real sites (traced values pass through —
+    to_dense ignores sentinel rows either way)."""
+    if isinstance(out.data, jax.core.Tracer):
+        return out
+    if keep is None:
+        keep = (np.asarray(out.indices) <
+                np.asarray(out.shape[:out.indices.shape[1]])).all(axis=1)
+    keep = np.asarray(keep)
+    if keep.all():
+        return out
+    return jsparse.BCOO(
+        (jnp.asarray(np.asarray(out.data)[keep]),
+         jnp.asarray(np.asarray(out.indices)[keep])), shape=out.shape)
+
+
 def _prep(x, weight, stride, padding, dilation, groups):
     if not isinstance(x, SparseCooTensor):
         raise TypeError("sparse conv3d expects a SparseCooTensor input")
@@ -85,6 +119,7 @@ def subm_conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1,
                 groups=1, data_format="NDHWC", key=None, name=None):
     """Submanifold conv: output sparsity pattern == input pattern
     (reference subm_conv3d; stride must be 1)."""
+    _check_layout(data_format, "subm_conv3d")
     b, w, stride, padding, dilation = _prep(x, weight, stride, padding,
                                             dilation, groups)
     if stride != (1, 1, 1):
@@ -131,6 +166,7 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
            data_format="NDHWC", name=None):
     """Standard sparse conv: each input point scatters one contribution
     per kernel tap to the strided output coordinate (reference conv3d)."""
+    _check_layout(data_format, "conv3d")
     b, w, stride, padding, dilation = _prep(x, weight, stride, padding,
                                             dilation, groups)
     N, D, H, W, C = b.shape
@@ -138,7 +174,6 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
     nnz = coords.shape[0]
     offs, w_flat = _offsets(w, dilation)
     K = offs.shape[0]
-    kd, kh, kw = w.shape[:3]
     out_sp = tuple(
         (dim + 2 * padding[i] - (w.shape[i] - 1) * dilation[i] - 1)
         // stride[i] + 1 for i, dim in enumerate((D, H, W)))
@@ -147,12 +182,7 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
 
     def tap(oi):
         off, w_o = oi
-        num = coords[:, 1:] + pad - off          # [nnz, 3]
-        oc = num // st
-        valid = ((num % st == 0).all(axis=1) &
-                 (oc >= 0).all(axis=1) &
-                 (oc[:, 0] < out_sp[0]) & (oc[:, 1] < out_sp[1]) &
-                 (oc[:, 2] < out_sp[2]))
+        oc, valid = _window_tap(coords, out_sp, pad, st, off)
         contrib = (vals @ w_o.astype(vals.dtype)) * \
             valid[:, None].astype(vals.dtype)
         idx = jnp.concatenate(
@@ -166,20 +196,8 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
                        shape=(N,) + out_sp + (w.shape[4],))
     # the true output site count is data-dependent; sum_duplicates pads
     # to the static bound with out-of-bounds sentinel indices
-    out = out.sum_duplicates(nse=min(K * nnz,
-                                     N * int(np.prod(out_sp))))
-    if not isinstance(out.data, jax.core.Tracer):
-        # eager call: compact away the padding rows so nnz()/indices()
-        # report only real sites (inside jit the padded form stays —
-        # to_dense ignores sentinel rows either way)
-        keep = np.asarray(
-            (np.asarray(out.indices) <
-             np.asarray(out.shape[:4])).all(axis=1))
-        if not keep.all():
-            out = jsparse.BCOO(
-                (jnp.asarray(np.asarray(out.data)[keep]),
-                 jnp.asarray(np.asarray(out.indices)[keep])),
-                shape=out.shape)
+    out = _compact_eager(out.sum_duplicates(
+        nse=min(K * nnz, N * int(np.prod(out_sp)))))
     if bias is not None:
         bb = unwrap(bias) if isinstance(bias, Tensor) else jnp.asarray(bias)
         out = jsparse.BCOO((out.data + bb.astype(out.data.dtype),
@@ -197,6 +215,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     point). Contributions sort by linearized output coordinate; run
     starts become segment ids by cumsum, and one `segment_max` reduces
     each output cell — no dynamic rulebook, no densified grid."""
+    _check_layout(data_format, "max_pool3d")
     if not isinstance(x, SparseCooTensor):
         raise TypeError("sparse max_pool3d expects a SparseCooTensor")
     b = x._bcoo.sum_duplicates(remove_zeros=False)
@@ -236,11 +255,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     st = jnp.asarray(stride, jnp.int32)
 
     def tap(off):
-        num = coords[:, 1:] + pad - off
-        oc = num // st
-        valid = ((num % st == 0).all(axis=1) & (oc >= 0).all(axis=1) &
-                 (oc[:, 0] < out_sp[0]) & (oc[:, 1] < out_sp[1]) &
-                 (oc[:, 2] < out_sp[2]))
+        oc, valid = _window_tap(coords, out_sp, pad, st, off)
         return jnp.where(valid[:, None], oc, -1), valid
 
     ocs, valids = jax.vmap(tap)(jnp.asarray(offs))       # [K, nnz, 3]
@@ -277,10 +292,5 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
     out = jsparse.BCOO((out_vals, out_idx.astype(coords.dtype)),
                        shape=(N,) + out_sp + (C,))
     if not isinstance(out.data, jax.core.Tracer):
-        keep = np.asarray(seg_ok)
-        if not keep.all():
-            out = jsparse.BCOO(
-                (jnp.asarray(np.asarray(out.data)[keep]),
-                 jnp.asarray(np.asarray(out.indices)[keep])),
-                shape=out.shape)
+        out = _compact_eager(out, keep=seg_ok)
     return SparseCooTensor(out, stop_gradient=x.stop_gradient)

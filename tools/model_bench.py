@@ -1,0 +1,267 @@
+"""Model-level benchmark harness over the five BASELINE configs.
+
+Reference parity: `tools/ci_model_benchmark.sh:18` (whl-vs-whl relative
+model benchmarking in CI; the reference stores no absolute numbers) +
+the model list pinned by BASELINE.json `configs`:
+
+  1. ResNet-50 dygraph (CIFAR-shaped batches)        -> images/sec
+  2. BERT-base pretrain step                         -> tokens/sec
+  3. GPT data-parallel train step                    -> tokens/sec
+  4. GPT hybrid-parallel (mp/pp/sharding) train step -> tokens/sec
+  5. ERNIE static-graph Executor inference           -> samples/sec
+
+Usage:
+  python tools/model_bench.py --out model_bench.json [--scale tiny|full]
+  python tools/model_bench.py --out new.json --check old.json --tol 1.20
+
+`--scale tiny` (default) sizes every config to finish on one CPU core —
+the CI gate; `--scale full` uses the real model sizes for accelerator
+runs. `--check` exits 1 when any config's per-sample time regressed more
+than `tol`x vs the previous snapshot — the relative gating
+ci_model_benchmark.sh implements by comparing two installed wheels.
+
+Distributed configs run on whatever devices exist (virtual CPU mesh OK:
+run under XLA_FLAGS=--xla_force_host_platform_device_count=8).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _steps(fn, warmup=2, iters=5):
+    for _ in range(warmup):
+        fn()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        fn()
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_resnet(scale):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet18, resnet50
+
+    paddle.seed(0)
+    model = resnet50() if scale == "full" else resnet18(num_classes=10)
+    bs = 32 if scale == "full" else 4
+    side = 224 if scale == "full" else 32
+    opt = paddle.optimizer.Momentum(0.1, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(bs, 3, side, side))
+                         .astype(np.float32))
+    y = paddle.to_tensor(rng.integers(0, 10, (bs,)).astype(np.int64))
+    import paddle_tpu.nn.functional as F
+
+    def step_fn(xb, yb):
+        loss = F.cross_entropy(model(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train = paddle.jit.TrainStep(step_fn, model, opt)
+    dt = _steps(lambda: float(train(x, y)))
+    return {"config": "resnet_dygraph", "value": round(bs / dt, 2),
+            "unit": "images/s", "per_sample_ms": round(dt / bs * 1e3, 4)}
+
+
+def bench_bert(scale):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import BertPretrainingCriterion, bert_tiny
+    from paddle_tpu.models.bert import (BertConfig, BertForPretraining,
+                                        BertModel)
+
+    paddle.seed(0)
+    if scale == "full":
+        bert = BertModel(BertConfig())  # bert-base
+        bs, T, V = 16, 128, 30522
+    else:
+        bert = bert_tiny(vocab_size=256, max_position_embeddings=64)
+        bs, T, V = 4, 32, 256
+    model = BertForPretraining(bert)
+    crit = BertPretrainingCriterion(V)
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    rng = np.random.default_rng(0)
+    ids = paddle.to_tensor(rng.integers(0, V, (bs, T)).astype(np.int64))
+    nsp = paddle.to_tensor(np.zeros((bs, 1), np.int64))
+
+    def step_fn(idb, nspb):
+        scores, rel = model(idb)
+        loss = crit(scores, rel, idb, nspb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    train = paddle.jit.TrainStep(step_fn, model, opt)
+    dt = _steps(lambda: float(train(ids, nsp)))
+    tok = bs * T
+    return {"config": "bert_pretrain", "value": round(tok / dt, 1),
+            "unit": "tokens/s", "per_sample_ms": round(dt / tok * 1e3, 5)}
+
+
+def _gpt_engine(scale, hybrid):
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
+                                   GPTPretrainingCriterion)
+
+    paddle.seed(0)
+    import jax
+
+    n_dev = len(jax.devices())
+    strategy = fleet.DistributedStrategy()
+    if hybrid:
+        mp = 2 if n_dev >= 8 else 1
+        pp = 2 if n_dev >= 4 else 1
+        sh = 2 if n_dev >= 2 else 1
+        strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": mp,
+                                   "pp_degree": pp, "sharding_degree": sh}
+        strategy.pipeline_configs = {"accumulate_steps": max(2 * pp, 2)}
+    else:
+        dp = min(n_dev, 8)
+        strategy.hybrid_configs = {"dp_degree": dp, "mp_degree": 1,
+                                   "pp_degree": 1, "sharding_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    hcg = fleet.get_hybrid_communicate_group()
+    if scale == "full":
+        preset, bs, T = ("gpt3-6.7B" if hybrid else "gpt3-1.3B"), 8, 2048
+        cfg = GPTConfig.preset(preset, dropout=0.0, dtype="bfloat16")
+    else:
+        cfg = GPTConfig.preset("gpt2-tiny", vocab_size=128, n_layer=2,
+                               seq_len=32, dropout=0.0, n_head=2,
+                               d_model=64)
+        bs, T = 16, 32
+    model = GPTForPretraining(GPTModel(cfg))
+    opt = paddle.optimizer.AdamW(1e-4, parameters=model.parameters())
+    engine = fleet.HybridParallelEngine(
+        model, opt, hcg, strategy, criterion=GPTPretrainingCriterion())
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, (bs, T)).astype(np.int64)
+    labels = np.roll(toks, -1, 1)
+    dt = _steps(lambda: float(engine.train_batch([toks, labels])))
+    tok = bs * T
+    name = "gpt_hybrid" if hybrid else "gpt_dp"
+    return {"config": name, "value": round(tok / dt, 1),
+            "unit": "tokens/s", "per_sample_ms": round(dt / tok * 1e3, 5)}
+
+
+def bench_gpt_dp(scale):
+    return _gpt_engine(scale, hybrid=False)
+
+
+def bench_gpt_hybrid(scale):
+    return _gpt_engine(scale, hybrid=True)
+
+
+def bench_ernie_static(scale):
+    import numpy as np
+
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    paddle.enable_static()
+    try:
+        from paddle_tpu.models.ernie import (ErnieConfig,
+                                             ErnieForSequenceClassification,
+                                             ErnieModel)
+
+        if scale == "full":
+            cfg = ErnieConfig()
+            bs, T = 16, 128
+        else:
+            cfg = ErnieConfig(vocab_size=128, hidden_size=64,
+                              num_hidden_layers=2, num_attention_heads=2,
+                              intermediate_size=128,
+                              max_position_embeddings=64,
+                              hidden_dropout_prob=0.0,
+                              attention_probs_dropout_prob=0.0)
+            bs, T = 4, 16
+        main = paddle.static.Program()
+        startup = paddle.static.Program()
+        with paddle.static.program_guard(main, startup):
+            ids = paddle.static.data("ids", [None, T], "int64")
+            model = ErnieForSequenceClassification(ErnieModel(cfg), 3)
+            model.eval()
+            logits = model(ids)
+        exe = paddle.static.Executor()
+        exe.run(startup)
+        rng = np.random.default_rng(0)
+        feed = {"ids": rng.integers(0, cfg.vocab_size, (bs, T))
+                .astype(np.int64)}
+        dt = _steps(lambda: exe.run(main, feed=feed, fetch_list=[logits]))
+        return {"config": "ernie_static_infer",
+                "value": round(bs / dt, 1), "unit": "samples/s",
+                "per_sample_ms": round(dt / bs * 1e3, 4)}
+    finally:
+        paddle.disable_static()
+
+
+CONFIGS = [("resnet_dygraph", bench_resnet),
+           ("bert_pretrain", bench_bert),
+           ("gpt_dp", bench_gpt_dp),
+           ("gpt_hybrid", bench_gpt_hybrid),
+           ("ernie_static_infer", bench_ernie_static)]
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--scale", choices=["tiny", "full"], default="tiny")
+    ap.add_argument("--check", help="previous snapshot to gate against")
+    ap.add_argument("--tol", type=float, default=1.20)
+    ap.add_argument("--only", help="comma list of config names to run")
+    args = ap.parse_args()
+
+    only = set(args.only.split(",")) if args.only else None
+    known = {name for name, _ in CONFIGS}
+    if only and only - known:
+        print(f"unknown --only config(s): {sorted(only - known)}; "
+              f"known: {sorted(known)}", file=sys.stderr)
+        return 2
+    results = []
+    for name, fn in CONFIGS:
+        if only and name not in only:
+            continue
+        rec = fn(args.scale)
+        rec["scale"] = args.scale
+        print(json.dumps(rec), flush=True)
+        results.append(rec)
+    with open(args.out, "w") as f:
+        json.dump(results, f, indent=1)
+
+    if args.check:
+        with open(args.check) as f:
+            prev = {r["config"]: r for r in json.load(f)}
+        bad, compared = [], 0
+        for r in results:
+            p = prev.get(r["config"])
+            if p is None or p.get("scale") != r["scale"]:
+                continue
+            compared += 1
+            if r["per_sample_ms"] > p["per_sample_ms"] * args.tol:
+                bad.append(f"{r['config']}: {p['per_sample_ms']} -> "
+                           f"{r['per_sample_ms']} ms/sample")
+        if compared == 0:
+            # a gate that compared nothing must not pass green
+            print("PERF CHECK: no overlapping (config, scale) entries "
+                  f"between {args.check} and this run", file=sys.stderr)
+            return 2
+        if bad:
+            print("PERF REGRESSION:\n  " + "\n  ".join(bad),
+                  file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
