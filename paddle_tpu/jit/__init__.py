@@ -114,6 +114,16 @@ class StaticFunction:
     def __call__(self, *args):
         if not _to_static_enabled:
             return self._fn(*args)
+        # already inside an enclosing trace (TrainStep / an outer jit):
+        # INLINE instead of dispatching a nested compiled executable —
+        # the nested jit would return bare arrays that silently sever the
+        # autograd tape (zero grads for every upstream param) and thread
+        # traced state through host-side globals. One cheap global check;
+        # no per-call state walk.
+        from jax._src import core as _jcore
+
+        if not _jcore.trace_state_clean():
+            return self._fn(*args)
         if self._compiled is None:
             self._build()
         arg_arrays = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)

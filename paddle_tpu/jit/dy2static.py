@@ -1007,6 +1007,45 @@ def _loop_alive(test, brk):
     return cf_and(test, cf_not(brk))
 
 
+def convert_logical_and(x_fn, y_fn):
+    """`a and b` (reference convert_operators.convert_logical_and):
+    python values keep exact short-circuit + value semantics; a tensor on
+    either side evaluates both and lowers to an elementwise logical_and
+    (the reference's documented divergence — short-circuit cannot skip a
+    traced computation). Tensor detection is the file-wide _tensorish."""
+    from ..ops import logic as _logic
+    from ..core.tensor import Tensor
+
+    x = x_fn()
+    if _tensorish(x):
+        y = y_fn()
+        xt = x if isinstance(x, Tensor) else Tensor(x)
+        return _logic.logical_and(xt, y if isinstance(y, Tensor)
+                                  else Tensor(y))
+    if not x:
+        return x
+    return y_fn()
+
+
+def convert_logical_or(x_fn, y_fn):
+    from ..ops import logic as _logic
+    from ..core.tensor import Tensor
+
+    x = x_fn()
+    if _tensorish(x):
+        y = y_fn()
+        xt = x if isinstance(x, Tensor) else Tensor(x)
+        return _logic.logical_or(xt, y if isinstance(y, Tensor)
+                                 else Tensor(y))
+    if x:
+        return x
+    return y_fn()
+
+
+# `not x` in transformed code reuses the existing tensor-aware helper
+convert_logical_not = cf_not
+
+
 _RUNTIME_HELPERS = {
     "__dy2static_convert_ifelse": convert_ifelse,
     "__dy2static_convert_while": convert_while_loop,
@@ -1015,7 +1054,132 @@ _RUNTIME_HELPERS = {
     "__dy2static_noflag": cf_noflag,
     "__dy2static_loop_alive": _loop_alive,
     "__dy2static_UNDEF": UNDEF,
+    "__dy2static_logical_and": convert_logical_and,
+    "__dy2static_logical_or": convert_logical_or,
+    "__dy2static_logical_not": convert_logical_not,
 }
+
+
+class _LogicalTransformer(ast.NodeTransformer):
+    """`and`/`or`/`not` → convert_logical_* thunk calls (reference
+    logical_transformer.py): tensor operands stop exploding on bool()
+    while python operands keep exact value/short-circuit semantics (the
+    operands become lambdas)."""
+
+    def __init__(self):
+        self.changed = False
+
+    def _thunk(self, expr):
+        return ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=expr)
+
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        if any(isinstance(sub, ast.NamedExpr) for v in node.values
+               for sub in ast.walk(v)):
+            # a walrus inside a thunked operand would bind in the
+            # lambda's scope, not the function's — leave it untouched
+            # (python semantics preserved; tensor operands fail loudly)
+            return node
+        self.changed = True
+        helper = "__dy2static_logical_and" \
+            if isinstance(node.op, ast.And) else "__dy2static_logical_or"
+        out = node.values[0]
+        for v in node.values[1:]:
+            out = ast.Call(func=_load(helper),
+                           args=[self._thunk(out), self._thunk(v)],
+                           keywords=[])
+        return out
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if not isinstance(node.op, ast.Not):
+            return node
+        self.changed = True
+        return ast.Call(func=_load("__dy2static_logical_not"),
+                        args=[node.operand], keywords=[])
+
+
+def _always_returns(stmts):
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        return True
+    if isinstance(last, ast.If):
+        return _always_returns(last.body) and _always_returns(last.orelse)
+    return False
+
+
+def _replace_tail_returns(stmts, name):
+    """Precondition: _always_returns(stmts)."""
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        stmts[-1] = ast.Assign(
+            targets=[_store(name)],
+            value=last.value or ast.Constant(value=None))
+    else:  # an If whose branches both return
+        _replace_tail_returns(last.body, name)
+        _replace_tail_returns(last.orelse, name)
+
+
+class _ReturnNormalizer:
+    """Early-return normalization (reference early_return_transformer +
+    the tail slice of return_transformer): statements after an If whose
+    one branch always returns move into the other branch, and an If whose
+    BOTH branches end in Return becomes assignments to a fresh variable
+    followed by one tail return — so returns stop escaping hoisted
+    regions and tensor-predicate ifs with early returns convert instead
+    of falling back. Returns inside loops are left alone (the loop
+    transforms bail on them, as before)."""
+
+    def __init__(self, fresh):
+        self._fresh = fresh
+        self.changed = False
+
+    def normalize_function(self, fdef):
+        body = list(fdef.body)
+        if not _always_returns(body):
+            # materialize the implicit `return None` so a tail
+            # `if c: return A` gains an explicit other side
+            body = body + [ast.Return(value=ast.Constant(value=None))]
+        fdef.body = self._block(body)
+
+    def _block(self, stmts):
+        res = []
+        i = 0
+        stmts = list(stmts)
+        while i < len(stmts):
+            st = stmts[i]
+            if isinstance(st, ast.If):
+                st.body = self._block(st.body)
+                st.orelse = self._block(st.orelse)
+                b_ret = _always_returns(st.body)
+                o_ret = _always_returns(st.orelse)
+                trailing = stmts[i + 1:]
+                if trailing and (b_ret != o_ret):
+                    self.changed = True
+                    if b_ret:
+                        st.orelse = self._block(
+                            list(st.orelse) + trailing)
+                        o_ret = _always_returns(st.orelse)
+                    else:
+                        st.body = self._block(list(st.body) + trailing)
+                        b_ret = _always_returns(st.body)
+                    stmts = stmts[:i + 1]
+                if b_ret and o_ret and st.orelse:
+                    self.changed = True
+                    name = self._fresh()
+                    _replace_tail_returns(st.body, name)
+                    _replace_tail_returns(st.orelse, name)
+                    res.append(st)
+                    res.append(ast.Return(value=_load(name)))
+                    return res  # anything further is unreachable
+            res.append(st)
+            i += 1
+        return res
 
 
 def ast_transform(fn):
@@ -1043,10 +1207,28 @@ def ast_transform(fn):
     fdef.decorator_list = []  # run undecorated (to_static re-wraps)
     arg_names = [a.arg for a in fdef.args.args + fdef.args.posonlyargs +
                  fdef.args.kwonlyargs]
+    # pre-passes: logical ops -> thunked convert calls; early returns ->
+    # branch-tail assignments (must run BEFORE the control-flow pass so
+    # the rewritten ifs become hoistable regions)
+    logical = _LogicalTransformer()
+    logical.visit(fdef)
+    _ret_n = [0]
+
+    def _ret_fresh():
+        _ret_n[0] += 1
+        return f"__dy2s_ret_{_ret_n[0]}"
+
+    norm = _ReturnNormalizer(_ret_fresh)
+    norm.normalize_function(fdef)
     local_names = set(arg_names) | set(_assigned_names(fdef.body))
     tr = _ControlFlowTransformer(local_names)
     tr.visit(fdef)
-    if not tr.changed:
+    # logical rewrites alone don't justify re-exec'ing the function: a
+    # pure-python `and`/`or` works identically untransformed (and a
+    # tensor boolop OUTSIDE converted control flow keeps failing loudly,
+    # as before). They ship only alongside a control-flow or
+    # return-normalization change.
+    if not (tr.changed or norm.changed):
         return fn
     # a name first CREATED inside both branches would be unbound at the
     # operand load; it is fn-local (assigned somewhere), so a top-of-body

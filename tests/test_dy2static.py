@@ -584,3 +584,127 @@ class TestLayerForward:
         out = layer(paddle.to_tensor(np.ones((2, 4), np.float32)))
         assert list(out.shape) == [2, 4]
         assert np.isfinite(out.numpy()).all()
+
+
+class TestEarlyReturnAndLogical:
+    """Round-4 breadth: early returns normalize into branch-tail
+    assignments (reference early_return_transformer + return_transformer
+    tail) and and/or/not over tensors lower to convert_logical_* calls
+    (reference logical_transformer)."""
+
+    def test_early_return_concrete_both_paths(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            return x - 1
+
+        pos = f(paddle.to_tensor(np.ones(3, np.float32)))
+        np.testing.assert_allclose(pos.numpy(), 2 * np.ones(3), rtol=1e-6)
+        neg = f(paddle.to_tensor(-np.ones(3, np.float32)))
+        np.testing.assert_allclose(neg.numpy(), -2 * np.ones(3), rtol=1e-6)
+
+    def test_early_return_in_train_step(self):
+        # traced predicate: the normalized if converts to lax.cond inside
+        # the compiled step and grads flow through the taken branch
+        import paddle_tpu.nn as nn
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(4, 4)
+
+            def forward(self, x):
+                h = self.fc(x)
+                if h.sum() > 0:
+                    return h * 2.0
+                return h * 0.5
+
+        paddle.seed(7)
+        net = paddle.jit.to_static(Net())
+        opt = paddle.optimizer.SGD(0.1, parameters=net.parameters())
+
+        def step(x):
+            loss = net(x).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        train = paddle.jit.TrainStep(step, net, opt)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        l0 = float(train(x))
+        l1 = float(train(x))
+        assert np.isfinite([l0, l1]).all() and l1 != l0  # params moved
+
+    def test_logical_and_or_not_over_tensors(self):
+        @paddle.jit.to_static
+        def f(x):
+            if (x.sum() > 0) and (x.max() < 10):
+                return x * 2
+            if (x.min() < -100) or (not (x.sum() > 0)):
+                return x - 5
+            return x
+
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        np.testing.assert_allclose(f(x).numpy(), 2 * np.ones(3))
+        big = paddle.to_tensor(np.full(3, 50.0, np.float32))
+        # and-branch false (max >= 10), or-branch false -> passthrough
+        np.testing.assert_allclose(f(big).numpy(), np.full(3, 50.0))
+        neg = paddle.to_tensor(-np.ones(3, np.float32))
+        np.testing.assert_allclose(f(neg).numpy(), -6 * np.ones(3))
+
+    def test_python_short_circuit_preserved(self):
+        # transformer-level check (StaticFunction would arrayify python
+        # args): converted `and` keeps exact short-circuit semantics
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        calls = []
+
+        def side(v):
+            calls.append(v)
+            return v
+
+        def f(flag, x):
+            if flag and side(True):
+                return x * 2
+            return x
+
+        g = ast_transform(f)
+        assert g is not f  # the transform actually fired
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(g(False, x).numpy(), np.ones(2))
+        assert calls == []  # rhs never evaluated: short-circuit kept
+        np.testing.assert_allclose(g(True, x).numpy(), 2 * np.ones(2))
+        assert calls == [True]
+
+    def test_logical_value_semantics_for_python_operands(self):
+        from paddle_tpu.jit.dy2static import (ast_transform,
+                                              convert_logical_or)
+
+        # python `or` returns the VALUE, not a bool — the runtime helper
+        # must preserve that exactly
+        assert convert_logical_or(lambda: 0,
+                                  lambda: "fallback") == "fallback"
+        assert convert_logical_or(lambda: "x", lambda: "y") == "x"
+
+        # a function with ONLY python boolops is returned untransformed
+        # (no re-exec cost, no behavior change)
+        def f(a, b):
+            return a or b
+
+        assert ast_transform(f) is f
+
+    def test_walrus_in_boolop_left_untouched(self):
+        from paddle_tpu.jit.dy2static import ast_transform
+
+        def f(xs, x):
+            if (n := len(xs)) and n > 1:
+                return x * n
+            return x
+
+        g = ast_transform(f)  # return-normalization still fires
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(g([1, 2, 3], x).numpy(),
+                                   3 * np.ones(2))
+        np.testing.assert_allclose(g([], x).numpy(), np.ones(2))
