@@ -1017,7 +1017,11 @@ def convert_logical_and(x_fn, y_fn):
     from ..core.tensor import Tensor
 
     x = x_fn()
-    if _tensorish(x):
+    # only framework tensors lower elementwise: numpy scalars/arrays keep
+    # exact python truthiness/value semantics (they did before the
+    # transform existed, and `not np_scalar` returning a Tensor would
+    # silently change eager behavior)
+    if isinstance(x, (Tensor, jax.Array)):
         y = y_fn()
         xt = x if isinstance(x, Tensor) else Tensor(x)
         return _logic.logical_and(xt, y if isinstance(y, Tensor)
@@ -1032,7 +1036,7 @@ def convert_logical_or(x_fn, y_fn):
     from ..core.tensor import Tensor
 
     x = x_fn()
-    if _tensorish(x):
+    if isinstance(x, (Tensor, jax.Array)):
         y = y_fn()
         xt = x if isinstance(x, Tensor) else Tensor(x)
         return _logic.logical_or(xt, y if isinstance(y, Tensor)
@@ -1042,8 +1046,12 @@ def convert_logical_or(x_fn, y_fn):
     return y_fn()
 
 
-# `not x` in transformed code reuses the existing tensor-aware helper
-convert_logical_not = cf_not
+def convert_logical_not(x):
+    from ..core.tensor import Tensor
+
+    if isinstance(x, (Tensor, jax.Array)):
+        return cf_not(x)
+    return not x  # numpy/python operands keep python semantics
 
 
 _RUNTIME_HELPERS = {
@@ -1125,6 +1133,17 @@ def _replace_tail_returns(stmts, name):
         _replace_tail_returns(last.orelse, name)
 
 
+def _tail_return_kinds(stmts):
+    """{'none', 'value'} over every tail return reachable in stmts
+    (precondition: _always_returns(stmts))."""
+    last = stmts[-1]
+    if isinstance(last, ast.Return):
+        is_none = last.value is None or (
+            isinstance(last.value, ast.Constant) and last.value.value is None)
+        return {"none" if is_none else "value"}
+    return _tail_return_kinds(last.body) | _tail_return_kinds(last.orelse)
+
+
 class _ReturnNormalizer:
     """Early-return normalization (reference early_return_transformer +
     the tail slice of return_transformer): statements after an If whose
@@ -1170,6 +1189,17 @@ class _ReturnNormalizer:
                         b_ret = _always_returns(st.body)
                     stmts = stmts[:i + 1]
                 if b_ret and o_ret and st.orelse:
+                    kinds = _tail_return_kinds(st.body) | \
+                        _tail_return_kinds(st.orelse)
+                    if kinds == {"none", "value"}:
+                        # guard-clause shape (`if p: return expr` with an
+                        # implicit None fall-through): a None-returning
+                        # cond branch has no tensor aval — leave the If
+                        # untouched so a tensor pred fails loudly at the
+                        # user's line instead of deep in region tracing
+                        res.append(st)
+                        i += 1
+                        continue
                     self.changed = True
                     name = self._fresh()
                     _replace_tail_returns(st.body, name)

@@ -47,8 +47,8 @@ class Adam(Optimizer):
         # than closure constants, so the lazy grad path's segment
         # signature (keyed on the kernel's code + captured cells) stays
         # identical across steps and its compiled executable caches
-        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
-        t_t = Tensor(jnp.asarray(self._opt_step, jnp.float32))
+        lr_t = self._scalar_input("lr", self._lr_for(p))
+        t_t = self._scalar_input("t", self._opt_step)
 
         def f(w, gg, mm, vv, lr, t, *master):
             gf = gg.astype(jnp.float32)
@@ -97,8 +97,8 @@ class AdamW(Adam):
         mw = self._acc("master_weight", p, dtype=jnp.float32) if use_master \
             else None
         # dynamic lr/step as inputs — see Adam._apply_one
-        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
-        t_t = Tensor(jnp.asarray(self._opt_step, jnp.float32))
+        lr_t = self._scalar_input("lr", self._lr_for(p))
+        t_t = self._scalar_input("t", self._opt_step)
 
         def f(w, gg, mm, vv, lr, t, *master):
             gf = gg.astype(jnp.float32)
@@ -267,8 +267,8 @@ class Lamb(Optimizer):
         m = self._acc("moment1", p, dtype=jnp.float32)
         v = self._acc("moment2", p, dtype=jnp.float32)
         # dynamic lr/step as inputs — see Adam._apply_one
-        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
-        t_t = Tensor(jnp.asarray(self._opt_step, jnp.float32))
+        lr_t = self._scalar_input("lr", self._lr_for(p))
+        t_t = self._scalar_input("t", self._opt_step)
 
         def f(w, gg, mm, vv, lr, t):
             gf = gg.astype(jnp.float32)

@@ -53,6 +53,32 @@ class Optimizer:
         return self.get_lr() * p.optimize_attr.get("learning_rate", 1.0) \
             if isinstance(p, Parameter) else self.get_lr()
 
+    def _scalar_input(self, name, value):
+        """f32 scalar Tensor for a dynamic hyperparameter (lr, step),
+        cached by value for PYTHON scalars only: the step count and lr
+        are shared by every parameter in one step, and rebuilding a
+        device scalar per parameter per step is measurable overhead in
+        eager/lazy loops. Traced/array values — and ANY call made while a
+        trace is active — wrap fresh: a cached committed array entering a
+        later sharded jit gets lifted into a hidden executable argument
+        (buffer-count mismatch at dispatch), and a cached tracer poisons
+        every later compile."""
+        from jax._src import core as _jcore
+
+        if hasattr(value, "dtype") or not _jcore.trace_state_clean():
+            return Tensor(jnp.asarray(value, jnp.float32))
+        cache = getattr(self, "_scalar_cache", None)
+        if cache is None:
+            cache = self._scalar_cache = {}
+        key = (name, value)
+        hit = cache.get(key)
+        if hit is None:
+            if len(cache) > 16:
+                cache.clear()
+            hit = Tensor(jnp.asarray(value, jnp.float32))
+            cache[key] = hit
+        return hit
+
     # -- accumulators (reference Optimizer._add_accumulator) ------------------
     def _acc(self, name, p, init=0.0, dtype=None):
         store = self._accumulators.setdefault(name, {})
@@ -187,6 +213,10 @@ class Optimizer:
         pass
 
 
+def _sgd_update(w, gg, lr):
+    return w - (lr * gg.astype(jnp.float32)).astype(w.dtype)
+
+
 class SGD(Optimizer):
     def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
                  grad_clip=None, name=None):
@@ -195,11 +225,12 @@ class SGD(Optimizer):
 
     def _apply_one(self, p, g):
         # dynamic lr as an input (not a closure cell) keeps the lazy grad
-        # path's segment signature stable across steps — see Adam
-        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
-        new_p = forward(
-            lambda w, gg, lr: w - (lr * gg.astype(jnp.float32)).astype(
-                w.dtype), (p, g, lr_t), name="sgd", nondiff=True)
+        # path's segment signature stable across steps — see Adam. The
+        # kernel is MODULE-LEVEL: a closure-free per-call lambda would
+        # get its own jit cache entry every step (compile storm).
+        lr_t = self._scalar_input("lr", self._lr_for(p))
+        new_p = forward(_sgd_update, (p, g, lr_t), name="sgd",
+                        nondiff=True)
         p._data = new_p._data
 
 
@@ -220,7 +251,7 @@ class Momentum(Optimizer):
     def _apply_one(self, p, g):
         mu = self._momentum
         vel = self._acc("velocity", p)
-        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
+        lr_t = self._scalar_input("lr", self._lr_for(p))
 
         def f(w, gg, v, lr):
             gg = gg.astype(w.dtype)
@@ -268,7 +299,7 @@ class Lars(Momentum):
         if any(k in pname for k in self._exclude):
             wd = 0.0
         vel = self._acc("velocity", p)
-        lr_t = Tensor(jnp.asarray(self._lr_for(p), jnp.float32))
+        lr_t = self._scalar_input("lr", self._lr_for(p))
 
         def f(w, gg, v, lr):
             wf = w.astype(jnp.float32)

@@ -708,3 +708,30 @@ class TestEarlyReturnAndLogical:
         np.testing.assert_allclose(g([1, 2, 3], x).numpy(),
                                    3 * np.ones(2))
         np.testing.assert_allclose(g([], x).numpy(), np.ones(2))
+
+    def test_guard_clause_with_implicit_none_left_untouched(self):
+        # `if p: return expr` with implicit None fall-through: a cond
+        # region can't produce None on one side — the normalizer must
+        # leave the If unconverted so concrete preds keep exact python
+        # semantics and a traced pred fails loudly AT THE USER'S LINE
+        # (TracerArrayConversionError) instead of deep in region tracing
+        def f(x):
+            if x.sum() > 0:
+                return x * 2
+            # implicit return None
+
+        g = ast_transform(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(g(x).numpy(), 2 * np.ones(2))
+        assert g(paddle.to_tensor(-np.ones(2, np.float32))) is None
+        import jax
+
+        with pytest.raises(jax.errors.TracerArrayConversionError):
+            paddle.jit.to_static(f)(x)
+
+    def test_not_on_numpy_keeps_python_semantics(self):
+        from paddle_tpu.jit.dy2static import convert_logical_not
+
+        assert convert_logical_not(np.float32(0.0)) is True
+        assert convert_logical_not(np.bool_(True)) is False
+        assert convert_logical_not(0) is True
