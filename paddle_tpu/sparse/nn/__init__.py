@@ -105,6 +105,33 @@ class SubmConv3D(_SparseConv3DBase):
     _subm = True
 
 
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm on sparse values (reference
+    sparse/nn/layer/norm.py SyncBatchNorm over the c_sync_calc/comm
+    kernels).
+
+    TPU re-design: under SPMD the nnz values of a COO tensor live in ONE
+    logical array — a mean/variance reduction over it is already a
+    GLOBAL reduction (GSPMD inserts the cross-device psum), so the
+    reference's explicit sync collectives collapse into plain BatchNorm
+    statistics. The class exists for API parity and for
+    convert_sync_batchnorm porting flows."""
+
+    @classmethod
+    def convert_sync_batchnorm(cls, layer):
+        """Reference API: rewrite BatchNorm sublayers to SyncBatchNorm
+        (a no-op behavior change here — see class docstring)."""
+        if isinstance(layer, BatchNorm) and not isinstance(
+                layer, SyncBatchNorm):
+            new = SyncBatchNorm.__new__(SyncBatchNorm)
+            new.__dict__.update(layer.__dict__)
+            return new
+        for name, sub in list(layer._sub_layers.items()):
+            if isinstance(sub, Layer):  # None sublayers are legal
+                layer._sub_layers[name] = cls.convert_sync_batchnorm(sub)
+        return layer
+
+
 class MaxPool3D(Layer):
     """Reference sparse/nn/layer/pooling.py MaxPool3D."""
 

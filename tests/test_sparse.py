@@ -316,3 +316,24 @@ class TestSparseConv3D:
         assert out.shape == [1, 2, 2, 2, 2]
         assert out.nnz == 0
         np.testing.assert_allclose(np.asarray(out.to_dense().numpy()), 0.0)
+
+    def test_sync_batchnorm_parity_and_convert(self):
+        import paddle_tpu.sparse.nn as snn
+
+        rng = np.random.default_rng(5)
+        x = self._rand_sparse(rng, shape=(1, 4, 4, 4, 3), nnz=12)
+        paddle.seed(11)
+        bn = snn.BatchNorm(3)
+        paddle.seed(11)
+        sbn = snn.SyncBatchNorm(3)
+        a = bn(x).values().numpy()
+        b = sbn(x).values().numpy()
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6)
+
+        class Net(paddle.nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.bn = snn.BatchNorm(3)
+
+        net = snn.SyncBatchNorm.convert_sync_batchnorm(Net())
+        assert isinstance(net.bn, snn.SyncBatchNorm)
