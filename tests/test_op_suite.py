@@ -89,7 +89,9 @@ OPS = [
     ("sinh", paddle.sinh, np.sinh, [_f(3, 4)], {}, True, {}),
     ("cosh", paddle.cosh, np.cosh, [_f(3, 4)], {}, True, {}),
     ("tanh", paddle.tanh, np.tanh, [_f(3, 4)], {}, True, {}),
-    ("erf", paddle.erf, None, [_f(3, 4)], {}, True, {}),
+    ("erf", paddle.erf,
+     lambda x: __import__("scipy.special", fromlist=["erf"]).erf(x),
+     [_f(3, 4)], {}, True, {}),
     ("expm1", paddle.expm1, np.expm1, [_f(3, 4)], {}, False, {}),
     ("reciprocal", paddle.reciprocal, np.reciprocal, [_pos(3, 4)], {},
      True, {}),
@@ -213,7 +215,10 @@ OPS = [
      lambda x: np.where(x > 0, x, 0.01 * x), [_f(3, 4)], {}, True, {}),
     ("elu", F.elu, lambda x: np.where(x > 0, x, np.expm1(x)), [_f(3, 4)],
      {}, True, {}),
-    ("selu", F.selu, None, [_f(3, 4)], {}, False, {}),
+    ("selu", F.selu,
+     lambda x: 1.0507009873554805 * np.where(
+         x > 0, x, 1.6732632423543772 * np.expm1(x)),
+     [_f(3, 4)], {}, False, {}),
     ("hardsigmoid", F.hardsigmoid,
      lambda x: np.clip(x / 6 + 0.5, 0, 1), [_f(3, 4) * 4], {}, False, {}),
     ("hardswish", F.hardswish,
