@@ -20,8 +20,13 @@ kept here:
   * the TCPStore rendezvous (csrc/tcpstore) as the coordination
     substrate a multi-host deployment would use.
 
-A distributed brpc replacement is intentionally out of scope: scale-out
-embeddings on TPU should use mesh sharding, not RPC pulls.
+Round 4 adds the CROSS-PROCESS table service the round-3 review asked to
+either ratify away or build (`service.py`): `DistributedPS` hosts these
+tables on dedicated server processes over `distributed.rpc` (dense
+tables on a hash owner, sparse rows sharded `id % n_servers`), with
+worker-side pull/push fan-out — the brpc_ps_client/server role at
+control-plane scale. TB-scale CPU embedding *serving* remains out of
+scope: scale-out embeddings on TPU use mesh sharding, not RPC pulls.
 """
 from __future__ import annotations
 

@@ -303,6 +303,26 @@ def get_current_worker_info():
     return _agent().me
 
 
+def _store_barrier(store, tag, count):
+    """Store-backed barrier among `count` participants (the reference's
+    _barrier_never_timeout pattern), generation-counted so one tag can be
+    reused."""
+    import time
+
+    n = store.add(f"rpc/barrier/{tag}", 1)
+    target = ((n - 1) // count + 1) * count
+    while store.add(f"rpc/barrier/{tag}", 0) < target:
+        time.sleep(0.01)
+
+
+def _barrier(tag, count):
+    with _state_lock:
+        if _state is None:
+            raise RuntimeError("rpc is not initialized")
+        store = _state["store"]
+    _store_barrier(store, tag, count)
+
+
 def shutdown():
     """Graceful stop: barrier so no worker exits while peers still call it
     (reference rpc.py shutdown's _barrier_never_timeout), then close."""
@@ -312,8 +332,5 @@ def shutdown():
             return
         agent, store = _state["agent"], _state["store"]
         _state = None
-    store.add("rpc/stop_barrier", 1)
-    import time
-    while store.add("rpc/stop_barrier", 0) < agent.world_size:
-        time.sleep(0.01)
+    _store_barrier(store, "stop", agent.world_size)
     agent.stop()

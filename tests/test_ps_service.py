@@ -1,0 +1,111 @@
+"""Cross-process parameter-server table service (reference
+brpc_ps_client/server pull-push over the_one_ps; here distributed.rpc +
+the in-process tables as shard backend — distributed/ps/service.py).
+
+Topology under test: 2 server processes + 2 worker processes, sparse
+rows sharded id%2 across servers, dense table on its hash owner."""
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+ROLE_SCRIPT = textwrap.dedent("""
+    import os, sys
+    import numpy as np
+    from paddle_tpu.distributed.ps import PaddleCloudRoleMaker
+    from paddle_tpu.distributed.ps.service import DistributedPS
+
+    master = os.environ["TEST_MASTER"]
+    ps = DistributedPS(PaddleCloudRoleMaker(), master_endpoint=master)
+    role = os.environ["TRAINING_ROLE"]
+    if role == "PSERVER":
+        ps.run_server()
+        sys.exit(0)
+
+    wid = int(os.environ["PADDLE_TRAINER_ID"])
+    dense = ps.create_dense_table("w", (4,), optimizer="sgd", lr=0.5)
+    emb = ps.create_sparse_table("emb", 4, lr=0.1)
+    ps.barrier()
+
+    if wid == 0:
+        dense.load(np.arange(4, dtype=np.float32))
+    ps.barrier()
+    # both workers see the loaded value
+    np.testing.assert_allclose(dense.pull(),
+                               np.arange(4, dtype=np.float32))
+    if wid == 1:
+        dense.push(np.ones(4, np.float32))  # sgd lr=0.5 -> -0.5
+    ps.barrier()
+    np.testing.assert_allclose(dense.pull(),
+                               np.arange(4, dtype=np.float32) - 0.5)
+
+    # sparse rows span BOTH shards (even ids -> server0, odd -> server1)
+    ids = np.array([0, 1, 2, 3, 7], np.int64)
+    if wid == 0:
+        before = emb.pull(ids)           # lazy-init on owning servers
+        grads = np.full((5, 4), 2.0, np.float32)
+        emb.push(ids, grads)
+        after = emb.pull(ids)
+        np.testing.assert_allclose(after, before - 0.1 * 2.0, rtol=1e-6)
+    ps.barrier()
+    # worker1 sees worker0's rows (shared server state) and total size
+    if wid == 1:
+        assert emb.size() == 5
+        row0 = emb.pull(np.array([7], np.int64))
+        assert row0.shape == (1, 4)
+    ps.barrier()
+    if wid == 0:
+        ps.stop_servers()
+    ps.shutdown()
+    print("PS-WORKER-OK", wid)
+""")
+
+
+def test_ps_service_two_servers_two_workers(tmp_path):
+    port = _free_port()
+    script = tmp_path / "role.py"
+    script.write_text(ROLE_SCRIPT)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    servers = "127.0.0.1:1,127.0.0.1:2"   # layout only (count matters)
+    workers = "127.0.0.1:3,127.0.0.1:4"
+    procs = []
+    for role, n in (("PSERVER", 2), ("TRAINER", 2)):
+        for i in range(n):
+            env = dict(os.environ)
+            env.update({
+                "JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+                "PYTHONPATH": repo + os.pathsep + env.get("PYTHONPATH", ""),
+                "TEST_MASTER": f"127.0.0.1:{port}",
+                "TRAINING_ROLE": role,
+                "PADDLE_TRAINER_ID": str(i),
+                "PADDLE_PSERVERS_IP_PORT_LIST": servers,
+                "PADDLE_TRAINER_ENDPOINTS": workers,
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, str(script)], env=env,
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True))
+    try:
+        outs = []
+        for p in procs:
+            out, _ = p.communicate(timeout=240)
+            outs.append(out)
+            assert p.returncode == 0, out[-800:]
+        joined = "\n".join(outs)
+        assert "PS-WORKER-OK 0" in joined and "PS-WORKER-OK 1" in joined
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
