@@ -27,7 +27,11 @@ import jax.numpy as jnp
 from ...core.tensor import Tensor
 from ...optimizer.optimizer import Optimizer
 
-__all__ = ["DGCMomentumOptimizer", "DistributedFusedLamb", "ModelAverage"]
+from .lbfgs import LBFGS  # noqa: F401
+from .lookahead import LookAhead  # noqa: F401
+
+__all__ = ["DGCMomentumOptimizer", "DistributedFusedLamb", "ModelAverage",
+           "LookAhead", "LBFGS"]
 
 
 class ModelAverage:

@@ -1,0 +1,254 @@
+"""L-BFGS optimizer (reference `python/paddle/incubate/optimizer/lbfgs.py`
+LBFGS + `line_search_dygraph.py` `_strong_wolfe` — the torch-style
+full-batch quasi-Newton optimizer driven by a loss closure).
+
+TPU re-design: L-BFGS is inherently a HOST-DRIVEN algorithm — the
+two-loop recursion over a small history and the line-search control flow
+are data-dependent scalar logic, while each closure evaluation
+(forward+backward) is one big compiled device step. So the history math
+runs in numpy on flattened parameter vectors and the closure is whatever
+the user provides (typically a jit.TrainStep-style compiled
+loss-and-grad); no attempt is made to force the outer loop into XLA.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from ...core.tensor import Tensor
+
+__all__ = ["LBFGS"]
+
+
+def _strong_wolfe(obj, t, d, f0, g0, gtd0, c1=1e-4, c2=0.9,
+                  tolerance_change=1e-9, max_ls=25):
+    """Strong-Wolfe line search (reference line_search_dygraph.py
+    _strong_wolfe; Nocedal & Wright alg. 3.5/3.6). `obj(t)` evaluates
+    (f, g_flat) at x + t*d. Returns (f, g, t, n_evals); t=0 means the
+    search failed and the caller must not move."""
+    d_norm = np.abs(d).max()
+    g0 = g0.copy()
+    # bracket phase
+    f_prev, g_prev, t_prev = f0, g0, 0.0
+    ls_iter = 0
+    done = False
+    f_new, g_new = obj(t)
+    ls_iter += 1
+    gtd_new = float(g_new @ d)
+    while ls_iter < max_ls:
+        if f_new > (f0 + c1 * t * gtd0) or (ls_iter > 1 and
+                                            f_new >= f_prev):
+            bracket = [t_prev, t]
+            bracket_f = [f_prev, f_new]
+            bracket_g = [g_prev, g_new.copy()]
+            break
+        if abs(gtd_new) <= -c2 * gtd0:
+            return f_new, g_new, t, ls_iter
+        if gtd_new >= 0:
+            bracket = [t_prev, t]
+            bracket_f = [f_prev, f_new]
+            bracket_g = [g_prev, g_new.copy()]
+            break
+        # extrapolate, clamped
+        min_step = t + 0.01 * (t - t_prev)
+        max_step = t * 10
+        t_prev, f_prev, g_prev = t, f_new, g_new.copy()
+        t = min(max(2 * t, min_step), max_step)
+        f_new, g_new = obj(t)
+        ls_iter += 1
+        gtd_new = float(g_new @ d)
+    else:
+        # bracket budget exhausted: the last extrapolation was never
+        # Armijo-checked — accept it only if it actually decreases
+        if f_new <= f0 + c1 * t * gtd0:
+            return f_new, g_new, t, ls_iter
+        return f0, g0, 0.0, ls_iter  # fail: don't move
+
+    # zoom phase: bisect the bracket (the reference uses safeguarded
+    # cubic interpolation; bisection keeps the same convergence contract
+    # with simpler control flow)
+    while not done and ls_iter < max_ls:
+        if abs(bracket[1] - bracket[0]) * d_norm < tolerance_change:
+            break
+        t = 0.5 * (bracket[0] + bracket[1])
+        f_new, g_new = obj(t)
+        ls_iter += 1
+        gtd_new = float(g_new @ d)
+        lo = 0 if bracket_f[0] <= bracket_f[1] else 1
+        if f_new > (f0 + c1 * t * gtd0) or f_new >= bracket_f[lo]:
+            hi = 1 - lo
+            bracket[hi], bracket_f[hi] = t, f_new
+            bracket_g[hi] = g_new.copy()
+        else:
+            if abs(gtd_new) <= -c2 * gtd0:
+                done = True
+            elif gtd_new * (bracket[1 - lo] - bracket[lo]) >= 0:
+                bracket[1 - lo] = bracket[lo]
+                bracket_f[1 - lo] = bracket_f[lo]
+                bracket_g[1 - lo] = bracket_g[lo]
+            bracket[lo], bracket_f[lo] = t, f_new
+            bracket_g[lo] = g_new.copy()
+    lo = 0 if bracket_f[0] <= bracket_f[1] else 1
+    return bracket_f[lo], bracket_g[lo], bracket[lo], ls_iter
+
+
+class LBFGS:
+    """Usage (reference API):
+        opt = LBFGS(parameters=model.parameters(), learning_rate=1.0,
+                    line_search_fn='strong_wolfe')
+        def closure():
+            opt.clear_grad()
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            return loss
+        opt.step(closure)
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError("LBFGS requires parameters")
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError(
+                f"line_search_fn must be None or 'strong_wolfe', got "
+                f"{line_search_fn!r}")
+        self._parameter_list = [p for p in parameters if p is not None]
+        self.lr = float(learning_rate)
+        self.max_iter = int(max_iter)
+        self.max_eval = int(max_eval) if max_eval is not None \
+            else self.max_iter * 5 // 4
+        self.tol_grad = float(tolerance_grad)
+        self.tol_change = float(tolerance_change)
+        self.history_size = int(history_size)
+        self.line_search_fn = line_search_fn
+        self._s: list = []  # param displacements
+        self._y: list = []  # grad displacements
+
+    # -- flat-vector plumbing ---------------------------------------------
+    def _trainables(self):
+        return [p for p in self._parameter_list if not p.stop_gradient]
+
+    def _flat_params(self):
+        return np.concatenate([
+            np.asarray(p._data, np.float64).ravel()
+            for p in self._trainables()])
+
+    def _flat_grads(self):
+        out = []
+        for p in self._trainables():
+            g = p.grad
+            arr = np.zeros(np.asarray(p._data).shape, np.float64) \
+                if g is None else np.asarray(g._data, np.float64)
+            out.append(arr.ravel())
+        return np.concatenate(out)
+
+    def _set_flat_params(self, vec):
+        i = 0
+        for p in self._trainables():
+            shape = np.asarray(p._data).shape
+            n = int(np.prod(shape)) if shape else 1
+            chunk = vec[i:i + n].reshape(shape)
+            p._data = jnp.asarray(chunk).astype(p._data.dtype)
+            i += n
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list:
+            if p is not None:
+                p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.lr
+
+    def state_dict(self):
+        """Curvature history is THE optimizer state: losing it on resume
+        resets the Hessian approximation."""
+        return {"s": [np.asarray(s) for s in self._s],
+                "y": [np.asarray(y) for y in self._y]}
+
+    def set_state_dict(self, state_dict):
+        self._s = [np.asarray(s, np.float64)
+                   for s in state_dict.get("s", [])]
+        self._y = [np.asarray(y, np.float64)
+                   for y in state_dict.get("y", [])]
+
+    # -- the optimizer -----------------------------------------------------
+    def step(self, closure):
+        """Run up to max_iter L-BFGS iterations; `closure` re-evaluates
+        the loss and gradients (it must call backward). Returns the loss
+        at entry, reference/torch contract."""
+        n_evals = 0
+
+        def evaluate():
+            nonlocal n_evals
+            n_evals += 1
+            loss = closure()
+            f = float(loss._data if isinstance(loss, Tensor) else loss)
+            return f, self._flat_grads()
+
+        x = self._flat_params()
+        f, g = evaluate()
+        loss0 = f
+        if float(np.abs(g).max()) <= self.tol_grad:
+            return loss0
+
+        for it in range(self.max_iter):
+            # two-loop recursion over the (s, y) history
+            q = g.copy()
+            alphas = []
+            for s, y in zip(reversed(self._s), reversed(self._y)):
+                rho = 1.0 / float(y @ s)
+                a = rho * float(s @ q)
+                alphas.append((a, rho))
+                q -= a * y
+            if self._y:
+                y_last, s_last = self._y[-1], self._s[-1]
+                gamma = float(s_last @ y_last) / float(y_last @ y_last)
+                q *= gamma
+            for (a, rho), s, y in zip(reversed(alphas), self._s, self._y):
+                b = rho * float(y @ q)
+                q += (a - b) * s
+            d = -q
+            gtd = float(g @ d)
+            if gtd > -self.tol_change:
+                break  # not a descent direction: history degenerate
+
+            t = self.lr if (self._y or it > 0) else \
+                min(1.0, 1.0 / max(float(np.abs(g).sum()), 1e-12)) * self.lr
+
+            if self.line_search_fn == "strong_wolfe":
+                def obj(tt, _x=x, _d=d):
+                    self._set_flat_params(_x + tt * _d)
+                    return evaluate()
+
+                f_new, g_new, t, ls_evals = _strong_wolfe(
+                    obj, t, d, f, g, gtd,
+                    tolerance_change=self.tol_change)
+                if t == 0.0:
+                    self._set_flat_params(x)
+                    break  # line search failed: stay put
+                x_new = x + t * d
+                self._set_flat_params(x_new)
+            else:
+                x_new = x + t * d
+                self._set_flat_params(x_new)
+                f_new, g_new = evaluate()
+
+            s, yv = x_new - x, g_new - g
+            if float(yv @ s) > 1e-10:
+                self._s.append(s)
+                self._y.append(yv)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+
+            converged = (float(np.abs(g_new).max()) <= self.tol_grad or
+                         float(np.abs(s).max()) <= self.tol_change or
+                         abs(f_new - f) < self.tol_change)
+            x, f, g = x_new, f_new, g_new
+            if converged or n_evals >= self.max_eval:
+                break
+        return loss0

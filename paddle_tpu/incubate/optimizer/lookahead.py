@@ -1,0 +1,102 @@
+"""LookAhead optimizer wrapper (reference
+`python/paddle/incubate/optimizer/lookahead.py` LookAhead: Zhang et al.
+2019 "Lookahead Optimizer: k steps forward, 1 step back").
+
+The inner optimizer advances the FAST weights every step; every k-th
+step the SLOW weights interpolate toward them
+(slow += alpha * (fast - slow)) and the fast weights reset to the slow
+point. The sync is a dispatched op, so it stays deferred under lazy
+eager mode and traces cleanly under jit.TrainStep."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...core.dispatch import forward
+from ...core.tensor import Tensor
+
+__all__ = ["LookAhead"]
+
+
+class LookAhead:
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha must be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._step_num = 0
+        self._slow: dict[int, Tensor] = {}
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def step(self):
+        self._seed_slow()
+        self.inner_optimizer.step()
+        self._after_inner_step()
+
+    def minimize(self, loss, **kw):
+        self._seed_slow()
+        out = self.inner_optimizer.minimize(loss, **kw)
+        self._after_inner_step()
+        return out
+
+    def _seed_slow(self):
+        """Slow weights start at the params' value BEFORE the first fast
+        step (reference _add_accumulator seeding at first optimize op)."""
+        for p in self._parameter_list:
+            if p is not None and not p.stop_gradient and \
+                    id(p) not in self._slow:
+                self._slow[id(p)] = Tensor(jnp.asarray(p._data))
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def _after_inner_step(self):
+        self._step_num += 1
+        if self._step_num % self.k:
+            return
+        alpha = self.alpha
+        for p in self._parameter_list:
+            if p is None or p.stop_gradient:
+                continue
+            slow = self._slow[id(p)]
+
+            def f(fast, sl):
+                new_slow = sl + alpha * (fast.astype(sl.dtype) - sl)
+                return new_slow.astype(fast.dtype), new_slow
+
+            new_fast, new_slow = forward(f, (p, slow), name="lookahead",
+                                         nondiff=True)
+            p._data = new_fast._data
+            slow._data = new_slow._data
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@lookahead@step"] = self._step_num
+        # slow weights are real optimizer state (reference stores them as
+        # accumulators): without them a mid-cycle resume would reseed
+        # slow from the FAST params and silently diverge
+        for i, p in enumerate(self._parameter_list):
+            if p is not None and id(p) in self._slow:
+                sd[f"@lookahead@slow@{i}"] = self._slow[id(p)]
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_num = int(state_dict.get("@lookahead@step",
+                                            self._step_num))
+        for i, p in enumerate(self._parameter_list):
+            key = f"@lookahead@slow@{i}"
+            if p is not None and key in state_dict:
+                v = state_dict[key]
+                self._slow[id(p)] = v if isinstance(v, Tensor) \
+                    else Tensor(jnp.asarray(v))
+        self.inner_optimizer.set_state_dict(state_dict)

@@ -122,3 +122,143 @@ def test_optimizer_state_dict():
     opt2.step()  # create accumulators
     opt2.set_state_dict(sd)
     assert opt2._opt_step == 1
+
+
+class TestLookAhead:
+    """Reference incubate/optimizer/lookahead.py: k fast steps, then
+    slow += alpha*(fast-slow) and fast resets to slow."""
+
+    def test_matches_manual_slow_fast(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+
+        paddle.seed(3)
+        p = paddle.to_tensor(np.array([10.0, -10.0], np.float32))
+        p.stop_gradient = False
+        inner = paddle.optimizer.SGD(learning_rate=1.0, parameters=[p])
+        opt = LookAhead(inner, alpha=0.5, k=2)
+        g = np.array([1.0, -1.0], np.float32)
+        x0 = np.array([10.0, -10.0], np.float32)
+        for step in range(4):
+            p.grad = paddle.to_tensor(g)
+            opt.step()
+            opt.clear_grad()
+        # manual: fast after 2 sgd steps = x0 - 2g; sync1: slow=x0+0.5*
+        # ((x0-2g)-x0)=x0-g; fast=slow. two more steps -> fast=x0-3g;
+        # sync2: slow=x0-g+0.5*((x0-3g)-(x0-g))=x0-2g
+        np.testing.assert_allclose(np.asarray(p.numpy()), x0 - 2 * g,
+                                   rtol=1e-6)
+
+    def test_trains_mlp(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+        import paddle_tpu.nn as nn
+
+        paddle.seed(5)
+        net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 1))
+        opt = LookAhead(paddle.optimizer.Adam(
+            learning_rate=0.05, parameters=net.parameters()), k=3)
+        rng = np.random.default_rng(0)
+        X = rng.normal(size=(32, 8)).astype(np.float32)
+        Y = (X @ rng.normal(size=(8, 1))).astype(np.float32)
+        xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+        losses = []
+        for _ in range(30):
+            loss = ((net(xt) - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.2
+
+
+class TestLBFGS:
+    """Reference incubate/optimizer/lbfgs.py (torch-style closure API)."""
+
+    def test_quadratic_exact(self):
+        from paddle_tpu.incubate.optimizer import LBFGS
+
+        p = paddle.to_tensor(np.array([3.0, -4.0], np.float32))
+        p.stop_gradient = False
+        target = np.array([1.0, 2.0], np.float32)
+        opt = LBFGS(parameters=[p], learning_rate=1.0, max_iter=20,
+                    line_search_fn="strong_wolfe")
+
+        def closure():
+            opt.clear_grad()
+            loss = ((p - paddle.to_tensor(target)) ** 2).sum()
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        np.testing.assert_allclose(np.asarray(p.numpy()), target,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_rosenbrock_converges(self):
+        from paddle_tpu.incubate.optimizer import LBFGS
+
+        p = paddle.to_tensor(np.array([-1.2, 1.0], np.float32))
+        p.stop_gradient = False
+        opt = LBFGS(parameters=[p], learning_rate=1.0, max_iter=60,
+                    history_size=10, line_search_fn="strong_wolfe")
+
+        def closure():
+            opt.clear_grad()
+            a = p[1] - p[0] * p[0]
+            b = 1.0 - p[0]
+            loss = 100.0 * (a * a) + b * b
+            loss.backward()
+            return loss
+
+        for _ in range(4):  # a few restarts of max_iter each
+            opt.step(closure)
+        np.testing.assert_allclose(np.asarray(p.numpy()), [1.0, 1.0],
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_fixed_step_no_line_search(self):
+        from paddle_tpu.incubate.optimizer import LBFGS
+
+        p = paddle.to_tensor(np.array([5.0], np.float32))
+        p.stop_gradient = False
+        opt = LBFGS(parameters=[p], learning_rate=0.4, max_iter=30)
+
+        def closure():
+            opt.clear_grad()
+            loss = (p * p).sum()
+            loss.backward()
+            return loss
+
+        opt.step(closure)
+        assert abs(float(p.numpy()[0])) < 1e-3
+
+    def test_lookahead_state_roundtrip_mid_cycle(self):
+        from paddle_tpu.incubate.optimizer import LookAhead
+
+        def build():
+            p = paddle.to_tensor(np.array([10.0, -10.0], np.float32))
+            p.stop_gradient = False
+            return p, LookAhead(paddle.optimizer.SGD(
+                learning_rate=1.0, parameters=[p]), alpha=0.5, k=3)
+
+        g = np.array([1.0, -1.0], np.float32)
+
+        def run(opt, p, n):
+            for _ in range(n):
+                p.grad = paddle.to_tensor(g)
+                opt.step()
+                opt.clear_grad()
+
+        # uninterrupted 5 steps
+        p1, o1 = build()
+        run(o1, p1, 5)
+        # 2 steps, checkpoint, resume into a fresh instance, 3 more
+        p2, o2 = build()
+        run(o2, p2, 2)
+        sd = o2.state_dict()
+        p3 = paddle.to_tensor(np.asarray(p2.numpy()))
+        p3.stop_gradient = False
+        o3 = LookAhead(paddle.optimizer.SGD(learning_rate=1.0,
+                                            parameters=[p3]),
+                       alpha=0.5, k=3)
+        o3.set_state_dict(sd)
+        run(o3, p3, 3)
+        np.testing.assert_allclose(np.asarray(p3.numpy()),
+                                   np.asarray(p1.numpy()), rtol=1e-6)
