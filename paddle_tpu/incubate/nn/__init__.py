@@ -19,7 +19,10 @@ import math
 from ... import nn, ops
 from ...nn import functional as F
 
-__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+__all__ = ["memory_efficient_attention", "identity_loss",
+           "AttentionBias", "LowerTriangularMask",
+           "LowerTriangularMaskWithTensorBias",
+           "FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer", "FusedMultiTransformer",
            "FusedLinear"]
 
@@ -160,3 +163,79 @@ class FusedMultiTransformer(nn.Layer):
         for layer in self.layers:
             out = layer(out, attn_mask)
         return out
+
+
+# ------------------------------------------------ attention bias types
+class AttentionBias:
+    """Base marker (reference incubate/nn/attn_bias.py AttentionBias)."""
+
+
+class LowerTriangularMask(AttentionBias):
+    """Causal mask marker — routes memory_efficient_attention onto the
+    flash kernel's native causal path (no [T, T] materialization)."""
+
+
+class LowerTriangularMaskWithTensorBias(LowerTriangularMask):
+    def __init__(self, bias):
+        self._bias = bias
+
+
+def memory_efficient_attention(query, key, value, attn_bias=None, p=0.0,
+                               scale=None, training=True):
+    """Reference incubate/nn/memory_efficient_attention.py:67 (the
+    xFormers-style kernel dispatcher over
+    `memory_efficient_attention_op`). Layout [B, T, N, H].
+
+    TPU re-design: "memory-efficient attention" and flash attention are
+    the same O(T)-memory algorithm — this dispatches to the framework's
+    attention path (Pallas flash kernel on TPU when tileable, fused XLA
+    otherwise): causal markers use the kernel's native causal flag,
+    tensor biases fold into the fused-softmax path. Routed through the
+    single dispatch point so autograd/AMP/lazy all apply."""
+    from ...core.dispatch import forward
+    from ...core.tensor import Tensor
+    from ...ops import pallas_ops
+
+    causal = isinstance(attn_bias, LowerTriangularMask)
+    bias = None
+    if isinstance(attn_bias, LowerTriangularMaskWithTensorBias):
+        bias = attn_bias._bias
+    elif attn_bias is not None and not isinstance(attn_bias, AttentionBias):
+        bias = attn_bias  # raw tensor bias
+    fold_causal = causal and bias is not None
+
+    def f(q, k, v, *b):
+        import jax.numpy as jnp
+
+        mask = b[0] if b else None
+        is_causal = causal
+        if fold_causal:
+            # fold the causal structure into the additive bias: the
+            # masked path can't also use the kernel's causal flag
+            tri = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+            mask = jnp.where(tri, mask, -jnp.inf)
+            is_causal = False
+        return pallas_ops.flash_attention(q, k, v, mask=mask,
+                                          causal=is_causal, scale=scale)
+
+    ins = (query, key, value) + (() if bias is None else (bias,))
+    out = forward(f, ins, name="memory_efficient_attention")
+    if p > 0.0 and training:
+        from ... import nn as _nn
+
+        out = _nn.functional.dropout(out, p=p, training=True)
+    return out if isinstance(out, Tensor) else Tensor(out)
+
+
+def identity_loss(x, reduction="none"):
+    """Reference incubate/nn/loss.py identity_loss (the IPU loss marker;
+    here the reductions are the whole semantic)."""
+    from ...core.tensor import Tensor
+
+    if reduction in (0, "sum"):
+        return x.sum() if isinstance(x, Tensor) else Tensor(x).sum()
+    if reduction in (1, "mean"):
+        return x.mean() if isinstance(x, Tensor) else Tensor(x).mean()
+    if reduction in (2, "none"):
+        return x if isinstance(x, Tensor) else Tensor(x)
+    raise ValueError(f"reduction must be sum/mean/none, got {reduction!r}")

@@ -114,6 +114,10 @@ class LBFGS:
             raise ValueError(
                 f"line_search_fn must be None or 'strong_wolfe', got "
                 f"{line_search_fn!r}")
+        if weight_decay is not None or grad_clip is not None:
+            raise ValueError(
+                "LBFGS does not apply weight_decay/grad_clip (fold the "
+                "penalty into the closure's loss instead)")
         self._parameter_list = [p for p in parameters if p is not None]
         self.lr = float(learning_rate)
         self.max_iter = int(max_iter)

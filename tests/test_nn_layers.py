@@ -229,3 +229,64 @@ class TestRNN:
         cell = nn.LSTMCell(4, 8)
         h, (hn, cn) = cell(_x(2, 4))
         assert h.shape == [2, 8]
+
+
+class TestMemoryEfficientAttention:
+    """Reference incubate/nn/memory_efficient_attention.py — same O(T)
+    algorithm as flash attention, dispatched to the framework kernel."""
+
+    def test_causal_matches_dense_reference(self):
+        from paddle_tpu.incubate.nn import (LowerTriangularMask,
+                                            memory_efficient_attention)
+
+        rng = np.random.default_rng(0)
+        B, T, N, H = 2, 16, 2, 8
+        q = rng.normal(size=(B, T, N, H)).astype(np.float32)
+        k = rng.normal(size=(B, T, N, H)).astype(np.float32)
+        v = rng.normal(size=(B, T, N, H)).astype(np.float32)
+        out = memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            attn_bias=LowerTriangularMask()).numpy()
+
+        # dense reference
+        logits = np.einsum("bqnh,bknh->bnqk", q, k) / np.sqrt(H)
+        tri = np.tril(np.ones((T, T), bool))
+        logits = np.where(tri, logits, -np.inf)
+        probs = np.exp(logits - logits.max(-1, keepdims=True))
+        probs /= probs.sum(-1, keepdims=True)
+        ref = np.einsum("bnqk,bknh->bqnh", probs, v)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_tensor_bias_and_identity_loss(self):
+        from paddle_tpu.incubate.nn import (identity_loss,
+                                            memory_efficient_attention)
+
+        rng = np.random.default_rng(1)
+        B, T, N, H = 1, 8, 2, 4
+        q = rng.normal(size=(B, T, N, H)).astype(np.float32)
+        bias = rng.normal(size=(B, N, T, T)).astype(np.float32)
+        out = memory_efficient_attention(
+            paddle.to_tensor(q), paddle.to_tensor(q), paddle.to_tensor(q),
+            attn_bias=paddle.to_tensor(bias))
+        assert out.shape == [B, T, N, H]
+        assert np.isfinite(out.numpy()).all()
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        assert float(identity_loss(x, "sum")) == 6.0
+        assert float(identity_loss(x, "mean")) == 2.0
+        np.testing.assert_allclose(identity_loss(x, "none").numpy(),
+                                   [1, 2, 3])
+
+    def test_memory_efficient_attention_has_grads(self):
+        from paddle_tpu.incubate.nn import (LowerTriangularMask,
+                                            memory_efficient_attention)
+
+        rng = np.random.default_rng(2)
+        q = paddle.to_tensor(rng.normal(size=(1, 8, 2, 4))
+                             .astype(np.float32))
+        q.stop_gradient = False
+        out = memory_efficient_attention(q, q, q,
+                                         attn_bias=LowerTriangularMask())
+        out.sum().backward()
+        assert q.grad is not None
+        assert np.isfinite(np.asarray(q.grad.numpy())).all()
