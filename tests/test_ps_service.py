@@ -100,7 +100,10 @@ def test_ps_service_two_servers_two_workers(tmp_path):
     try:
         outs = []
         for p in procs:
-            out, _ = p.communicate(timeout=240)
+            # generous: the whole suite shares ONE core, and four
+            # fresh interpreters importing jax under that load can
+            # take minutes before the barriers even form
+            out, _ = p.communicate(timeout=600)
             outs.append(out)
             assert p.returncode == 0, out[-800:]
         joined = "\n".join(outs)
