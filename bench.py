@@ -207,6 +207,16 @@ def _note(text):
           file=sys.stderr, flush=True)
 
 
+def _replay_line(history, note):
+    """Best banked on-chip line, re-tagged for replay. ADVICE r4: a
+    replay must never carry "best": true — only a freshly-measured line
+    may; the replay gets "best_on_record" plus cached + its timestamp."""
+    cached = dict(history[0])
+    cached.pop("best", None)
+    cached.update({"cached": True, "best_on_record": True, "note": note})
+    return cached
+
+
 def _attempt(cfg, env, watchdog):
     """Run one config in a watchdog subprocess. Returns (record|None, err)."""
     preset, batch, seq, policy = cfg
@@ -342,12 +352,9 @@ def main():
                           "unit": "tokens/s/chip", "vs_baseline": 0,
                           "error": last_err[:300]}), flush=True)
         if history:
-            cached = dict(history[0])
-            cached.update({"cached": True, "best": True,
-                           "note": "run FAILED (see error line); replayed "
-                                   "prior on-chip measurement from "
-                                   ".bench_history.json"})
-            print(json.dumps(cached), flush=True)
+            print(json.dumps(_replay_line(
+                history, "run FAILED (see error line); replayed prior "
+                "on-chip measurement from .bench_history.json")), flush=True)
         return 1
 
     # best = highest-MFU real-accelerator line from THIS run; degraded
@@ -360,14 +367,12 @@ def main():
     pool = real_now or results
     best = max(pool, key=lambda r: r.get("mfu", 0))
     if not real_now and history:
-        cached = dict(history[0])
-        cached.update({"cached": True, "best": True,
-                       "note": "accelerator dead this run; replayed from "
-                               ".bench_history.json (a REAL prior on-chip "
-                               "measurement, timestamp in measured_at)"})
         print(json.dumps({**best, "fresh_degraded_best": True}),
               flush=True)
-        print(json.dumps(cached), flush=True)
+        print(json.dumps(_replay_line(
+            history, "accelerator dead this run; replayed from "
+            ".bench_history.json (a REAL prior on-chip measurement, "
+            "timestamp in measured_at)")), flush=True)
         return 0
     print(json.dumps({**best, "best": True}), flush=True)
     return 0

@@ -72,6 +72,39 @@ def _init_coverage_sink():
 _init_coverage_sink()
 
 
+_trace_state_clean = None
+
+
+def trace_state_clean():
+    """True when no jax trace is active (safe to cache committed arrays /
+    dispatch nested executables). Resolves the probe once: public
+    `jax.core` first, the private `jax._src.core` as fallback; when
+    neither exports it (jaxlib moved the symbol) every call reports
+    DIRTY, which degrades callers to their safe path (fresh scalar,
+    inline call) instead of raising."""
+    global _trace_state_clean
+    if _trace_state_clean is None:
+        fn = getattr(jax.core, "trace_state_clean", None)
+        if fn is None:
+            try:
+                from jax._src import core as _jcore
+
+                fn = getattr(_jcore, "trace_state_clean", None)
+            except ImportError:
+                fn = None
+        if fn is None:
+            import warnings
+
+            warnings.warn(
+                "jax no longer exports trace_state_clean; paddle_tpu "
+                "degrades to always-dirty trace state (StaticFunction "
+                "inlines every call, optimizer scalars are never cached)",
+                RuntimeWarning, stacklevel=2)
+            fn = lambda: False  # noqa: E731
+        _trace_state_clean = fn
+    return _trace_state_clean()
+
+
 def unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 

@@ -120,9 +120,9 @@ class StaticFunction:
         # autograd tape (zero grads for every upstream param) and thread
         # traced state through host-side globals. One cheap global check;
         # no per-call state walk.
-        from jax._src import core as _jcore
+        from ..core.dispatch import trace_state_clean
 
-        if not _jcore.trace_state_clean():
+        if not trace_state_clean():
             return self._fn(*args)
         if self._compiled is None:
             self._build()

@@ -63,9 +63,9 @@ class Optimizer:
         later sharded jit gets lifted into a hidden executable argument
         (buffer-count mismatch at dispatch), and a cached tracer poisons
         every later compile."""
-        from jax._src import core as _jcore
+        from ..core.dispatch import trace_state_clean
 
-        if hasattr(value, "dtype") or not _jcore.trace_state_clean():
+        if hasattr(value, "dtype") or not trace_state_clean():
             return Tensor(jnp.asarray(value, jnp.float32))
         cache = getattr(self, "_scalar_cache", None)
         if cache is None:

@@ -185,8 +185,12 @@ def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
         oc, valid = _window_tap(coords, out_sp, pad, st, off)
         contrib = (vals @ w_o.astype(vals.dtype)) * \
             valid[:, None].astype(vals.dtype)
+        # invalid taps route to the OOB sentinel (== out_sp), not index 0:
+        # sum_duplicates groups them as padding and _compact_eager drops
+        # them, so no phantom zero-valued active site appears at (n,0,0,0)
+        sent = jnp.asarray(out_sp, jnp.int32)
         idx = jnp.concatenate(
-            [coords[:, :1], jnp.where(valid[:, None], oc, 0)], axis=1)
+            [coords[:, :1], jnp.where(valid[:, None], oc, sent)], axis=1)
         return idx, contrib
 
     idxs, contribs = jax.vmap(tap)((offs, w_flat))
