@@ -67,6 +67,9 @@ def _free_port():
 
 
 def test_kill_one_of_three_resumes_at_world_two(tmp_path):
+    from proc_utils import proc_timeout, shed_parent_memory
+
+    shed_parent_memory()
     trainer = tmp_path / "trainer.py"
     trainer.write_text(TRAINER)
     wrapper = tmp_path / "wrapper.py"
@@ -83,7 +86,7 @@ def test_kill_one_of_three_resumes_at_world_two(tmp_path):
     # (imports are slow on one core; killing pre-registration would test
     # the never-registered path instead of lease expiry)
     ckpt2 = tmp_path / "host2.ckpt"
-    deadline = time.time() + 120
+    deadline = time.time() + proc_timeout(120)
     while time.time() < deadline:
         try:
             if ckpt2.exists() and int(ckpt2.read_text() or 0) >= 3:
@@ -96,7 +99,7 @@ def test_kill_one_of_three_resumes_at_world_two(tmp_path):
     procs[2].send_signal(signal.SIGKILL)  # host 2 dies (heartbeat stops)
 
     for r in (0, 1):
-        rc = procs[r].wait(timeout=90)
+        rc = procs[r].wait(timeout=proc_timeout(90))
         out = procs[r].stdout.read()
         assert rc == 0, f"host{r}: rc={rc} out={out}"
         assert "STATUS completed" in out

@@ -56,6 +56,9 @@ def _free_port():
 
 
 def test_two_node_world_allreduce(tmp_path):
+    from proc_utils import proc_timeout, shed_parent_memory
+
+    shed_parent_memory()
     script = tmp_path / "trainer.py"
     script.write_text(TRAINER)
     master = f"127.0.0.1:{_free_port()}"
@@ -75,7 +78,7 @@ def test_two_node_world_allreduce(tmp_path):
              "--nproc_per_node", "1",
              "--log_dir", str(tmp_path / f"log{rank}"), str(script)],
             env=env, cwd=str(tmp_path)))
-    deadline = time.time() + 300
+    deadline = time.time() + proc_timeout(300)
     for p in procs:
         rc = p.wait(timeout=max(5, deadline - time.time()))
         assert rc == 0, _logs(tmp_path)

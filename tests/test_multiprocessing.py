@@ -5,10 +5,11 @@ import numpy as np
 
 import paddle_tpu as paddle
 import paddle_tpu.incubate.multiprocessing as pmp
+from proc_utils import proc_timeout
 
 
-def _child(q_in, q_out):
-    t = q_in.get(timeout=30)
+def _child(q_in, q_out, timeout):
+    t = q_in.get(timeout=timeout)
     # child sees the payload and sends a derived tensor back through shm
     import paddle_tpu as paddle
 
@@ -19,15 +20,19 @@ class TestSharedMemoryTensor:
     def test_queue_roundtrip(self):
         ctx = pmp.get_context("spawn")
         q_in, q_out = ctx.Queue(), ctx.Queue()
-        p = ctx.Process(target=_child, args=(q_in, q_out))
+        # the child-side get budget rides the same load knob as the
+        # parent-side waits (passed by value: the child can't re-derive
+        # an env-overridden factor after spawn re-imports)
+        p = ctx.Process(target=_child,
+                        args=(q_in, q_out, proc_timeout(60)))
         p.start()
         try:
             src = np.arange(12, dtype=np.float32).reshape(3, 4)
             q_in.put(paddle.to_tensor(src))
-            back = q_out.get(timeout=60)
+            back = q_out.get(timeout=proc_timeout(60))
             np.testing.assert_allclose(np.asarray(back.numpy()), src * 2.0)
         finally:
-            p.join(timeout=30)
+            p.join(timeout=proc_timeout(30))
             if p.is_alive():
                 p.terminate()
 

@@ -74,6 +74,9 @@ ROLE_SCRIPT = textwrap.dedent("""
 
 
 def test_ps_service_two_servers_two_workers(tmp_path):
+    from proc_utils import proc_timeout, shed_parent_memory
+
+    shed_parent_memory()
     port = _free_port()
     script = tmp_path / "role.py"
     script.write_text(ROLE_SCRIPT)
@@ -92,6 +95,14 @@ def test_ps_service_two_servers_two_workers(tmp_path):
                 "PADDLE_TRAINER_ID": str(i),
                 "PADDLE_PSERVERS_IP_PORT_LIST": servers,
                 "PADDLE_TRAINER_ENDPOINTS": workers,
+                # children need no device mesh: rewrite only the suite's
+                # device-count flag (preserving any other XLA flags) so
+                # each of the 4 interpreters inits one cheap CPU device
+                "XLA_FLAGS": " ".join(
+                    [f for f in env.get("XLA_FLAGS", "").split()
+                     if not f.startswith(
+                         "--xla_force_host_platform_device_count")]
+                    + ["--xla_force_host_platform_device_count=1"]),
             })
             procs.append(subprocess.Popen(
                 [sys.executable, str(script)], env=env,
@@ -103,7 +114,7 @@ def test_ps_service_two_servers_two_workers(tmp_path):
             # generous: the whole suite shares ONE core, and four
             # fresh interpreters importing jax under that load can
             # take minutes before the barriers even form
-            out, _ = p.communicate(timeout=600)
+            out, _ = p.communicate(timeout=proc_timeout(600))
             outs.append(out)
             assert p.returncode == 0, out[-800:]
         joined = "\n".join(outs)

@@ -94,16 +94,19 @@ def test_rpc_two_processes(tmp_path):
     env.setdefault("JAX_PLATFORMS", "cpu")
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    from proc_utils import proc_timeout, shed_parent_memory
+
+    shed_parent_memory()
     peer = subprocess.Popen([sys.executable, str(script)], env=env)
     try:
         rpc.init_rpc("worker0", rank=0, world_size=2,
                      master_endpoint=f"127.0.0.1:{port}")
         assert rpc.rpc_sync("worker1", operator.add, args=(21, 21),
-                            timeout=30) == 42
+                            timeout=proc_timeout(30)) == 42
         infos = rpc.get_all_worker_infos()
         assert [i.name for i in infos] == ["worker0", "worker1"]
         rpc.shutdown()
-        assert peer.wait(timeout=30) == 0
+        assert peer.wait(timeout=proc_timeout(30)) == 0
     finally:
         if peer.poll() is None:
             peer.kill()
