@@ -105,6 +105,22 @@ def trace_state_clean():
     return _trace_state_clean()
 
 
+def refuse_static(op_name, hint):
+    """Loud static-mode refusal for eager-only ops whose OUTPUT SHAPE
+    depends on runtime values (reference *_kernel with dynamic out dims:
+    masked_select, nonzero, unique, bincount, ...). XLA executables need
+    static shapes, so these cannot be recorded in a Program; without
+    this guard they either leak a cryptic trace error or — worse —
+    silently bake a constant computed from the placeholder aval. Call
+    at the top of each such op. The message always contains 'static
+    Program' (tests key the contract on that phrase)."""
+    if static_recorder is not None:
+        raise NotImplementedError(
+            f"{op_name} has a data-dependent output shape and cannot be "
+            f"recorded in a static Program (XLA requires static shapes). "
+            f"Compute it in dygraph, or {hint}.")
+
+
 def unwrap(x):
     return x._data if isinstance(x, Tensor) else x
 

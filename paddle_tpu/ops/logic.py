@@ -56,8 +56,11 @@ def equal_all(x, y, name=None):
 
 
 def is_empty(x, name=None):
-    _note('is_empty')
-    return Tensor(np.asarray(x.size == 0))
+    # routed through forward() so static mode records a (constant) var —
+    # x.size is static metadata, but a bare Tensor return would be
+    # unfetchable from a Program (round-5: structural skip closed)
+    return forward(lambda a: jnp.asarray(a.size == 0), (x,),
+                   name="is_empty", nondiff=True)
 
 
 def is_tensor(x):

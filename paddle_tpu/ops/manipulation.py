@@ -14,7 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dtype as dtypes
-from ..core.dispatch import forward, unwrap
+from ..core.dispatch import forward, refuse_static, unwrap
 from ..core.dispatch import note as _note
 from ..core.tensor import Tensor
 
@@ -269,7 +269,10 @@ def take(x, index, mode="raise", name=None):
 
 def masked_select(x, mask, name=None):
     _note('masked_select')
-    # dynamic output shape: eager-only (reference kernel masked_select_kernel)
+    # data-dependent output length (mask popcount) — reference
+    # masked_select_kernel; eager-only by design
+    refuse_static("masked_select", "use paddle.where / multiplication "
+                  "by the mask for a static-shaped equivalent")
     return Tensor(np.asarray(unwrap(x))[np.asarray(unwrap(mask)).astype(bool)])
 
 
@@ -300,6 +303,10 @@ def where(condition, x=None, y=None, name=None):
 
 def nonzero(x, as_tuple=False, name=None):
     _note('nonzero')
+    # data-dependent output length; without the guard, static recording
+    # would silently bake a CONSTANT computed from the placeholder aval
+    refuse_static("nonzero", "for a fixed-size variant use paddle.topk "
+                  "over a boolean mask cast to int")
     idx = np.nonzero(np.asarray(unwrap(x)))
     if as_tuple:
         return tuple(Tensor(i.astype(np.int64)) for i in idx)
@@ -404,6 +411,8 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
            axis=None, dtype="int64", name=None):
     _note('unique')
     # dynamic shape → eager-only, like reference unique_kernel
+    refuse_static("unique", "sort + compare-adjacent gives a "
+                  "static-shaped duplicate mask")
     arr = np.asarray(unwrap(x))
     out = np.unique(arr, return_index=return_index, return_inverse=return_inverse,
                     return_counts=return_counts, axis=axis)
@@ -415,6 +424,8 @@ def unique(x, return_index=False, return_inverse=False, return_counts=False,
 def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
                        dtype="int64", name=None):
     _note('unique_consecutive')
+    refuse_static("unique_consecutive", "compare-adjacent gives a "
+                  "static-shaped run-boundary mask")
     arr = np.asarray(unwrap(x)).reshape(-1) if axis is None else np.asarray(unwrap(x))
     keep = np.ones(arr.shape[0], bool)
     keep[1:] = np.any(arr[1:] != arr[:-1], axis=tuple(range(1, arr.ndim))) \
@@ -567,8 +578,10 @@ def tolist(x):
 
 
 def numel(x, name=None):
-    _note('numel')
-    return Tensor(np.asarray(x.size, dtype=np.int64))
+    # routed through forward() so static mode records a (constant) var;
+    # the element count itself is static shape metadata
+    return forward(lambda a: jnp.asarray(a.size, jnp.int64), (x,),
+                   name="numel", nondiff=True)
 
 
 def shape(x):

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 
 from ..core import dtype as dtypes
-from ..core.dispatch import forward
+from ..core.dispatch import forward, refuse_static
 from ..core.tensor import Tensor
 
 __all__ = []
@@ -395,7 +395,16 @@ def cast(x, dtype):
 
 @_export
 def increment(x, value=1.0, name=None):
-    return x._rebind(forward(lambda a: a + value, (x,), name="increment"))
+    res = forward(lambda a: a + value, (x,), name="increment")
+    from ..core import dispatch as _dispatch
+
+    if _dispatch.static_recorder is not None:
+        # static mode: Variables are immutable program nodes (their _data
+        # setter is a no-op), so in-place rebinding cannot work — return
+        # the recorded output var instead (SSA form of the reference's
+        # in-place increment_op)
+        return res
+    return x._rebind(res)
 
 
 @_export
@@ -553,6 +562,11 @@ def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
 
 @_export
 def bincount(x, weights=None, minlength=0, name=None):
+    # output length = max(x)+1, a runtime VALUE (reference
+    # bincount_kernel) — eager-only
+    refuse_static("bincount", "build a fixed-width histogram with "
+                  "scatter_add over a preallocated zeros(minlength) "
+                  "tensor")
     xv = _as_input(x)
     n = int(np.asarray((xv._data if isinstance(xv, Tensor) else xv).max()
                        ) + 1) if (xv._data if isinstance(xv, Tensor)

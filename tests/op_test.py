@@ -117,6 +117,32 @@ def check_output_dtypes(op_fn, np_fn, inputs, attrs=None,
                 err_msg=f"dtype={dt}")
 
 
+def check_static_refusal(op_fn, inputs, attrs=None):
+    """For dygraph-only ops (data-dependent output shapes): the op must
+    run eagerly AND refuse static recording with a loud, actionable
+    NotImplementedError — never leak a cryptic trace error."""
+    import pytest
+
+    attrs = attrs or {}
+    tensors = [paddle.to_tensor(np.asarray(a)) for a in inputs]
+    with paddle.no_grad():
+        op_fn(*tensors, **attrs)  # eager side must work
+    paddle.enable_static()
+    try:
+        prog = paddle.static.Program()
+        with paddle.static.program_guard(prog):
+            feeds = []
+            for i, a in enumerate(inputs):
+                a = np.asarray(a)
+                feeds.append(paddle.static.data(
+                    f"in{i}", list(a.shape), str(a.dtype)))
+            with pytest.raises(NotImplementedError,
+                               match="static Program"):
+                op_fn(*feeds, **attrs)
+    finally:
+        paddle.disable_static()
+
+
 def check_dygraph_static(op_fn, inputs, attrs=None, rtol=1e-5, atol=1e-6):
     """Run the op eagerly AND as a recorded static Program through the
     Executor; both must agree (reference dual-mode check,
