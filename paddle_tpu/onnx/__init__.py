@@ -1,26 +1,75 @@
-"""paddle.onnx (reference `python/paddle/onnx/export.py` — a thin wrapper
-over the external paddle2onnx converter). The TPU-native deployment format
-is StableHLO (`paddle.jit.save` → `.pdmodel`), which onnxruntime does not
-consume; ONNX export therefore requires an external converter exactly as
-the reference does."""
+"""paddle.onnx — ONNX model export.
+
+Reference surface: `python/paddle/onnx/export.py` (a thin wrapper over
+the external paddle2onnx converter, walking a Program op-by-op). The
+TPU-native redesign needs no external converter: the model's forward is
+traced to a jaxpr — the same IR behind `paddle.jit.save`'s StableHLO
+artifact — and each primitive is mapped to standard-opset ONNX nodes,
+serialized by a self-contained protobuf writer (`_proto.py`). Coverage
+is the Predictor-supported eager subset (dense / conv / norm /
+activation / attention-style compute, static shapes); anything outside
+it raises naming the offending primitive.
+"""
 from __future__ import annotations
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
-    """Export a Layer to ONNX. Requires the `onnx` package (not bundled in
-    this environment, matching the reference's external paddle2onnx
-    dependency). The portable alternative is `paddle.jit.save`, whose
-    StableHLO artifact any XLA runtime executes."""
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Export a Layer (or callable) to `<path>.onnx`.
+
+    input_spec: list of example inputs — Tensors, numpy arrays, or
+    static.InputSpec with fully static shapes (ONNX export specializes
+    shapes exactly like `paddle.jit.save`'s non-symbolic path).
+    Returns the written file path.
+    """
+    from ..core import autograd
+    from ..core.tensor import Tensor
+    from ._export import export_traced
+
+    if input_spec is None:
+        raise ValueError(
+            "paddle.onnx.export needs input_spec: a list of example "
+            "inputs (Tensors / numpy arrays / static.InputSpec with "
+            "static shapes)")
+
+    arrays = []
+    for spec in input_spec:
+        if isinstance(spec, Tensor):
+            arrays.append(np.asarray(spec.numpy()))
+        elif isinstance(spec, np.ndarray):
+            arrays.append(spec)
+        elif hasattr(spec, "shape") and hasattr(spec, "dtype"):
+            shape = list(spec.shape)
+            if any(s in (None, -1) for s in shape):
+                raise ValueError(
+                    "paddle.onnx.export requires fully static shapes in "
+                    f"input_spec (got {shape}); pass a concrete example "
+                    "batch instead")
+            from ..core import dtype as dtypes
+
+            arrays.append(np.zeros(shape, dtypes.convert_dtype(spec.dtype)))
+        else:
+            arrays.append(np.asarray(spec))
+
+    fwd = layer.forward if hasattr(layer, "forward") else layer
+    was_training = bool(getattr(layer, "training", False))
+    if hasattr(layer, "eval"):
+        layer.eval()
     try:
-        import onnx  # noqa: F401
-    except ImportError as exc:
-        raise ImportError(
-            "paddle.onnx.export needs the 'onnx' package, which is not "
-            "installed in this environment. Use paddle.jit.save(layer, "
-            "path, input_spec) for the StableHLO deployment artifact "
-            "instead.") from exc
-    raise NotImplementedError(
-        "ONNX conversion from StableHLO artifacts is not implemented; "
-        "use paddle.jit.save / paddle.inference for deployment.")
+        def fn(*xs):
+            with autograd._scoped(False):
+                out = fwd(*[Tensor(x) for x in xs])
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            res = tuple(o._data if isinstance(o, Tensor) else o
+                        for o in outs)
+            return res if len(res) > 1 else res[0]
+
+        target = path if path.endswith(".onnx") else path + ".onnx"
+        return export_traced(fn, arrays, target,
+                             opset_version=opset_version)
+    finally:
+        if was_training and hasattr(layer, "train"):
+            layer.train()

@@ -1,0 +1,462 @@
+"""jaxpr -> ONNX GraphProto conversion.
+
+The reference delegates ONNX export to paddle2onnx, which walks a Paddle
+Program op-by-op (`python/paddle/onnx/export.py:1`). The TPU-native
+equivalent walks the model's traced jaxpr — the same IR every other
+export path here uses (StableHLO via `paddle.jit.save`) — and maps each
+primitive to standard-opset ONNX nodes. Coverage is the Predictor-
+supported eager subset: dense/conv/norm/activation/attention-style
+compute with static shapes. Unsupported primitives raise with the
+primitive name rather than emitting a broken graph.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+from . import _proto as P
+
+_CALL_PRIMS = {"jit", "pjit", "closed_call", "core_call", "custom_jvp_call",
+               "custom_vjp_call", "custom_vjp_call_jaxpr", "remat",
+               "checkpoint", "remat2", "custom_jvp_call_jaxpr"}
+
+
+def _inner_jaxpr(eqn):
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        sub = eqn.params.get(key)
+        if sub is None:
+            continue
+        if hasattr(sub, "jaxpr"):  # ClosedJaxpr
+            return sub.jaxpr, sub.consts
+        return sub, []
+    raise NotImplementedError(
+        f"ONNX export: call primitive {eqn.primitive.name} carries no "
+        f"inner jaxpr (params: {list(eqn.params)})")
+
+
+class _Converter:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self.names = {}          # jax Var -> onnx value name
+        self._const_cache = {}   # (dtype, shape, bytes) -> initializer name
+        self._uid = 0
+
+    # ---------------------------------------------------------- name plumbing
+    def _fresh(self, hint="v"):
+        self._uid += 1
+        return f"{hint}_{self._uid}"
+
+    def name_of(self, atom):
+        from jax._src.core import Literal
+
+        if isinstance(atom, Literal):
+            return self.const(np.asarray(atom.val))
+        if atom not in self.names:
+            self.names[atom] = self._fresh()
+        return self.names[atom]
+
+    def const(self, arr, hint="c"):
+        # float64 stays float64: this package enables jax x64 by default,
+        # so f64 avals are real and the graph's I/O declares DOUBLE —
+        # downcasting initializers would type-mismatch every consumer.
+        # Identical constants dedup to one initializer (shape vectors,
+        # epsilons and iota tables repeat once per transformer block).
+        arr = np.ascontiguousarray(np.asarray(arr))
+        key = (str(arr.dtype), arr.shape, arr.tobytes())
+        cached = self._const_cache.get(key)
+        if cached is not None:
+            return cached
+        name = self._fresh(hint)
+        self.initializers.append(P.tensor_proto(name, arr))
+        self._const_cache[key] = name
+        return name
+
+    def emit(self, op_type, inputs, n_out=1, **attrs):
+        outs = [self._fresh(op_type.lower()) for _ in range(n_out)]
+        self.nodes.append(P.node(op_type, inputs, outs,
+                                 name=outs[0] + "_node", **attrs))
+        return outs if n_out > 1 else outs[0]
+
+    def bind_out(self, var, name):
+        self.names[var] = name
+
+    # ------------------------------------------------------------- conversion
+    def convert(self, jaxpr, consts):
+        for var, cval in zip(jaxpr.constvars, consts):
+            self.names[var] = self.const(np.asarray(cval), hint="w")
+        for eqn in jaxpr.eqns:
+            prim = eqn.primitive.name
+            if prim in _CALL_PRIMS:
+                inner, inner_consts = _inner_jaxpr(eqn)
+                # some call prims pass consts as leading invars; align
+                # the inner invars with the TRAILING outer invars
+                offset = len(eqn.invars) - len(inner.invars)
+                for ivar, outer in zip(inner.invars, eqn.invars[offset:]):
+                    self.names[ivar] = self.name_of(outer)
+                self.convert(inner, inner_consts)
+                for ovar, inner_out in zip(eqn.outvars, inner.outvars):
+                    self.bind_out(ovar, self.name_of(inner_out))
+                continue
+            handler = _HANDLERS.get(prim)
+            if handler is None:
+                raise NotImplementedError(
+                    f"ONNX export: primitive '{prim}' is outside the "
+                    "supported subset (dense/conv/norm/activation "
+                    "compute); simplify the model or export via "
+                    "paddle.jit.save (StableHLO)")
+            handler(self, eqn)
+
+    def in_names(self, eqn):
+        return [self.name_of(v) for v in eqn.invars]
+
+
+# ------------------------------------------------------------------- handlers
+def _simple(op_type):
+    def h(cv, eqn):
+        cv.bind_out(eqn.outvars[0], cv.emit(op_type, cv.in_names(eqn)))
+    return h
+
+
+def _h_rem(cv, eqn):
+    # fmod=1 matches lax.rem exactly (truncated, sign of dividend) and
+    # is the only Mod form ONNX allows for floats
+    cv.bind_out(eqn.outvars[0],
+                cv.emit("Mod", cv.in_names(eqn), fmod=1))
+
+
+def _h_square(cv, eqn):
+    a = cv.name_of(eqn.invars[0])
+    cv.bind_out(eqn.outvars[0], cv.emit("Mul", [a, a]))
+
+
+def _h_rsqrt(cv, eqn):
+    s = cv.emit("Sqrt", cv.in_names(eqn))
+    cv.bind_out(eqn.outvars[0], cv.emit("Reciprocal", [s]))
+
+
+def _h_erfc(cv, eqn):
+    e = cv.emit("Erf", cv.in_names(eqn))
+    one = cv.const(np.asarray(1.0, eqn.invars[0].aval.dtype))
+    cv.bind_out(eqn.outvars[0], cv.emit("Sub", [one, e]))
+
+
+def _h_logistic(cv, eqn):
+    cv.bind_out(eqn.outvars[0], cv.emit("Sigmoid", cv.in_names(eqn)))
+
+
+def _h_integer_pow(cv, eqn):
+    y = eqn.params["y"]
+    a = cv.name_of(eqn.invars[0])
+    exp = cv.const(np.asarray(y, eqn.invars[0].aval.dtype))
+    cv.bind_out(eqn.outvars[0], cv.emit("Pow", [a, exp]))
+
+
+def _h_select_n(cv, eqn):
+    if len(eqn.invars) != 3:
+        raise NotImplementedError("ONNX export: select_n with >2 cases")
+    pred, f_case, t_case = (cv.name_of(v) for v in eqn.invars)
+    cv.bind_out(eqn.outvars[0], cv.emit("Where", [pred, t_case, f_case]))
+
+
+def _h_cast(cv, eqn):
+    to = P.onnx_dtype(eqn.params["new_dtype"])
+    cv.bind_out(eqn.outvars[0],
+                cv.emit("Cast", cv.in_names(eqn), to=to))
+
+
+def _h_reshape(cv, eqn):
+    if eqn.params.get("dimensions") is not None:
+        raise NotImplementedError("ONNX export: reshape with dimensions")
+    shape = cv.const(np.asarray(eqn.params["new_sizes"], np.int64))
+    cv.bind_out(eqn.outvars[0],
+                cv.emit("Reshape", cv.in_names(eqn) + [shape]))
+
+
+def _h_transpose(cv, eqn):
+    perm = [int(p) for p in eqn.params["permutation"]]
+    cv.bind_out(eqn.outvars[0],
+                cv.emit("Transpose", cv.in_names(eqn), perm=perm))
+
+
+def _h_concatenate(cv, eqn):
+    cv.bind_out(eqn.outvars[0],
+                cv.emit("Concat", cv.in_names(eqn),
+                        axis=int(eqn.params["dimension"])))
+
+
+def _h_broadcast_in_dim(cv, eqn):
+    shape = [int(s) for s in eqn.params["shape"]]
+    bdims = [int(d) for d in eqn.params["broadcast_dimensions"]]
+    a = cv.name_of(eqn.invars[0])
+    # step 1: reshape so each source dim sits at its mapped position
+    interim = [1] * len(shape)
+    for src, dst in enumerate(bdims):
+        interim[dst] = int(eqn.invars[0].aval.shape[src])
+    if list(eqn.invars[0].aval.shape) != interim:
+        rs = cv.const(np.asarray(interim, np.int64))
+        a = cv.emit("Reshape", [a, rs])
+    # step 2: expand to the broadcast target
+    if interim != shape:
+        ex = cv.const(np.asarray(shape, np.int64))
+        a = cv.emit("Expand", [a, ex])
+    cv.bind_out(eqn.outvars[0], a)
+
+
+def _h_reduce(op_type, axes_as_input):
+    def h(cv, eqn):
+        axes = [int(a) for a in eqn.params["axes"]]
+        ins = cv.in_names(eqn)
+        if axes_as_input:  # ReduceSum takes axes as input from opset 13
+            ins = ins + [cv.const(np.asarray(axes, np.int64))]
+            out = cv.emit(op_type, ins, keepdims=0)
+        else:              # ReduceMax/Min keep the attribute until 18
+            out = cv.emit(op_type, ins, axes=axes, keepdims=0)
+        cv.bind_out(eqn.outvars[0], out)
+    return h
+
+
+def _h_dot_general(cv, eqn):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars
+    l_shape = [int(s) for s in lhs.aval.shape]
+    r_shape = [int(s) for s in rhs.aval.shape]
+    lc, rc, lb, rb = map(lambda t: [int(x) for x in t], (lc, rc, lb, rb))
+    l_free = [i for i in range(len(l_shape)) if i not in lc + lb]
+    r_free = [i for i in range(len(r_shape)) if i not in rc + rb]
+
+    a, b = cv.name_of(lhs), cv.name_of(rhs)
+    # canonicalize: lhs -> [batch..., M, K], rhs -> [batch..., K, N]
+    l_perm = lb + l_free + lc
+    r_perm = rb + rc + r_free
+    if l_perm != list(range(len(l_shape))):
+        a = cv.emit("Transpose", [a], perm=l_perm)
+    if r_perm != list(range(len(r_shape))):
+        b = cv.emit("Transpose", [b], perm=r_perm)
+    batch = [l_shape[i] for i in lb]
+    M = int(np.prod([l_shape[i] for i in l_free], dtype=np.int64)) \
+        if l_free else 1
+    K = int(np.prod([l_shape[i] for i in lc], dtype=np.int64)) if lc else 1
+    N = int(np.prod([r_shape[i] for i in r_free], dtype=np.int64)) \
+        if r_free else 1
+    la = batch + [M, K]
+    rb_shape = batch + [K, N]
+    if la != [l_shape[i] for i in l_perm]:
+        a = cv.emit("Reshape", [a, cv.const(np.asarray(la, np.int64))])
+    if rb_shape != [r_shape[i] for i in r_perm]:
+        b = cv.emit("Reshape", [b, cv.const(np.asarray(rb_shape, np.int64))])
+    out = cv.emit("MatMul", [a, b])
+    final = batch + [l_shape[i] for i in l_free] + \
+        [r_shape[i] for i in r_free]
+    if final != batch + [M, N]:
+        out = cv.emit("Reshape",
+                      [out, cv.const(np.asarray(final, np.int64))])
+    cv.bind_out(eqn.outvars[0], out)
+
+
+def _h_conv(cv, eqn):
+    dn = eqn.params["dimension_numbers"]
+    nd = len(eqn.invars[0].aval.shape)
+    id_spec = tuple(range(nd))
+    if (tuple(dn.lhs_spec) != id_spec or tuple(dn.rhs_spec) != id_spec or
+            tuple(dn.out_spec) != id_spec):
+        raise NotImplementedError(
+            "ONNX export: conv supports NCHW/OIHW layouts only "
+            f"(got {dn})")
+    if any(d != 1 for d in eqn.params["lhs_dilation"]):
+        raise NotImplementedError(
+            "ONNX export: transposed conv (lhs_dilation>1) unsupported")
+    pads_lo = [int(p[0]) for p in eqn.params["padding"]]
+    pads_hi = [int(p[1]) for p in eqn.params["padding"]]
+    cv.bind_out(eqn.outvars[0], cv.emit(
+        "Conv", cv.in_names(eqn),
+        strides=[int(s) for s in eqn.params["window_strides"]],
+        dilations=[int(d) for d in eqn.params["rhs_dilation"]],
+        group=int(eqn.params["feature_group_count"]),
+        pads=pads_lo + pads_hi))
+
+
+def _h_reduce_window_max(cv, eqn):
+    wd = [int(w) for w in eqn.params["window_dimensions"]]
+    ws = [int(s) for s in eqn.params["window_strides"]]
+    pad = [(int(l), int(h)) for l, h in eqn.params["padding"]]
+    if wd[:2] != [1, 1] or ws[:2] != [1, 1] or pad[0] != (0, 0) or \
+            pad[1] != (0, 0):
+        raise NotImplementedError(
+            "ONNX export: reduce_window_max supports NCHW spatial "
+            "pooling only")
+    if any(d != 1 for d in eqn.params.get("base_dilation", ()) or []) or \
+            any(d != 1 for d in eqn.params.get("window_dilation", ()) or []):
+        raise NotImplementedError("ONNX export: dilated pooling")
+    cv.bind_out(eqn.outvars[0], cv.emit(
+        "MaxPool", cv.in_names(eqn), kernel_shape=wd[2:],
+        strides=ws[2:],
+        pads=[p[0] for p in pad[2:]] + [p[1] for p in pad[2:]]))
+
+
+def _h_iota(cv, eqn):
+    shape = [int(s) for s in eqn.params["shape"]]
+    dim = int(eqn.params["dimension"])
+    dt = np.dtype(eqn.params["dtype"])
+    n = shape[dim]
+    arr = np.arange(n, dtype=dt).reshape(
+        [n if i == dim else 1 for i in range(len(shape))])
+    arr = np.broadcast_to(arr, shape).copy()
+    cv.bind_out(eqn.outvars[0], cv.const(arr, hint="iota"))
+
+
+def _h_pad(cv, eqn):
+    cfg = [(int(l), int(h), int(i)) for l, h, i in eqn.params["padding_config"]]
+    if any(i != 0 for _, _, i in cfg):
+        raise NotImplementedError("ONNX export: interior padding")
+    operand, value = (cv.name_of(v) for v in eqn.invars)
+    pads = cv.const(np.asarray([c[0] for c in cfg] + [c[1] for c in cfg],
+                               np.int64))
+    cv.bind_out(eqn.outvars[0], cv.emit("Pad", [operand, pads, value]))
+
+
+def _h_slice(cv, eqn):
+    starts = [int(s) for s in eqn.params["start_indices"]]
+    ends = [int(s) for s in eqn.params["limit_indices"]]
+    strides = eqn.params.get("strides")
+    axes = list(range(len(starts)))
+    ins = cv.in_names(eqn) + [cv.const(np.asarray(starts, np.int64)),
+                              cv.const(np.asarray(ends, np.int64)),
+                              cv.const(np.asarray(axes, np.int64))]
+    if strides is not None:
+        ins.append(cv.const(np.asarray([int(s) for s in strides], np.int64)))
+    cv.bind_out(eqn.outvars[0], cv.emit("Slice", ins))
+
+
+def _h_squeeze(cv, eqn):
+    out_shape = [int(s) for s in eqn.outvars[0].aval.shape]
+    shape = cv.const(np.asarray(out_shape, np.int64))
+    cv.bind_out(eqn.outvars[0],
+                cv.emit("Reshape", cv.in_names(eqn) + [shape]))
+
+
+def _h_split(cv, eqn):
+    sizes = [int(s) for s in eqn.params["sizes"]]
+    axis = int(eqn.params["axis"])
+    ins = cv.in_names(eqn) + [cv.const(np.asarray(sizes, np.int64))]
+    outs = cv.emit("Split", ins, n_out=len(sizes), axis=axis)
+    outs = outs if isinstance(outs, list) else [outs]
+    for var, name in zip(eqn.outvars, outs):
+        cv.bind_out(var, name)
+
+
+def _h_gather(cv, eqn):
+    """lax.gather in its jnp.take form -> ONNX Gather(axis).
+
+    take(operand, idx, axis=k) traces to gather with start_index_map ==
+    collapsed_slice_dims == (k,), full slice_sizes except 1 at k, and a
+    trailing size-1 index-vector dim on the indices. Anything more
+    general (multi-dim starts, batching dims) is refused by name."""
+    dn = eqn.params["dimension_numbers"]
+    operand, indices = eqn.invars
+    o_shape = [int(s) for s in operand.aval.shape]
+    slice_sizes = [int(s) for s in eqn.params["slice_sizes"]]
+    simple = (len(dn.start_index_map) == 1 and
+              tuple(dn.collapsed_slice_dims) == tuple(dn.start_index_map)
+              and not getattr(dn, "operand_batching_dims", ()) and
+              not getattr(dn, "start_indices_batching_dims", ()))
+    k = int(dn.start_index_map[0]) if simple else -1
+    expect = list(o_shape)
+    if simple:
+        expect[k] = 1
+    if not simple or slice_sizes != expect:
+        raise NotImplementedError(
+            "ONNX export: general lax.gather (only the jnp.take / "
+            "embedding-lookup form maps to ONNX Gather)")
+    idx = cv.name_of(indices)
+    i_shape = [int(s) for s in indices.aval.shape]
+    if i_shape and i_shape[-1] == 1:  # drop the index-vector dim
+        idx = cv.emit("Reshape",
+                      [idx, cv.const(np.asarray(i_shape[:-1], np.int64))])
+    cv.bind_out(eqn.outvars[0], cv.emit("Gather", [cv.name_of(operand),
+                                                   idx], axis=k))
+
+
+def _h_argminmax(op_type):
+    def h(cv, eqn):
+        axes = eqn.params["axes"]
+        out = cv.emit(op_type, cv.in_names(eqn), axis=int(axes[0]),
+                      keepdims=0)
+        want = P.onnx_dtype(eqn.params["index_dtype"])
+        if want != P.INT64:  # ArgMax/ArgMin emit int64
+            out = cv.emit("Cast", [out], to=want)
+        cv.bind_out(eqn.outvars[0], out)
+    return h
+
+
+_HANDLERS = {
+    "add": _simple("Add"), "sub": _simple("Sub"), "mul": _simple("Mul"),
+    "div": _simple("Div"), "max": _simple("Max"), "min": _simple("Min"),
+    "pow": _simple("Pow"),
+    "rem": _h_rem,
+    "neg": _simple("Neg"), "exp": _simple("Exp"), "log": _simple("Log"),
+    "sqrt": _simple("Sqrt"), "abs": _simple("Abs"), "sign": _simple("Sign"),
+    "floor": _simple("Floor"), "ceil": _simple("Ceil"),
+    "round": _simple("Round"), "tanh": _simple("Tanh"),
+    "sin": _simple("Sin"), "cos": _simple("Cos"),
+    "erf": _simple("Erf"), "erfc": _h_erfc,
+    "logistic": _h_logistic, "rsqrt": _h_rsqrt, "square": _h_square,
+    "integer_pow": _h_integer_pow,
+    "gt": _simple("Greater"), "lt": _simple("Less"), "eq": _simple("Equal"),
+    "ge": _simple("GreaterOrEqual"), "le": _simple("LessOrEqual"),
+    "and": _simple("And"), "or": _simple("Or"), "not": _simple("Not"),
+    "select_n": _h_select_n,
+    "convert_element_type": _h_cast,
+    "copy": _simple("Identity"), "stop_gradient": _simple("Identity"),
+    "device_put": _simple("Identity"), "name": _simple("Identity"),
+    "reshape": _h_reshape, "transpose": _h_transpose,
+    "concatenate": _h_concatenate, "broadcast_in_dim": _h_broadcast_in_dim,
+    "reduce_sum": _h_reduce("ReduceSum", axes_as_input=True),
+    "reduce_max": _h_reduce("ReduceMax", axes_as_input=False),
+    "reduce_min": _h_reduce("ReduceMin", axes_as_input=False),
+    "argmax": _h_argminmax("ArgMax"), "argmin": _h_argminmax("ArgMin"),
+    "dot_general": _h_dot_general,
+    "conv_general_dilated": _h_conv,
+    "reduce_window_max": _h_reduce_window_max,
+    "iota": _h_iota, "pad": _h_pad, "slice": _h_slice,
+    "gather": _h_gather, "split": _h_split,
+    "squeeze": _h_squeeze, "expand_dims": _h_squeeze,  # static reshapes
+}
+
+
+# ------------------------------------------------------------------ public
+def export_traced(fn, example_arrays, path, opset_version=13,
+                  input_names=None, output_names=None):
+    """Trace `fn` over example arrays and write an ONNX ModelProto."""
+    if not 13 <= int(opset_version) <= 17:
+        # nodes are emitted in opset-13 form (ReduceSum/Split/Slice take
+        # inputs, ReduceMax/Min still take the axes attribute); 18+
+        # drops that attribute and <13 predates the inputs form
+        raise ValueError(
+            f"opset_version must be in [13, 17] (got {opset_version}): "
+            "nodes are emitted in opset-13 form")
+    closed = jax.make_jaxpr(fn)(*example_arrays)
+    jaxpr = closed.jaxpr
+
+    cv = _Converter()
+    g_inputs = []
+    names = input_names or [f"input_{i}" for i in range(len(jaxpr.invars))]
+    for var, arr, name in zip(jaxpr.invars, example_arrays, names):
+        cv.names[var] = name
+        g_inputs.append(P.value_info(name, arr.shape, arr.dtype))
+    cv.convert(jaxpr, closed.consts)
+
+    g_outputs = []
+    onames = output_names or [f"output_{i}"
+                              for i in range(len(jaxpr.outvars))]
+    for var, name in zip(jaxpr.outvars, onames):
+        # alias the producing value to the declared graph output name
+        cv.nodes.append(P.node("Identity", [cv.name_of(var)], [name]))
+        g_outputs.append(P.value_info(name, var.aval.shape, var.aval.dtype))
+
+    gb = P.graph(cv.nodes, "paddle_tpu_graph", g_inputs, g_outputs,
+                 cv.initializers)
+    blob = P.model(gb, opset_version)
+    with open(path, "wb") as f:
+        f.write(blob)
+    return path

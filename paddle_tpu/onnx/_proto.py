@@ -1,0 +1,163 @@
+"""Self-contained ONNX protobuf serialization (no `onnx` dependency).
+
+The reference's `python/paddle/onnx/export.py` shells out to the external
+paddle2onnx converter; this environment bundles neither it nor the onnx
+package, so the wire format is emitted directly. ONNX models are standard
+proto2 messages (onnx/onnx.proto); the tiny subset of the protobuf wire
+format needed to write them — varints, tagged fields, length-delimited
+submessages — is implemented here by hand. Field numbers follow the
+public onnx.proto schema (IR version 8 era, stable for all of these
+fields since IR v3).
+
+Layout helpers return `bytes`; composition is plain concatenation, which
+is exactly proto's repeated-field semantics.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+# TensorProto.DataType enum (onnx.proto)
+FLOAT, UINT8, INT8, INT32, INT64 = 1, 2, 3, 6, 7
+BOOL, FLOAT16, DOUBLE, BFLOAT16 = 9, 10, 11, 16
+
+_NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT,
+    np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16,
+    np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64,
+    np.dtype(np.int8): INT8,
+    np.dtype(np.uint8): UINT8,
+    np.dtype(np.bool_): BOOL,
+}
+
+
+def onnx_dtype(np_dtype):
+    dt = np.dtype(np_dtype)
+    if str(dt) == "bfloat16":
+        return BFLOAT16
+    try:
+        return _NP_TO_ONNX[dt]
+    except KeyError:
+        raise NotImplementedError(
+            f"ONNX export: unsupported dtype {dt}") from None
+
+
+# ---------------------------------------------------------------- wire format
+def _varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64  # proto int64 two's complement
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def fint(field: int, value: int) -> bytes:
+    """varint-typed field (int32/int64/enum/bool)."""
+    return _tag(field, 0) + _varint(int(value))
+
+
+def ffloat(field: int, value: float) -> bytes:
+    return _tag(field, 5) + np.float32(value).tobytes()
+
+
+def fbytes(field: int, value: bytes) -> bytes:
+    return _tag(field, 2) + _varint(len(value)) + value
+
+
+def fstr(field: int, value: str) -> bytes:
+    return fbytes(field, value.encode("utf-8"))
+
+
+def fmsg(field: int, encoded: bytes) -> bytes:
+    return fbytes(field, encoded)
+
+
+# ------------------------------------------------------------- ONNX messages
+def tensor_proto(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, name=8, raw_data=9."""
+    arr = np.ascontiguousarray(arr)
+    out = b"".join(fint(1, d) for d in arr.shape)
+    out += fint(2, onnx_dtype(arr.dtype))
+    out += fstr(8, name)
+    out += fbytes(9, arr.tobytes())
+    return out
+
+
+def value_info(name: str, shape, np_dtype) -> bytes:
+    """ValueInfoProto name=1, type=2 -> TypeProto.tensor_type=1 ->
+    {elem_type=1, shape=2 -> repeated Dimension{dim_value=1}}."""
+    dims = b"".join(fmsg(1, fint(1, int(d))) for d in shape)
+    shape_p = fmsg(2, dims) if shape else fmsg(2, b"")
+    tensor_t = fint(1, onnx_dtype(np_dtype)) + shape_p
+    return fstr(1, name) + fmsg(2, fmsg(1, tensor_t))
+
+
+# AttributeProto.AttributeType enum
+A_FLOAT, A_INT, A_STRING, A_TENSOR, A_FLOATS, A_INTS = 1, 2, 3, 4, 6, 7
+
+
+def attr(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    type=20. Type is inferred from the python value."""
+    out = fstr(1, name)
+    if isinstance(value, bool) or isinstance(value, (int, np.integer)):
+        out += fint(3, int(value)) + fint(20, A_INT)
+    elif isinstance(value, float):
+        out += ffloat(2, value) + fint(20, A_FLOAT)
+    elif isinstance(value, str):
+        out += fbytes(4, value.encode()) + fint(20, A_STRING)
+    elif isinstance(value, bytes):
+        out += fbytes(4, value) + fint(20, A_STRING)
+    elif isinstance(value, np.ndarray):
+        out += fmsg(5, tensor_proto(name + "_t", value)) + fint(20, A_TENSOR)
+    elif isinstance(value, (list, tuple)):
+        if value and isinstance(value[0], float):
+            out += b"".join(ffloat(7, v) for v in value) + fint(20, A_FLOATS)
+        else:
+            out += b"".join(fint(8, int(v)) for v in value) + fint(20, A_INTS)
+    else:
+        raise TypeError(f"attr {name}: unsupported value {value!r}")
+    return out
+
+
+def node(op_type: str, inputs, outputs, name="", **attrs) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    out = b"".join(fstr(1, i) for i in inputs)
+    out += b"".join(fstr(2, o) for o in outputs)
+    if name:
+        out += fstr(3, name)
+    out += fstr(4, op_type)
+    out += b"".join(fmsg(5, attr(k, v)) for k, v in sorted(attrs.items()))
+    return out
+
+
+def graph(nodes, name, inputs, outputs, initializers) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    out = b"".join(fmsg(1, n) for n in nodes)
+    out += fstr(2, name)
+    out += b"".join(fmsg(5, t) for t in initializers)
+    out += b"".join(fmsg(11, v) for v in inputs)
+    out += b"".join(fmsg(12, v) for v in outputs)
+    return out
+
+
+def model(graph_bytes: bytes, opset_version: int,
+          producer="paddle_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7,
+    opset_import=8 -> OperatorSetIdProto{domain=1, version=2}."""
+    opset = fstr(1, "") + fint(2, opset_version)
+    return (fint(1, 8)  # IR version 8
+            + fstr(2, producer)
+            + fmsg(7, graph_bytes)
+            + fmsg(8, opset))

@@ -395,16 +395,11 @@ def cast(x, dtype):
 
 @_export
 def increment(x, value=1.0, name=None):
-    res = forward(lambda a: a + value, (x,), name="increment")
-    from ..core import dispatch as _dispatch
-
-    if _dispatch.static_recorder is not None:
-        # static mode: Variables are immutable program nodes (their _data
-        # setter is a no-op), so in-place rebinding cannot work — return
-        # the recorded output var instead (SSA form of the reference's
-        # in-place increment_op)
-        return res
-    return x._rebind(res)
+    # in-place in BOTH modes: eager rebinds the payload; under static
+    # recording Variable._rebind records an SSA alias, so later op
+    # inputs and fetches of x resolve to the incremented var (the
+    # reference increment_op's in-place Block rewrite)
+    return x._rebind(forward(lambda a: a + value, (x,), name="increment"))
 
 
 @_export

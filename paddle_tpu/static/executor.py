@@ -22,7 +22,7 @@ import jax.numpy as jnp
 from ..core import autograd, dispatch
 from ..core.tensor import Parameter, Tensor
 from . import program as prog_mod
-from .program import Program, Variable, global_scope
+from .program import Program, Variable, global_scope, resolve_alias
 
 __all__ = ["Executor"]
 
@@ -31,9 +31,11 @@ def _resolve_fetch(program, fetch_list):
     out = []
     for f in fetch_list or []:
         if isinstance(f, Variable):
-            out.append(f)
+            # in-place rebinds (increment, scatter_, ...) alias the var
+            # to its latest SSA node; fetch the live one
+            out.append(resolve_alias(f))
         elif isinstance(f, str):
-            out.append(program.vars[f])
+            out.append(resolve_alias(program.vars[f]))
         else:
             raise TypeError(f"bad fetch entry {f!r}")
     return out

@@ -115,6 +115,21 @@ class Variable(Tensor):
             "paddle.static.nn.while_loop (compiled to XLA control flow) "
             "instead of if/while on tensor values.")
 
+    def _rebind(self, result):
+        """In-place op (increment, scatter_, reshape_, ...) on a program
+        Variable. Variables are immutable SSA nodes, so true mutation is
+        impossible; instead the new var is recorded as this one's ALIAS —
+        every later op input and Executor fetch resolves through it (the
+        reference's in-place ops rewrite the var in the Block; the alias
+        is the SSA equivalent). Inside a control-flow subtrace the
+        recorder is uninstalled and `result` carries a live traced value:
+        forward it through _replay_value so subsequent reads see it."""
+        if isinstance(result, Variable):
+            self._static_alias = result
+        else:
+            self._replay_value = result._data
+        return self
+
     def numpy(self):
         scope = global_scope()
         if self.name in scope.vars:
@@ -235,12 +250,22 @@ class name_scope:
 
 # -- the recorder hook (installed into core.dispatch) -------------------------
 
+def resolve_alias(v):
+    """Follow in-place rebind aliases (Variable._rebind) to the live var."""
+    while isinstance(v, Variable):
+        nxt = v.__dict__.get("_static_alias")
+        if nxt is None:
+            return v
+        v = nxt
+    return v
+
+
 def _recorder(fn, name, inputs, attrs, nondiff=False):
     prog = _main_program
     in_refs = []
     for x in inputs:
         if isinstance(x, Variable):
-            in_refs.append(x)
+            in_refs.append(resolve_alias(x))
         elif isinstance(x, Parameter) and x._data is not None:
             # dygraph-created Parameter used under static mode: promote to a
             # program parameter once, keyed by object id

@@ -150,3 +150,18 @@ def test_python_bool_on_variable_raises(static_mode):
         with pytest.raises(TypeError, match="cond"):
             if x.sum() > 0:  # data-dependent python branch
                 pass
+
+
+def test_inplace_ops_alias_in_program(static_mode):
+    # statement-style in-place (the reference's increment_op idiom):
+    # later op inputs AND fetches must resolve to the rebound SSA var
+    prog = paddle.static.Program()
+    with paddle.static.program_guard(prog):
+        v = paddle.static.data("v", [1], "float32")
+        paddle.increment(v)
+        paddle.increment(v)          # alias chain depth 2
+        w = v + 10.0                 # downstream op sees the alias
+    exe = paddle.static.Executor()
+    r = exe.run(prog, feed={"v": np.array([2.0], np.float32)},
+                fetch_list=[v, w])
+    assert float(r[0]) == 4.0 and float(r[1]) == 14.0
