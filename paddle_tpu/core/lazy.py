@@ -20,7 +20,9 @@ so correctness never depends on laziness.
 """
 from __future__ import annotations
 
+import itertools
 import threading
+import weakref
 
 import numpy as np
 import jax
@@ -132,8 +134,9 @@ def _infer_avals(fn, key, attrs, inputs, attrs_key):
     in_avals = tuple(_aval_of(i) for i in inputs)
     ck = None
     if key is not None and attrs_key is not None:
+        # np.dtype objects hash fast; str(dtype) was measurable per record
         ck = (key, attrs_key,
-              tuple((a.shape, str(a.dtype)) for a in in_avals))
+              tuple((a.shape, a.dtype) for a in in_avals))
         with _lock:
             hit = _aval_cache.get(ck)
         if hit is not None:
@@ -149,15 +152,20 @@ def _infer_avals(fn, key, attrs, inputs, attrs_key):
     return res
 
 
+# itertools.count is atomic in CPython: unique monotonic serials are the
+# invariant the serial-distance cache key's soundness rests on, and
+# recording is supported from multiple threads (thread-local _state)
+_serial_counter = itertools.count(1)
+
+
 class _Node:
     """One recorded op: fn(*inputs, **attrs) -> n_outputs arrays."""
 
     __slots__ = ("fn", "attrs", "inputs", "name", "avals", "values",
-                 "multi", "key", "attrs_key", "refs")
+                 "multi", "key", "attrs_key", "refs", "serial",
+                 "sig_entry")
 
     def __init__(self, fn, attrs, inputs, name, key, attrs_key):
-        import weakref
-
         self.fn = fn
         self.attrs = attrs
         self.inputs = inputs  # list of LazyArray | concrete array
@@ -168,6 +176,28 @@ class _Node:
                                               attrs_key)
         self.values = None  # tuple of jax.Array once materialized
         self.refs = weakref.WeakSet()  # live LazyArrays viewing this node
+        # Segment-signature entry, precomputed ONCE at record time
+        # (round 5, VERDICT item 6: the per-step Python re-record cost
+        # was dominated by rebuilding the signature structure every
+        # materialization). Node inputs are referenced by serial
+        # DISTANCE (self.serial - input.serial), which is identical
+        # across steady-state iterations of the same loop even though
+        # the node objects are fresh; leaves stay None placeholders.
+        # _signature validates the creation-time pending/leaf split and
+        # falls back to the slow path when an input materialized in
+        # between.
+        self.serial = next(_serial_counter)
+        if key is not None and attrs_key is not None:
+            in_sig = []
+            for inp in inputs:
+                if isinstance(inp, LazyArray) and inp.node.values is None:
+                    in_sig.append((self.serial - inp.node.serial, inp.idx))
+                else:
+                    in_sig.append(None)
+            self.sig_entry = (key, name, attrs_key, tuple(in_sig),
+                              len(self.avals))
+        else:
+            self.sig_entry = None
 
 
 def _aval_of(x):
@@ -185,8 +215,6 @@ class LazyArray:
     __slots__ = ("node", "idx", "owners", "__weakref__")
 
     def __init__(self, node, idx=0):
-        import weakref
-
         self.node = node
         self.idx = idx
         # Tensors holding this payload, keyed by id: a WeakSet would hash
@@ -320,32 +348,52 @@ def _collect(root):
 
 
 def _signature(topo):
-    """Hashable structure key + flat leaf list for the segment."""
-    index = {id(n): i for i, n in enumerate(topo)}
+    """Hashable structure key + flat leaf list for the segment.
+
+    Fast path (round 5): each node's signature entry was precomputed at
+    record time with inputs referenced by serial DISTANCE — identical
+    across iterations of a steady-state loop — so the per-step work here
+    is validation plus leaf collection, with no index dicts or tuple
+    rebuilding. One systematic difference between record time and
+    signature time is EXPECTED: nodes recorded before one
+    materialization but consumed by the next (a train loop's backward
+    and optimizer-update nodes) see their record-time-pending inputs
+    become materialized leaves — stably so, every iteration. That flip
+    is encoded as a per-node drift bitmask folded into the key rather
+    than treated as uncacheable. Only a still-pending ref whose
+    distance changed (a genuinely different wiring) degrades to
+    key=None: the segment still runs, uncached."""
     leaves = []
     sig = []
     cacheable = True
     for n in topo:
-        in_sig = []
-        for inp in n.inputs:
-            if isinstance(inp, LazyArray) and inp.node.values is None:
-                in_sig.append(("n", index[id(inp.node)], inp.idx))
-            else:
-                arr = force(inp)
-                in_sig.append(("l", len(leaves)))
-                leaves.append(arr)
-        # keys are enforced non-None by the dispatch gate; the guard stays
-        # for direct build() callers — but the leaf list must ALWAYS be
-        # complete (the replay indexes into it) so collection continues
-        if n.attrs_key is None or n.key is None:
+        entry = n.sig_entry
+        if entry is None:
             cacheable = False
-        else:
-            sig.append((n.key, n.name, n.attrs_key, tuple(in_sig),
-                        len(n.avals)))
+            for inp in n.inputs:
+                if not (isinstance(inp, LazyArray)
+                        and inp.node.values is None):
+                    leaves.append(force(inp))
+            continue
+        drift = 0
+        for bit, (inp, isig) in enumerate(zip(n.inputs, entry[3])):
+            if isinstance(inp, LazyArray) and inp.node.values is None:
+                if isig is None or \
+                        n.serial - inp.node.serial != isig[0] or \
+                        inp.idx != isig[1]:
+                    cacheable = False  # genuinely different wiring
+            else:
+                # the leaf list must ALWAYS be complete (the replay
+                # indexes into it) so collection continues either way
+                leaves.append(force(inp))
+                if isig is not None:
+                    drift |= 1 << bit  # record-time ref, now a leaf
+        sig.append((entry, drift) if drift else entry)
     if not cacheable:
         return None, leaves
-    leaf_avals = tuple((np.shape(a), np.result_type(a).str)
-                       for a in leaves)
+    leaf_avals = tuple(
+        (a.shape, a.dtype) if hasattr(a, "dtype") else
+        (np.shape(a), np.result_type(a)) for a in leaves)
     return (tuple(sig), leaf_avals), leaves
 
 
