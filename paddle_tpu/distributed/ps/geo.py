@@ -1,0 +1,108 @@
+"""Geo-async sparse tables (reference
+`paddle/fluid/distributed/ps/table/memory_sparse_geo_table.cc` + the
+`GeoCommunicator` push cadence of `fluid/distributed/ps/communicator/`).
+
+Semantics, matching the reference's geo-SGD mode: each worker trains
+against a LOCAL replica of the sparse table — every pull and gradient
+application is local and synchronous — while the deltas it produces are
+accumulated and shipped to the global server table only every
+`geo_step` applications. The server SUMS deltas (so concurrent workers
+compose), and a flush refreshes the worker's touched rows from the
+global table, absorbing other workers' progress. Between flushes,
+replicas are intentionally stale — that staleness-for-throughput trade
+IS geo-async training.
+
+TPU note: this path exists for API/workflow parity with the reference's
+CPU-PS mode; embedding scale-out on a TPU pod itself uses vocab
+sharding over ICI (see DESIGN_DECISIONS.md PS row).
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from . import SparseTable
+
+__all__ = ["GeoSparseTable"]
+
+
+class GeoSparseTable:
+    """Worker-side geo-async view over a DistSparseTable.
+
+    pull/push are LOCAL (replica SparseTable); every `geo_step` pushes
+    the accumulated deltas flush to the servers and the touched rows
+    refresh from the global table. `flush()` forces a cycle (call it at
+    a barrier before evaluating / saving). Thread-safe: a background
+    flusher thread (the reference GeoCommunicator pattern) may call
+    flush() while the training thread pulls/pushes.
+    """
+
+    def __init__(self, dist_table, geo_step=10, lr=0.01):
+        self._dist = dist_table
+        self.geo_step = int(geo_step)
+        self.lr = lr
+        self._local = SparseTable(dist_table.emb_dim, lr=lr)
+        self._pending: dict[int, np.ndarray] = {}
+        self._pushes = 0
+        self._lock = threading.Lock()
+
+    @property
+    def emb_dim(self):
+        return self._dist.emb_dim
+
+    def pull(self, ids):
+        ids = np.asarray(ids).reshape(-1)
+        with self._lock:
+            missing = [i for i in ids.tolist()
+                       if i not in self._local.rows]
+        if missing:
+            # server rpc outside the lock; install under it (a
+            # concurrent refresh of the same row wins either way —
+            # both sources are the global table)
+            fetched = self._dist.pull(np.asarray(missing, np.int64))
+            with self._lock:
+                for id_, row in zip(missing, fetched):
+                    self._local.rows.setdefault(
+                        id_, np.asarray(row, np.float32))
+        with self._lock:
+            return self._local.pull(ids)
+
+    def push(self, ids, grads):
+        ids = np.asarray(ids).reshape(-1)
+        grads = np.asarray(grads, np.float32)
+        with self._lock:
+            self._local.push(ids, grads)  # local SGD, synchronous
+            for id_, g in zip(ids.tolist(), grads):
+                delta = -self.lr * g
+                acc = self._pending.get(id_)
+                self._pending[id_] = delta if acc is None else acc + delta
+            self._pushes += 1
+            due = self._pushes % self.geo_step == 0
+        if due:
+            self.flush()
+
+    def flush(self):
+        """Ship accumulated deltas; refresh touched rows from global."""
+        with self._lock:
+            if not self._pending:
+                return
+            items = list(self._pending.items())
+            self._pending.clear()
+        ids = np.asarray([i for i, _ in items], np.int64)
+        self._dist.apply_delta(ids, np.stack([d for _, d in items]))
+        self.refresh(ids)
+
+    def refresh(self, ids):
+        """Overwrite local replica rows with the (merged) global rows —
+        the GeoCommunicator's periodic pull; call after a barrier to
+        absorb other workers' flushed deltas deterministically."""
+        ids = np.asarray(ids, np.int64).reshape(-1)
+        rows, present = self._dist.pull_existing(ids)
+        with self._lock:
+            for id_, row, ok in zip(ids.tolist(), rows, present):
+                if ok:
+                    self._local.rows[id_] = np.asarray(row, np.float32)
+
+    def size(self):
+        return self._dist.size()

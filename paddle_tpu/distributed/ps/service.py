@@ -88,6 +88,35 @@ def _srv_sparse_push(name, ids, grads):
     return True
 
 
+def _srv_sparse_apply_delta(name, ids, deltas):
+    """global_row += delta (geo-async merge). The row must exist: a
+    delta can only come from a worker that pulled the row first, and
+    that pull lazy-initialized it on this server — a missing row here
+    is a protocol bug, surfaced as KeyError rather than silently based
+    on a fresh RNG draw."""
+    with _tables_lock:
+        table = _server_tables[name]
+        for id_, d in zip(np.asarray(ids).reshape(-1).tolist(),
+                          np.asarray(deltas, np.float32)):
+            table.rows[id_] = table.rows[id_] + d
+    return True
+
+
+def _srv_sparse_pull_existing(name, ids):
+    """Pull rows WITHOUT lazy-init (geo refresh path: only rows the
+    server actually owns should overwrite a worker's local replica)."""
+    with _tables_lock:
+        table = _server_tables[name]
+        out = np.empty((len(ids), table.emb_dim), np.float32)
+        mask = np.zeros(len(ids), bool)
+        for i, id_ in enumerate(np.asarray(ids).reshape(-1).tolist()):
+            row = table.rows.get(id_)
+            if row is not None:
+                out[i] = row
+                mask[i] = True
+    return out, mask
+
+
 def _srv_sparse_size(name):
     with _tables_lock:
         return _server_tables[name].size()
@@ -163,6 +192,39 @@ class DistSparseTable:
                                       args=(self.name,))
                    for srv in self._servers)
 
+    # geo-async surface (used by GeoSparseTable; same shard fan-out as
+    # pull/push so the id->server rule lives in ONE class)
+    def apply_delta(self, ids, deltas):
+        ids, owner = self._shards(ids)
+        deltas = np.asarray(deltas, np.float32)
+        futs = []
+        for s, srv in enumerate(self._servers):
+            mask = owner == s
+            if mask.any():
+                futs.append(self._rpc.rpc_async(
+                    srv, _srv_sparse_apply_delta,
+                    args=(self.name, ids[mask], deltas[mask])))
+        for fut in futs:
+            fut.wait()
+
+    def pull_existing(self, ids):
+        """(rows, present_mask) in input order, no lazy-init."""
+        ids, owner = self._shards(ids)
+        out = np.empty((len(ids), self.emb_dim), np.float32)
+        present = np.zeros(len(ids), bool)
+        futs = []
+        for s, srv in enumerate(self._servers):
+            mask = owner == s
+            if mask.any():
+                futs.append((mask, self._rpc.rpc_async(
+                    srv, _srv_sparse_pull_existing,
+                    args=(self.name, ids[mask]))))
+        for mask, fut in futs:
+            rows, ok = fut.wait()
+            out[mask] = rows
+            present[mask] = ok
+        return out, present
+
 
 class DistributedPS:
     """The cross-process runtime (the_one_ps facade over the service).
@@ -217,6 +279,15 @@ class DistributedPS:
             fut.wait()
         return DistSparseTable(self._rpc, name, self._server_names,
                                emb_dim)
+
+    def create_geo_sparse_table(self, name, emb_dim, geo_step=10,
+                                lr=0.01, **kw):
+        """Geo-async sparse table (reference memory_sparse_geo_table):
+        local-replica training, delta push every `geo_step` pushes."""
+        from .geo import GeoSparseTable
+
+        dist = self.create_sparse_table(name, emb_dim, lr=lr, **kw)
+        return GeoSparseTable(dist, geo_step=geo_step, lr=lr)
 
     def barrier(self):
         """All-WORKER barrier over the rpc world's TCPStore rendezvous

@@ -45,6 +45,11 @@ ROLE_SCRIPT = textwrap.dedent("""
     # both workers see the loaded value
     np.testing.assert_allclose(dense.pull(),
                                np.arange(4, dtype=np.float32))
+    # barrier BEFORE the push: without it worker1 can race ahead (its
+    # own check + push) while worker0 sits between the previous barrier
+    # and its pull, observing the post-push value — the intermittent
+    # full-suite failure of rounds 3-5 was exactly this TOCTOU
+    ps.barrier()
     if wid == 1:
         dense.push(np.ones(4, np.float32))  # sgd lr=0.5 -> -0.5
     ps.barrier()
@@ -65,6 +70,27 @@ ROLE_SCRIPT = textwrap.dedent("""
         assert emb.size() == 5
         row0 = emb.pull(np.array([7], np.int64))
         assert row0.shape == (1, 4)
+    ps.barrier()
+
+    # geo-async table (reference memory_sparse_geo_table): local-replica
+    # training, explicit flush, deltas from BOTH workers merge on refresh
+    geo = ps.create_geo_sparse_table("gemb", 4, geo_step=100, lr=0.1)
+    ps.barrier()
+    gids = np.array([2, 5], np.int64)
+    base = geo.pull(gids).copy()       # lazy-init on servers, same view
+    g = np.full((2, 4), float(wid + 1), np.float32)
+    for _ in range(3):
+        geo.push(gids, g)              # local only: geo_step=100
+    np.testing.assert_allclose(geo.pull(gids), base - 0.1 * 3 * g,
+                               rtol=1e-5)
+    ps.barrier()
+    geo.flush()                        # ship accumulated deltas
+    ps.barrier()                       # every worker's deltas are in
+    geo.refresh(gids)
+    merged = base - 0.1 * 3 * (np.full((2, 4), 1.0) +
+                               np.full((2, 4), 2.0))
+    np.testing.assert_allclose(geo.pull(gids), merged, rtol=1e-5)
+
     ps.barrier()
     if wid == 0:
         ps.stop_servers()
@@ -109,15 +135,35 @@ def test_ps_service_two_servers_two_workers(tmp_path):
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True))
     try:
-        outs = []
+        # generous deadline: the whole suite shares ONE core, and four
+        # fresh interpreters importing jax under that load can take
+        # minutes before the barriers even form. Poll ALL procs: one
+        # child dying leaves its peers blocked in a barrier forever, so
+        # sequential communicate() would burn the whole budget before
+        # reporting the actual failure.
+        import time
+
+        deadline = time.time() + proc_timeout(600)
+        while time.time() < deadline:
+            rcs = [p.poll() for p in procs]
+            if any(rc not in (None, 0) for rc in rcs) or \
+                    all(rc == 0 for rc in rcs):
+                break
+            time.sleep(0.5)
+        # self-exited failures carry the real traceback; peers blocked
+        # in a barrier get killed and must be reported AFTER it, or
+        # pytest shows a SIGKILLed bystander instead of the cause
+        failed = [(p, rc) for p, rc in zip(procs, rcs)
+                  if rc not in (None, 0)]
         for p in procs:
-            # generous: the whole suite shares ONE core, and four
-            # fresh interpreters importing jax under that load can
-            # take minutes before the barriers even form
-            out, _ = p.communicate(timeout=proc_timeout(600))
-            outs.append(out)
-            assert p.returncode == 0, out[-800:]
-        joined = "\n".join(outs)
+            if p.poll() is None:
+                p.kill()
+        outs = {p: p.communicate()[0] for p in procs}
+        for p, rc in failed:
+            raise AssertionError(f"child rc={rc}: {outs[p][-1500:]}")
+        for p in procs:
+            assert p.returncode == 0, outs[p][-1500:]
+        joined = "\n".join(outs.values())
         assert "PS-WORKER-OK 0" in joined and "PS-WORKER-OK 1" in joined
     finally:
         for p in procs:
