@@ -46,6 +46,10 @@ class GeoSparseTable:
         self._pending: dict[int, np.ndarray] = {}
         self._pushes = 0
         self._lock = threading.Lock()
+        # serializes whole flush/refresh cycles: without it a slow
+        # concurrent flush's pull_existing result can overwrite a newer
+        # install and regress the replica behind its own shipped state
+        self._flush_lock = threading.Lock()
 
     @property
     def emb_dim(self):
@@ -84,19 +88,35 @@ class GeoSparseTable:
 
     def flush(self):
         """Ship accumulated deltas; refresh touched rows from global."""
-        with self._lock:
-            if not self._pending:
-                return
-            items = list(self._pending.items())
-            self._pending.clear()
-        ids = np.asarray([i for i, _ in items], np.int64)
-        self._dist.apply_delta(ids, np.stack([d for _, d in items]))
-        self.refresh(ids)
+        with self._flush_lock:
+            with self._lock:
+                if not self._pending:
+                    return
+                items = list(self._pending.items())
+                self._pending.clear()
+            ids = np.asarray([i for i, _ in items], np.int64)
+            try:
+                self._dist.apply_delta(ids,
+                                       np.stack([d for _, d in items]))
+            except Exception:
+                # transient rpc failure: re-merge so the deltas survive
+                # for a retry instead of silently vanishing (a dropped
+                # delta permanently diverges this worker's replica)
+                with self._lock:
+                    for id_, d in items:
+                        acc = self._pending.get(id_)
+                        self._pending[id_] = d if acc is None else acc + d
+                raise
+            self._refresh_locked(ids)
 
     def refresh(self, ids):
         """Overwrite local replica rows with the (merged) global rows —
         the GeoCommunicator's periodic pull; call after a barrier to
         absorb other workers' flushed deltas deterministically."""
+        with self._flush_lock:
+            self._refresh_locked(ids)
+
+    def _refresh_locked(self, ids):
         ids = np.asarray(ids, np.int64).reshape(-1)
         rows, present = self._dist.pull_existing(ids)
         with self._lock:

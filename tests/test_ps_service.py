@@ -155,12 +155,21 @@ def test_ps_service_two_servers_two_workers(tmp_path):
         # pytest shows a SIGKILLed bystander instead of the cause
         failed = [(p, rc) for p, rc in zip(procs, rcs)
                   if rc not in (None, 0)]
+        hung = [p for p, rc in zip(procs, rcs) if rc is None]
         for p in procs:
             if p.poll() is None:
                 p.kill()
         outs = {p: p.communicate()[0] for p in procs}
         for p, rc in failed:
             raise AssertionError(f"child rc={rc}: {outs[p][-1500:]}")
+        if hung:
+            # no child crashed: the harness deadline itself expired (a
+            # genuine distributed hang) — say so instead of reporting a
+            # SIGKILLed bystander as the failure
+            raise AssertionError(
+                f"harness deadline exceeded with {len(hung)} children "
+                "still running; tails:\n" + "\n---\n".join(
+                    outs[p][-600:] for p in hung))
         for p in procs:
             assert p.returncode == 0, outs[p][-1500:]
         joined = "\n".join(outs.values())
