@@ -56,10 +56,13 @@ import numpy as np
 #   large  bs8  dots_attn OOM (r4 jaxlib; was 37.2% old-accounting in r2)
 # Profiling note: attention kernels are the costliest thing to
 # rematerialize — 57% of step time under full remat; hence remat=none wins.
-# NOTE: gpt2-large rungs are deliberately absent — large-model compiles
-# exceeded the watchdog twice this round and the watchdog kill wedges the
-# tunnel (TPU_VALIDATION.md session-2 wedge); every rung below has a
-# known-bounded compile.
+# gpt2-large root cause (round 5, tools/memory_audit.py): bs8 needs
+# 24.4G at remat=none and 18.0G at dots_attn vs 16G v5e HBM — the r4
+# RESOURCE_EXHAUSTED was arithmetic, not a jaxlib regression; the only
+# fitting policy is full remat (15.2G, tight), which is what round 2's
+# 37.2% measured. One full-remat large rung therefore runs LAST: a
+# fast OOM can't wedge anything, and a slow compile at the tail risks
+# only budget that the banked rungs above no longer need.
 # Optional 5th element: env overrides for the child (flash block sweep —
 # the round-4 verdict's margin plan; block variants share the metric
 # string, so .bench_history banks whichever block size wins).
@@ -74,6 +77,8 @@ TPU_CONFIGS = [
     ("gpt2-medium", 8, 1024, "none",         # flash block sweep: 128x512
      {"PADDLE_TPU_FLASH_BLOCK_Q": "128", "PADDLE_TPU_FLASH_BLOCK_K": "512"}),
     ("gpt2-medium", 8, 2048, "dots_attn"),   # longer sequence
+    ("gpt2-large", 8, 1024, "full"),         # the one large config that
+                                             # fits 16G (memory_audit.py)
 ]
 # CPU fallback ladder: only the tiny config finishes on one core.
 CPU_CONFIGS = [("gpt2-tiny", 8, 128, "full")]
