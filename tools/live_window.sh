@@ -1,0 +1,26 @@
+#!/bin/bash
+# Priority-ordered use of a live TPU window (round 5, VERDICT items 1-3).
+# Run the moment a probe succeeds; each stage is independently useful and
+# the order banks the highest-value artifact first:
+#   1. bench.py            — fresh driver-format lines; money rung first,
+#                            margin repeats + flash-block sweep, large tail
+#   2. tpu_validate.py     — Pallas flash A/B, int8 numerics + timed
+#                            contraction, lazy round trips, hybrid step
+#   3. bench.py (2nd pass) — more variance-lottery draws; every real line
+#                            banks into .bench_history.json
+# All output is tee'd; commit .bench_history.json + the log afterwards.
+set -u
+cd "$(dirname "$0")/.."
+STAMP=$(date -u +%Y%m%dT%H%M%S)
+LOG=/tmp/live_window_$STAMP.log
+{
+  echo "=== live window $STAMP (UTC) ==="
+  echo "--- stage 1: bench ladder"
+  PADDLE_TPU_BENCH_BUDGET=${PADDLE_TPU_BENCH_BUDGET:-1200} python bench.py
+  echo "--- stage 2: hardware validation suite"
+  timeout 600 python tools/tpu_validate.py
+  echo "--- stage 3: bench ladder, second pass (warm cache)"
+  PADDLE_TPU_BENCH_BUDGET=900 python bench.py
+  echo "=== window done $(date -u +%H:%M:%S) ==="
+} 2>&1 | tee "$LOG"
+echo "log: $LOG"
