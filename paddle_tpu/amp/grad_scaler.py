@@ -44,10 +44,12 @@ class AmpScaler:
         # sync per step (reference check_finite_and_unscale op semantics;
         # the per-param bool() this replaces was one blocking sync each)
         found_traced = jnp.zeros((), jnp.bool_)
+        from ..core.selected_rows import densify_grad
+
         for p in optimizer._parameter_list:
             if p is None or p.grad is None:
                 continue
-            g = p.grad
+            g = densify_grad(p.grad)  # sparse embedding grads densify
             unscaled = forward(lambda a: (a.astype(jnp.float32) / s),
                                (g,), name="unscale", nondiff=True)
             p.grad = Tensor(unscaled._data.astype(g._data.dtype))

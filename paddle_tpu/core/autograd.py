@@ -136,8 +136,37 @@ def _is_float(x) -> bool:
 def _accumulate_leaf(tensor, g):
     """GradNodeAccumulation: write/accumulate `.grad` on a leaf tensor."""
     from . import lazy as _lazy
+    from .selected_rows import SelectedRows
     from .tensor import Tensor
 
+    if isinstance(g, SelectedRows):
+        # sparse embedding gradient (reference SelectedRows): .grad IS
+        # the SelectedRows object; row-capable optimizers consume it,
+        # everything else densifies via .to_dense(). Tensor hooks are
+        # not applied to sparse grads (the reference applies none
+        # either — hooks attach to dense VarBase grads).
+        prev = tensor.grad
+        if prev is None:
+            tensor.grad = g
+        elif isinstance(prev, SelectedRows):
+            tensor.grad = prev.accumulate(g)
+        else:
+            tensor.grad = Tensor(
+                _lazy.lazy_add(prev._data, g.to_dense()),
+                stop_gradient=True)
+        return
+    if isinstance(tensor.grad, SelectedRows):
+        # dense contribution onto an existing sparse grad: hooks still
+        # apply to the DENSE cotangent (parity with the dense-only path)
+        if tensor._hooks:
+            for h in tensor._hooks:
+                out = h(Tensor(g, stop_gradient=True))
+                if out is not None:
+                    g = out._data if isinstance(out, Tensor) \
+                        else jnp.asarray(out)
+        tensor.grad = Tensor(tensor.grad.to_dense() + g,
+                             stop_gradient=True)
+        return
     if tensor._hooks:
         for h in tensor._hooks:
             out = h(Tensor(g, stop_gradient=True))
@@ -234,6 +263,12 @@ def _run_engine(seeds, retain_graph=False, capture=None):
             if e[0] == "leaf":
                 t = e[1]
                 if captured is not None and id(t) in capture:
+                    from .selected_rows import SelectedRows
+
+                    # paddle.grad returns dense Tensors: densify sparse
+                    # embedding cotangents at the capture boundary
+                    if isinstance(g, SelectedRows):
+                        g = g.to_dense()
                     if id(t) in captured:
                         from . import lazy as _lazy
 

@@ -1,0 +1,82 @@
+"""SelectedRows: sparse row-wise gradients (reference
+`phi/core/selected_rows.h` + `phi/kernels/selected_rows/`).
+
+The reference uses SelectedRows as the gradient type of sparse embedding
+lookups: only looked-up rows carry gradient, and optimizers apply
+row-wise updates instead of materializing a [V, D] dense table gradient.
+
+TPU-first scope: this path serves EAGER training (and the CPU-PS
+workflow) — under jit/TrainStep tracing, XLA fuses the dense
+scatter-add gradient into the update and a dynamic-length row list
+cannot be traced anyway (data-dependent shape), so traced code keeps
+the dense path; `nn.Embedding(sparse=True)` falls back silently there,
+matching the capability (not the mechanism) of the reference's GPU
+dense path.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = ["SelectedRows", "densify_grad"]
+
+
+def densify_grad(g):
+    """Dense-Tensor view of a gradient that may be SelectedRows — the
+    choke point for consumers that need the whole gradient (clip-by-norm
+    utilities, GradScaler.unscale_, dp grad allreduce)."""
+    if isinstance(g, SelectedRows):
+        from .tensor import Tensor
+
+        return Tensor(g.to_dense(), stop_gradient=True)
+    return g
+
+
+class SelectedRows:
+    """rows: int64 [n] (duplicates allowed; semantics = sum), values:
+    [n, ...] aligned with rows, height: size of the dense dim 0."""
+
+    def __init__(self, rows, values, height):
+        self.rows = jnp.asarray(rows).reshape(-1)
+        self.values = jnp.asarray(values)
+        self.height = int(height)
+
+    @property
+    def shape(self):
+        return [self.height] + list(self.values.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def merged(self):
+        """(unique_rows, summed_values) — the reference's
+        MergeAdd/scatter dedup before an optimizer applies rows."""
+        rows = np.asarray(self.rows)
+        uniq, inv = np.unique(rows, return_inverse=True)
+        vals = jnp.zeros((len(uniq),) + self.values.shape[1:],
+                         self.values.dtype)
+        vals = vals.at[jnp.asarray(inv)].add(self.values)
+        return jnp.asarray(uniq), vals
+
+    def to_dense(self):
+        dense = jnp.zeros(self.shape, self.values.dtype)
+        return dense.at[self.rows].add(self.values)
+
+    def accumulate(self, other):
+        """Grad accumulation: SR+SR concatenates (sum semantics keep it
+        exact); SR+dense densifies."""
+        if isinstance(other, SelectedRows):
+            assert other.height == self.height
+            return SelectedRows(
+                jnp.concatenate([self.rows, other.rows]),
+                jnp.concatenate([self.values, other.values]), self.height)
+        return self.to_dense() + other
+
+    def numpy(self):
+        return np.asarray(self.to_dense())
+
+    def __repr__(self):
+        return (f"SelectedRows(height={self.height}, "
+                f"nnz_rows={self.rows.shape[0]}, "
+                f"row_shape={tuple(self.values.shape[1:])})")

@@ -227,7 +227,13 @@ class Tensor:
 
     def clear_gradient(self, set_to_zero=False):
         if set_to_zero and self.grad is not None:
-            self.grad = Tensor(jnp.zeros_like(self.grad._data))
+            from .selected_rows import SelectedRows
+
+            if isinstance(self.grad, SelectedRows):
+                self.grad = Tensor(jnp.zeros(tuple(self.grad.shape),
+                                             self.grad.dtype))
+            else:
+                self.grad = Tensor(jnp.zeros_like(self.grad._data))
         else:
             self.grad = None
 

@@ -40,8 +40,11 @@ class DataParallel(Layer):
         group = self.group or (hcg.get_data_parallel_group() if hcg else None)
         if group is None or group.nranks <= 1:
             return
+        from ..core.selected_rows import densify_grad
+
         for p in self._layers.parameters():
             if p.grad is not None:
+                p.grad = densify_grad(p.grad)  # SR can't ride allreduce
                 collective.all_reduce(p.grad, group=group)
 
     def state_dict(self, *args, **kwargs):

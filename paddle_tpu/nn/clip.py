@@ -92,9 +92,12 @@ class ClipGradByGlobalNorm(ClipGradBase):
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
                     error_if_nonfinite=False):
+    from ..core.selected_rows import densify_grad
+
     if isinstance(parameters, Tensor):
         parameters = [parameters]
-    pg = [(p, p.grad) for p in parameters if p.grad is not None]
+    pg = [(p, densify_grad(p.grad)) for p in parameters
+          if p.grad is not None]
     clipped = ClipGradByGlobalNorm(max_norm)._clip(pg)
     for (p, _), (_, g) in zip(pg, clipped):
         p.grad = g
@@ -102,8 +105,11 @@ def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
 
 
 def clip_grad_value_(parameters, clip_value):
+    from ..core.selected_rows import densify_grad
+
     if isinstance(parameters, Tensor):
         parameters = [parameters]
-    pg = [(p, p.grad) for p in parameters if p.grad is not None]
+    pg = [(p, densify_grad(p.grad)) for p in parameters
+          if p.grad is not None]
     for (p, _), (_, g) in zip(pg, ClipGradByValue(clip_value)._clip(pg)):
         p.grad = g

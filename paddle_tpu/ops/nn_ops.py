@@ -23,7 +23,7 @@ import jax.numpy as jnp
 
 from ..core import dtype as dtypes
 from ..core import random as prandom
-from ..core.dispatch import forward
+from ..core.dispatch import forward, unwrap
 from ..core.dispatch import note as _note
 from ..core.tensor import Tensor
 
@@ -565,14 +565,55 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
     """Reference `phi/kernels/gpu/embedding_kernel.cu`. XLA lowers take() to a
-    gather; the backward scatter-add is what the reference's sparse
-    SelectedRows grad optimizes — on TPU dense scatter-add is fine."""
+    gather; under jit the backward scatter-add fuses into the update, so
+    traced code always uses the dense path. `sparse=True` honors the
+    reference's SelectedRows gradient in EAGER mode: weight.grad becomes
+    a SelectedRows (rows = looked-up ids, values = output cotangents)
+    and row-capable optimizers (SGD, Adam lazy_mode) update only those
+    rows — `phi/kernels/selected_rows/` role."""
     def f(i, w):
         out = jnp.take(w, i, axis=0)
         if padding_idx is not None:
             mask = (i == padding_idx)[..., None]
             out = jnp.where(mask, 0.0, out)
         return out
+
+    if sparse:
+        from ..core import dispatch as _dispatch
+        from ..core import lazy as _lazy
+        from ..core import autograd as ag
+        from ..core.selected_rows import SelectedRows
+        from ..core.dispatch import trace_state_clean
+
+        eager = (_dispatch.static_recorder is None and not _lazy.enabled()
+                 and _dispatch.amp_cast_hook is None and trace_state_clean()
+                 and ag.is_grad_enabled()
+                 and isinstance(weight, Tensor) and not weight.stop_gradient
+                 # leaf tables only: an upstream node's jax pullback
+                 # cannot consume a SelectedRows cotangent, so a derived
+                 # table (w * s, casted, ...) keeps the dense path
+                 and weight._grad_node is None)
+        if eager:
+            ids = unwrap(x)
+            w = unwrap(weight)
+            out = f(ids, w)
+            V = w.shape[0]
+
+            def vjp_fn(cts, _ids=ids, _V=V):
+                ct = cts[0]
+                flat_ids = _ids.reshape(-1)
+                vals = ct.reshape((-1,) + ct.shape[len(_ids.shape):])
+                if padding_idx is not None:
+                    keep = flat_ids != padding_idx
+                    vals = vals * keep[:, None].astype(vals.dtype)
+                return (None, SelectedRows(flat_ids, vals, _V))
+
+            node = ag.GradNode("embedding_sparse", vjp_fn,
+                               [(out.shape, out.dtype)],
+                               [None, ("leaf", weight)])
+            t = Tensor(out, stop_gradient=False)
+            t._grad_node, t._out_idx = node, 0
+            return t
     return forward(f, (x, weight), name="embedding")
 
 

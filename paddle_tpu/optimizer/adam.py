@@ -27,6 +27,7 @@ class Adam(Optimizer):
                          name)
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
         self._multi_precision = multi_precision
+        self._lazy_mode = lazy_mode
 
     def _create_accumulators(self, p):
         self._acc("moment1", p, dtype=jnp.float32)
@@ -71,6 +72,47 @@ class Adam(Optimizer):
         if use_master:
             mw._data = outs[3]._data
 
+    def _supports_sparse_grad(self):
+        # reference Adam(lazy_mode=True): only the current rows' moments
+        # update; default mode decays EVERY moment, which is exactly a
+        # dense update — so non-lazy densifies (Optimizer.step)
+        return self._lazy_mode
+
+    def _apply_one_sparse(self, p, g):
+        b1, b2, eps = self._beta1, self._beta2, self._eps
+        self._create_accumulators(p)
+        m = self._acc("moment1", p, dtype=jnp.float32)
+        v = self._acc("moment2", p, dtype=jnp.float32)
+        use_master = self._multi_precision and p._data.dtype != jnp.float32
+        mw = self._acc("master_weight", p, dtype=jnp.float32) if use_master \
+            else None
+        rows, vals = g.merged()
+        lr_t = self._scalar_input("lr", self._lr_for(p))
+        t_t = self._scalar_input("t", self._opt_step)
+
+        def f(w, rr, gg, mm, vv, lr, t, *master):
+            gf = gg.astype(jnp.float32)
+            m_r = b1 * mm[rr] + (1 - b1) * gf
+            v_r = b2 * vv[rr] + (1 - b2) * jnp.square(gf)
+            mhat = m_r / (1 - b1 ** t)
+            vhat = v_r / (1 - b2 ** t)
+            base = (master[0] if master else w.astype(jnp.float32))[rr]
+            new_r = base - lr * mhat / (jnp.sqrt(vhat) + eps)
+            outs = (w.at[rr].set(new_r.astype(w.dtype)),
+                    mm.at[rr].set(m_r), vv.at[rr].set(v_r))
+            if master:
+                outs += (master[0].at[rr].set(new_r),)
+            return outs
+
+        ins = (p, rows, vals, m, v, lr_t, t_t) + \
+            ((mw,) if use_master else ())
+        outs = forward(f, ins, name="adam_rows", nondiff=True)
+        p._data = outs[0]._data
+        m._data = outs[1]._data
+        v._data = outs[2]._data
+        if use_master:
+            mw._data = outs[3]._data
+
 
 class AdamW(Adam):
     """Decoupled weight decay (reference `python/paddle/optimizer/adamw.py`)."""
@@ -83,6 +125,11 @@ class AdamW(Adam):
                          None, grad_clip, lazy_mode, multi_precision, name)
         self._wd_coeff = weight_decay
         self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _supports_sparse_grad(self):
+        # AdamW's decoupled decay multiplies EVERY weight each step — a
+        # whole-table op incompatible with a rows-only update; densify
+        return False
 
     def _apply_one(self, p, g):
         b1, b2, eps = self._beta1, self._beta2, self._eps

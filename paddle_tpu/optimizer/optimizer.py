@@ -98,17 +98,38 @@ class Optimizer:
         return pg
 
     def step(self):
+        from ..core.selected_rows import SelectedRows
+        from ..core.tensor import Tensor
+
         pg = self._params_grads()
+        # SelectedRows grads (sparse embedding, eager): row-capable
+        # optimizers apply row-wise updates; anything that needs the
+        # whole gradient (weight decay, clipping) or an optimizer
+        # without a sparse rule densifies first — the reference's
+        # MergeAdd-then-dense fallback.
+        densify = (self._weight_decay is not None
+                   or self._grad_clip is not None
+                   or not self._supports_sparse_grad())
+        pg = [(p, Tensor(g.to_dense(), stop_gradient=True)
+               if densify and isinstance(g, SelectedRows) else g)
+              for p, g in pg]
         if self._weight_decay is not None:
             pg = [(p, self._weight_decay(p, g)) for p, g in pg]
         if self._grad_clip is not None:
             pg = self._grad_clip(pg)
         self._opt_step += 1
         for p, g in pg:
-            self._apply_one(p, g)
+            if isinstance(g, SelectedRows):
+                self._apply_one_sparse(p, g)
+            else:
+                self._apply_one(p, g)
 
     def _apply_one(self, p, g):
         raise NotImplementedError
+
+    def _supports_sparse_grad(self):
+        """Override (with _apply_one_sparse) for row-wise update rules."""
+        return False
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
@@ -213,6 +234,10 @@ class Optimizer:
         pass
 
 
+def _sgd_rows_update(w, rows, vals, lr):
+    return w.at[rows].add((-lr * vals).astype(w.dtype))
+
+
 def _sgd_update(w, gg, lr):
     return w - (lr * gg.astype(jnp.float32)).astype(w.dtype)
 
@@ -231,6 +256,20 @@ class SGD(Optimizer):
         lr_t = self._scalar_input("lr", self._lr_for(p))
         new_p = forward(_sgd_update, (p, g, lr_t), name="sgd",
                         nondiff=True)
+        p._data = new_p._data
+
+    def _supports_sparse_grad(self):
+        return True
+
+    def _apply_one_sparse(self, p, g):
+        # row-wise SGD over a SelectedRows grad (reference
+        # phi/kernels/selected_rows/ sgd kernel): only looked-up rows
+        # move. No merged() here — at[rows].add sums duplicate rows
+        # itself, and merged()'s np.unique would force a host sync
+        # every step (Adam's read-modify-write of moments DOES need it)
+        lr_t = self._scalar_input("lr", self._lr_for(p))
+        new_p = forward(_sgd_rows_update, (p, g.rows, g.values, lr_t),
+                        name="sgd_rows", nondiff=True)
         p._data = new_p._data
 
 

@@ -1,0 +1,175 @@
+"""SelectedRows sparse embedding gradients (reference
+`phi/core/selected_rows.h`, `phi/kernels/selected_rows/`,
+Adam lazy_mode semantics from `python/paddle/optimizer/adam.py`).
+
+Oracle = the dense-gradient path on identical data."""
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.core.selected_rows import SelectedRows
+
+V, D = 12, 4
+
+
+def _pair(seed=0, sparse=True, **emb_kw):
+    paddle.seed(seed)
+    emb = nn.Embedding(V, D, sparse=sparse, **emb_kw)
+    return emb
+
+
+def _loss(emb, ids_np, tgt):
+    out = emb(paddle.to_tensor(ids_np))
+    return ((out - paddle.to_tensor(tgt)) ** 2).mean()
+
+
+class TestSelectedRowsGrad:
+    def test_grad_is_selected_rows_and_matches_dense(self):
+        ids = np.array([[1, 3, 3], [7, 1, 0]], np.int64)
+        tgt = np.ones((2, 3, D), np.float32)
+        es, ed = _pair(1, True), _pair(1, False)
+        _loss(es, ids, tgt).backward()
+        _loss(ed, ids, tgt).backward()
+        g = es.weight.grad
+        assert isinstance(g, SelectedRows)
+        assert g.height == V and g.rows.shape[0] == ids.size
+        np.testing.assert_allclose(np.asarray(g.to_dense()),
+                                   np.asarray(ed.weight.grad.numpy()),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_accumulation_and_merge(self):
+        ids1 = np.array([2, 5], np.int64)
+        ids2 = np.array([5, 9], np.int64)
+        tgt = np.zeros((2, D), np.float32)
+        es, ed = _pair(2, True), _pair(2, False)
+        _loss(es, ids1, tgt).backward()
+        _loss(es, ids2, tgt).backward()  # accumulates SR+SR
+        _loss(ed, ids1, tgt).backward()
+        _loss(ed, ids2, tgt).backward()
+        g = es.weight.grad
+        assert isinstance(g, SelectedRows)
+        rows, vals = g.merged()
+        assert sorted(np.asarray(rows).tolist()) == [2, 5, 9]
+        np.testing.assert_allclose(np.asarray(g.to_dense()),
+                                   np.asarray(ed.weight.grad.numpy()),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_padding_idx_rows_get_zero_grad(self):
+        ids = np.array([0, 3], np.int64)
+        es = _pair(3, True, padding_idx=0)
+        _loss(es, ids, np.ones((2, D), np.float32)).backward()
+        dense = np.asarray(es.weight.grad.to_dense())
+        np.testing.assert_allclose(dense[0], 0.0)
+        assert np.abs(dense[3]).sum() > 0
+
+    def test_sgd_row_update_matches_dense(self):
+        ids = np.array([1, 4, 4, 8], np.int64)
+        tgt = np.ones((4, D), np.float32)
+        es, ed = _pair(4, True), _pair(4, False)
+        os_ = optimizer.SGD(0.1, parameters=es.parameters())
+        od = optimizer.SGD(0.1, parameters=ed.parameters())
+        for _ in range(3):
+            _loss(es, ids, tgt).backward()
+            os_.step()
+            os_.clear_grad()
+            _loss(ed, ids, tgt).backward()
+            od.step()
+            od.clear_grad()
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_adam_lazy_mode_touches_only_current_rows(self):
+        ids_a = np.array([1, 2], np.int64)
+        ids_b = np.array([6, 7], np.int64)
+        tgt = np.ones((2, D), np.float32)
+        es, ed = _pair(5, True), _pair(5, False)
+        ol = optimizer.Adam(0.05, parameters=es.parameters(),
+                            lazy_mode=True)
+        od = optimizer.Adam(0.05, parameters=ed.parameters())
+        # step 1 on rows {1,2}: from zero moments, lazy == dense on
+        # touched rows AND untouched rows stay put in both
+        _loss(es, ids_a, tgt).backward()
+        ol.step(); ol.clear_grad()
+        _loss(ed, ids_a, tgt).backward()
+        od.step(); od.clear_grad()
+        np.testing.assert_allclose(np.asarray(es.weight.numpy()),
+                                   np.asarray(ed.weight.numpy()),
+                                   rtol=1e-5, atol=1e-6)
+        w_before = np.asarray(es.weight.numpy()).copy()
+        # step 2 on DISJOINT rows {6,7}: lazy must leave rows {1,2}
+        # exactly as they were (dense adam would keep moving them on
+        # momentum — the defining lazy_mode divergence)
+        _loss(es, ids_b, tgt).backward()
+        ol.step(); ol.clear_grad()
+        w_after = np.asarray(es.weight.numpy())
+        np.testing.assert_allclose(w_after[[1, 2]], w_before[[1, 2]])
+        assert np.abs(w_after[[6, 7]] - w_before[[6, 7]]).sum() > 0
+
+    def test_grad_clip_densifies(self):
+        ids = np.array([3, 3], np.int64)
+        es = _pair(6, True)
+        opt = optimizer.SGD(
+            0.1, parameters=es.parameters(),
+            grad_clip=nn.ClipGradByGlobalNorm(0.01))
+        _loss(es, ids, np.ones((2, D), np.float32)).backward()
+        opt.step()  # must not raise; clip sees a dense tensor
+        opt.clear_grad()
+        assert es.weight.grad is None
+
+    def test_trainstep_traced_falls_back_to_dense(self):
+        # under jit tracing the rows are data-dependent; sparse=True
+        # silently keeps the dense path and trains identically
+        ids = np.array([[1, 3], [7, 0]], np.int64)
+        tgt = np.ones((2, 2, D), np.float32)
+        es = _pair(7, True)
+        opt = optimizer.SGD(0.1, parameters=es.parameters())
+
+        def step(x, y):
+            loss = ((es(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        train = paddle.jit.TrainStep(step, es, opt)
+        l0 = float(train(paddle.to_tensor(ids), paddle.to_tensor(tgt)))
+        l1 = float(train(paddle.to_tensor(ids), paddle.to_tensor(tgt)))
+        assert np.isfinite(l0) and l1 < l0
+
+    def test_non_leaf_table_keeps_dense_path(self):
+        # a derived table (w * 1.0): upstream pullbacks can't consume a
+        # SelectedRows cotangent, so sparse=True must keep dense
+        es, ed = _pair(8, True), _pair(8, False)
+        ids = np.array([2, 5], np.int64)
+        tgt = np.zeros((2, D), np.float32)
+        out = nn.functional.embedding(paddle.to_tensor(ids),
+                                      es.weight * 1.0, sparse=True)
+        ((out - paddle.to_tensor(tgt)) ** 2).mean().backward()
+        assert not isinstance(es.weight.grad, SelectedRows)
+        _loss(ed, ids, tgt).backward()
+        np.testing.assert_allclose(np.asarray(es.weight.grad.numpy()),
+                                   np.asarray(ed.weight.grad.numpy()),
+                                   rtol=1e-6, atol=1e-7)
+
+    def test_clip_grad_norm_utility_densifies(self):
+        es = _pair(9, True)
+        _loss(es, np.array([1, 1], np.int64),
+              np.ones((2, D), np.float32)).backward()
+        from paddle_tpu.nn.clip import clip_grad_norm_
+
+        clip_grad_norm_(list(es.parameters()), 0.01)
+        g = es.weight.grad
+        assert not isinstance(g, SelectedRows)
+        norm = float(np.linalg.norm(np.asarray(g.numpy())))
+        assert norm <= 0.011, norm
+
+    def test_paddle_grad_capture_returns_dense(self):
+        es = _pair(10, True)
+        ids = np.array([4, 4, 6], np.int64)
+        out = es(paddle.to_tensor(ids))
+        loss = (out ** 2).mean()
+        (g,) = paddle.grad(loss, [es.weight])
+        arr = np.asarray(g.numpy())
+        assert arr.shape == (V, D)
+        assert np.abs(arr[[4, 6]]).sum() > 0
