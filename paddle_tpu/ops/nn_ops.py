@@ -64,27 +64,45 @@ def _norm_tuple(v, n):
     return tuple(int(i) for i in v)
 
 
+def normalize_conv_padding(n, padding, channels_last):
+    """Paddle conv padding forms -> "SAME"/"VALID" or n (lo, hi) pairs:
+    int, [p_dim...], [lo0, hi0, lo1, hi1, ...], [(lo, hi)...] spatial
+    pairs, or the full-rank pairs form including batch/channel dims
+    (which must be zero-padded)."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * n
+    padding = list(padding)
+    if padding and isinstance(padding[0], (list, tuple)):
+        pairs = [tuple(int(q) for q in p) for p in padding]
+        if len(pairs) == n:
+            return pairs
+        if len(pairs) == n + 2:
+            # full-rank form: [N, (spatial...), C] or [N, C, spatial...]
+            other = [pairs[0], pairs[-1]] if channels_last else pairs[:2]
+            spatial = pairs[1:1 + n] if channels_last else pairs[2:]
+            if any(p != (0, 0) for p in other):
+                raise ValueError(
+                    "conv padding on batch/channel dims must be (0, 0); "
+                    f"got {padding!r}")
+            return spatial
+        raise ValueError(f"conv padding pairs form needs {n} or {n + 2} "
+                         f"pairs; got {padding!r}")
+    if len(padding) == n:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * n:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1]))
+                for i in range(n)]
+    raise ValueError(f"unsupported conv padding form {padding!r}")
+
+
 def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups,
              data_format, name):
     stride = _norm_tuple(stride, n)
     dilation = _norm_tuple(dilation, n)
-    if isinstance(padding, str):
-        pad = padding.upper()
-        if pad == "SAME":
-            pad = "SAME"
-        elif pad == "VALID":
-            pad = "VALID"
-    elif isinstance(padding, (int, np.integer)):
-        pad = [(int(padding), int(padding))] * n
-    else:
-        padding = list(padding)
-        if len(padding) == n:
-            pad = [(int(p), int(p)) for p in padding]
-        elif len(padding) == 2 * n:
-            pad = [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(n)]
-        else:  # per-dim pairs
-            pad = [tuple(int(q) for q in p) for p in padding]
     channels_last = data_format in ("NHWC", "NLC", "NDHWC")
+    pad = normalize_conv_padding(n, padding, channels_last)
     spatial = "".join("DHW"[3 - n:])
     if channels_last:
         dn_in = "N" + spatial + "C"
@@ -102,7 +120,11 @@ def _conv_nd(n, x, weight, bias, stride, padding, dilation, groups,
             preferred_element_type=None)
         if b:
             bias_shape = [1] * out.ndim
-            bias_shape[dn.out_spec.index(1) if hasattr(dn, 'out_spec') else (out.ndim - 1 if channels_last else 1)] = -1
+            # out_spec = (batch_pos, feature_pos, *spatial_pos): the
+            # channel lands at out_spec[1] (.index(1) found the POSITION
+            # holding the value 1 — wrong for NHWC, where that's H)
+            bias_shape[dn.out_spec[1] if hasattr(dn, "out_spec")
+                       else (out.ndim - 1 if channels_last else 1)] = -1
             out = out + b[0].reshape(bias_shape)
         return out
 

