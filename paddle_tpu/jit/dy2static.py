@@ -53,6 +53,7 @@ import ast
 import inspect
 import textwrap
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 
@@ -1054,7 +1055,111 @@ def convert_logical_not(x):
     return not x  # numpy/python operands keep python semantics
 
 
+def convert_assert(cond, msg_fn):
+    """`assert c[, m]` inside a to_static region (reference
+    assert_transformer.py over static.nn Assert). Python values keep
+    exact python-assert TRUTHINESS (a non-empty tuple passes); concrete
+    tensors/arrays check all elements (the Assert op's semantics);
+    the message thunk evaluates only on failure. A TRACED condition
+    registers a host callback that raises at run time — XLA has no
+    abort op, so the check executes host-side per step, like the
+    reference's Assert op prints then aborts from the kernel."""
+    from ..core.tensor import Tensor
+
+    c = _unwrap(cond)
+    if _is_traced(cond):
+        def _check(ok):
+            if not bool(np.asarray(ok).all()):
+                # the thunk may reference traced values (leaked tracers
+                # inside a host callback) — never let that mask the
+                # assertion itself
+                try:
+                    m = msg_fn()
+                except Exception:
+                    m = "<message unavailable: refers to traced values>"
+                raise AssertionError(
+                    "dy2static traced assert failed"
+                    + (f": {m}" if m is not None else ""))
+
+        jax.debug.callback(_check, jnp.asarray(c).all())
+        return
+    if isinstance(cond, Tensor) or isinstance(c, (jax.Array, np.ndarray)):
+        ok = bool(np.asarray(c).all())
+    else:
+        ok = bool(c)  # python containers keep python truthiness
+    if not ok:
+        m = msg_fn()
+        raise AssertionError(m if m is not None else "")
+
+
+def convert_print(*args, sep=" ", end="\n", file=None, flush=False):
+    """`print(...)` inside a to_static region (reference
+    print_transformer.py over static Print op): any traced argument
+    routes the whole call through a host callback that runs the REAL
+    builtin print (honoring sep/end/file/flush) with runtime values
+    instead of tracer reprs. Pure-python calls print immediately."""
+    raw = [_unwrap(a) for a in args]
+    traced_idx = [i for i, a in enumerate(args) if _is_traced(a)]
+    if traced_idx:
+        idx_set = set(traced_idx)
+
+        def _emit(*tvals):
+            it = iter(tvals)
+            shown = [next(it) if i in idx_set else raw[i]
+                     for i in range(len(raw))]
+            if file is None:
+                print(*shown, sep=sep, end=end, flush=flush)
+            else:
+                print(*shown, sep=sep, end=end, file=file, flush=flush)
+
+        jax.debug.callback(_emit, *[raw[i] for i in traced_idx])
+        return
+    print(*args, sep=sep, end=end, file=file, flush=flush)
+
+
+class _StmtTransformer(ast.NodeTransformer):
+    """assert/print statements → convert_* calls (reference
+    assert_transformer.py / print_transformer.py)."""
+
+    def __init__(self):
+        self.changed = False
+
+    @staticmethod
+    def _all_constant(nodes):
+        return all(isinstance(n, ast.Constant) for n in nodes)
+
+    def visit_Assert(self, node):
+        self.generic_visit(node)
+        if self._all_constant([node.test]):
+            # `assert True`-style: can never see a tracer; leaving it
+            # untouched avoids forcing the re-exec path (whose
+            # closure-cell snapshot changes nonlocal visibility)
+            return node
+        self.changed = True
+        msg_thunk = ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=node.msg if node.msg is not None else _const(None))
+        return ast.Expr(value=ast.Call(
+            func=_load("__dy2static_convert_assert"),
+            args=[node.test, msg_thunk], keywords=[]))
+
+    def visit_Expr(self, node):
+        self.generic_visit(node)
+        call = node.value
+        if isinstance(call, ast.Call) and \
+                isinstance(call.func, ast.Name) and call.func.id == "print" \
+                and not self._all_constant(
+                    call.args + [k.value for k in call.keywords]):
+            self.changed = True
+            node.value = ast.Call(func=_load("__dy2static_convert_print"),
+                                  args=call.args, keywords=call.keywords)
+        return node
+
+
 _RUNTIME_HELPERS = {
+    "__dy2static_convert_assert": convert_assert,
+    "__dy2static_convert_print": convert_print,
     "__dy2static_convert_ifelse": convert_ifelse,
     "__dy2static_convert_while": convert_while_loop,
     "__dy2static_convert_for": convert_for,
@@ -1251,14 +1356,18 @@ def ast_transform(fn):
     norm = _ReturnNormalizer(_ret_fresh)
     norm.normalize_function(fdef)
     local_names = set(arg_names) | set(_assigned_names(fdef.body))
+    stmts = _StmtTransformer()
+    stmts.visit(fdef)
     tr = _ControlFlowTransformer(local_names)
     tr.visit(fdef)
     # logical rewrites alone don't justify re-exec'ing the function: a
     # pure-python `and`/`or` works identically untransformed (and a
     # tensor boolop OUTSIDE converted control flow keeps failing loudly,
     # as before). They ship only alongside a control-flow or
-    # return-normalization change.
-    if not (tr.changed or norm.changed):
+    # return-normalization change. assert/print rewrites DO justify
+    # re-exec on their own: to_static traces the whole function, so a
+    # bare assert/print sees tracers even without any control flow.
+    if not (tr.changed or norm.changed or stmts.changed):
         return fn
     # a name first CREATED inside both branches would be unbound at the
     # operand load; it is fn-local (assigned somewhere), so a top-of-body

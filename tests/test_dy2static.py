@@ -735,3 +735,82 @@ class TestEarlyReturnAndLogical:
         assert convert_logical_not(np.float32(0.0)) is True
         assert convert_logical_not(np.bool_(True)) is False
         assert convert_logical_not(0) is True
+
+
+class TestAssertPrintTransformers:
+    """assert/print statement conversion (reference
+    assert_transformer.py / print_transformer.py roles)."""
+
+    def test_concrete_assert_keeps_python_semantics(self):
+        def f(x):
+            assert x.sum() > 0, "must be positive"
+            return x * 2
+
+        fn = paddle.jit.to_static(f)
+        out = fn(paddle.to_tensor(np.ones((3,), np.float32)))
+        np.testing.assert_allclose(out.numpy(), np.full(3, 2.0))
+        with pytest.raises(AssertionError, match="must be positive"):
+            # eager path: concrete tensor pred materializes
+            f(paddle.to_tensor(np.full((3,), -1.0, np.float32)))
+
+    def test_traced_assert_checks_at_runtime(self):
+        import jax
+
+        def f(x):
+            assert (x > 0).all(), "saw nonpositive"
+            return (x * x).sum()
+
+        g = ast_transform(f)
+        jf = jax.jit(lambda a: g(paddle.Tensor(a))._data)
+        # passing input: traced assert compiles and stays silent
+        ok = jf(np.full((3,), 5.0, np.float32))
+        jax.effects_barrier()
+        assert float(ok) == 75.0
+        # failing input: the host callback raises at RUN time
+        with pytest.raises(Exception, match="saw nonpositive"):
+            jf(np.full((3,), -1.0, np.float32))
+            jax.effects_barrier()
+
+    def test_traced_print_emits_runtime_values(self, capsys):
+        def f(x):
+            print(x)
+            return x + 1
+
+        g = ast_transform(f)
+
+        import jax
+
+        out = jax.jit(lambda a: g(paddle.Tensor(a))._data)(
+            np.full((2,), 3.0, np.float32))
+        jax.effects_barrier()
+        captured = capsys.readouterr().out
+        np.testing.assert_allclose(np.asarray(out), [4.0, 4.0])
+        assert "3." in captured  # runtime VALUES, not tracer reprs
+
+    def test_python_print_untouched(self, capsys):
+        def f(x):
+            print("scale:", 2)
+            return x * 2
+
+        g = ast_transform(f)
+        out = g(paddle.to_tensor(np.ones((2,), np.float32)))
+        assert "scale: 2" in capsys.readouterr().out
+        np.testing.assert_allclose(out.numpy(), [2.0, 2.0])
+
+    def test_print_kwargs_honored_in_traced_region(self, capsys):
+        import io
+        import jax
+
+        buf = io.StringIO()
+
+        def f(x):
+            print("v=", x, sep="", end="|", file=buf)
+            return x * 2
+
+        g = ast_transform(f)
+        out = jax.jit(lambda a: g(paddle.Tensor(a))._data)(
+            np.float32(3.0))
+        jax.effects_barrier()
+        np.testing.assert_allclose(np.asarray(out), 6.0)
+        assert buf.getvalue().startswith("v=3") and \
+            buf.getvalue().endswith("|")
