@@ -294,6 +294,35 @@ def _h_reduce_window_max(cv, eqn):
         pads=[p[0] for p in pad[2:]] + [p[1] for p in pad[2:]]))
 
 
+def _h_reduce_window_sum(cv, eqn):
+    """NCHW window SUM -> AveragePool(count_include_pad=1) * window_size
+    — exact, because count_include_pad divides by the FULL kernel size
+    everywhere (padded cells contribute zero to the sum either way)."""
+    wd = [int(w) for w in eqn.params["window_dimensions"]]
+    ws = [int(s) for s in eqn.params["window_strides"]]
+    pad = [(int(l), int(h)) for l, h in eqn.params["padding"]]
+    if len(wd) != 4 or wd[:2] != [1, 1] or ws[:2] != [1, 1] or \
+            pad[0] != (0, 0) or pad[1] != (0, 0):
+        raise NotImplementedError(
+            "ONNX export: reduce_window_sum supports NCHW spatial "
+            "pooling only")
+    if any(d != 1 for d in eqn.params.get("base_dilation", ()) or []) or \
+            any(d != 1 for d in eqn.params.get("window_dilation", ())
+                or []):
+        raise NotImplementedError("ONNX export: dilated pooling")
+    if not np.issubdtype(np.dtype(eqn.invars[0].aval.dtype), np.floating):
+        raise NotImplementedError(
+            "ONNX export: AveragePool (the reduce_window_sum lowering) "
+            "is float-only in ONNX; integer window sums unsupported")
+    avg = cv.emit("AveragePool", cv.in_names(eqn), kernel_shape=wd[2:],
+                  strides=ws[2:],
+                  pads=[p[0] for p in pad[2:]] + [p[1] for p in pad[2:]],
+                  count_include_pad=1)
+    count = cv.const(np.asarray(float(wd[2] * wd[3]),
+                                eqn.invars[0].aval.dtype))
+    cv.bind_out(eqn.outvars[0], cv.emit("Mul", [avg, count]))
+
+
 def _h_iota(cv, eqn):
     shape = [int(s) for s in eqn.params["shape"]]
     dim = int(eqn.params["dimension"])
@@ -418,6 +447,7 @@ _HANDLERS = {
     "dot_general": _h_dot_general,
     "conv_general_dilated": _h_conv,
     "reduce_window_max": _h_reduce_window_max,
+    "reduce_window_sum": _h_reduce_window_sum,
     "iota": _h_iota, "pad": _h_pad, "slice": _h_slice,
     "gather": _h_gather, "split": _h_split,
     "squeeze": _h_squeeze, "expand_dims": _h_squeeze,  # static reshapes

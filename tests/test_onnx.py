@@ -232,6 +232,21 @@ def run_graph(model, feeds):
             b = ins[2] if len(ins) > 2 else None
             r = _np_conv(ins[0], ins[1], b, at["strides"], at["pads"],
                          at["dilations"], at.get("group", 1))
+        elif op == "AveragePool":
+            assert at.get("count_include_pad") == 1
+            kh, kw = at["kernel_shape"]
+            pads = at.get("pads", [0, 0, 0, 0])
+            xp = np.pad(ins[0], ((0, 0), (0, 0),
+                                 (pads[0], pads[2]), (pads[1], pads[3])))
+            sh, sw = at["strides"]
+            oh = (xp.shape[2] - kh) // sh + 1
+            ow = (xp.shape[3] - kw) // sw + 1
+            r = np.zeros((xp.shape[0], xp.shape[1], oh, ow), xp.dtype)
+            for ii in range(oh):
+                for jj in range(ow):
+                    r[:, :, ii, jj] = xp[:, :, ii * sh:ii * sh + kh,
+                                         jj * sw:jj * sw + kw].mean(
+                        axis=(2, 3))
         elif op == "MaxPool":
             r = _np_maxpool(ins[0], at["kernel_shape"], at["strides"],
                             at.get("pads", [0, 0, 0, 0]))
@@ -332,3 +347,20 @@ class TestOnnxExport:
         x = np.zeros((3, 5), np.float32)
         with pytest.raises(NotImplementedError, match="primitive"):
             paddle.onnx.export(TopK(), "/tmp/x", input_spec=[x])
+
+
+class TestOnnxPooling:
+    _roundtrip = TestOnnxExport._roundtrip
+
+    def test_bn_avgpool_classifier(self):
+        paddle.seed(8)
+        layer = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1),
+                              nn.BatchNorm2D(8), nn.ReLU(),
+                              nn.AvgPool2D(2), nn.Flatten(),
+                              nn.Linear(8 * 4 * 4, 5))
+        layer.eval()
+        x = np.random.default_rng(4).normal(
+            size=(2, 3, 8, 8)).astype(np.float32)
+        model = self._roundtrip(layer, [x], rtol=1e-4, atol=1e-4)
+        ops = {n["op"] for n in model["nodes"]}
+        assert "AveragePool" in ops
