@@ -127,6 +127,36 @@ class GradNode:
         return f"<GradNode {self.name}>"
 
 
+_seed_cache: dict = {}
+
+
+def _seed(shape, dtype, ones):
+    """Cached ones/zeros cotangent seed for (shape, dtype).
+
+    backward() mints a fresh seed array every step; under a captured
+    steady-state loop that is a per-step allocation AND an
+    identity-unstable leaf. Caching keeps the leaf object identical
+    across iterations (singleton identity class in the capture plan) and
+    drops the allocation. Same guard as Optimizer._scalar_input: while a
+    trace is active, always build fresh — a cached committed array
+    entering a later sharded jit becomes a hidden executable argument."""
+    from .dispatch import trace_state_clean
+
+    if not trace_state_clean():
+        return (jnp.ones if ones else jnp.zeros)(shape, dtype)
+    # key by the np.dtype OBJECT: .str is lossy for ml_dtypes customs
+    # (every same-width one reads '<V1', so float8_e4m3fn and int4 would
+    # share a cache slot); dtype objects hash and compare exactly
+    key = (bool(ones), tuple(shape), np.dtype(dtype))
+    hit = _seed_cache.get(key)
+    if hit is None:
+        if len(_seed_cache) > 256:
+            _seed_cache.clear()
+        hit = (jnp.ones if ones else jnp.zeros)(shape, dtype)
+        _seed_cache[key] = hit
+    return hit
+
+
 def _is_float(x) -> bool:
     return jnp.issubdtype(jnp.result_type(x), jnp.floating) or jnp.issubdtype(
         jnp.result_type(x), jnp.complexfloating
@@ -235,7 +265,7 @@ def _run_engine(seeds, retain_graph=False, capture=None):
         for i, (shape, dtype) in enumerate(node.out_avals):
             g = holder[i]
             if g is None:
-                g = jnp.zeros(shape, dtype)
+                g = _seed(shape, dtype, ones=False)
             if node.hooks and i in node.hooks:
                 from .tensor import Tensor
 
@@ -302,7 +332,7 @@ def backward(tensors, grad_tensors=None, retain_graph=False):
             if t.stop_gradient and t._grad_node is None:
                 continue
             g = (
-                jnp.ones(t._data.shape, t._data.dtype)
+                _seed(t._data.shape, t._data.dtype, ones=True)
                 if gt is None
                 else jnp.broadcast_to(
                     (gt._data if isinstance(gt, Tensor) else jnp.asarray(gt)).astype(
@@ -345,7 +375,7 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
             if t._grad_node is None:
                 continue
             g = (
-                jnp.ones(t._data.shape, t._data.dtype)
+                _seed(t._data.shape, t._data.dtype, ones=True)
                 if gt is None
                 else (gt._data if isinstance(gt, Tensor) else jnp.asarray(gt))
             )

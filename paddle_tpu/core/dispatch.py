@@ -165,6 +165,22 @@ def _vjp_kernel(fn, multi, n_in):
     return vjp_apply
 
 
+_inexact_memo: dict = {}
+
+
+def _is_inexact(dtype):
+    """Memoized jnp.issubdtype(dtype, inexact): runs per grad-requiring
+    input per dispatched op on the lazy grad path — the subdtype lattice
+    walk is measurable there, the answer per dtype never changes."""
+    r = _inexact_memo.get(dtype)
+    if r is None:
+        if len(_inexact_memo) > 64:
+            _inexact_memo.clear()
+        r = _inexact_memo[dtype] = bool(
+            jax.numpy.issubdtype(dtype, jax.numpy.inexact))
+    return r
+
+
 def _hashable_attrs(attrs):
     try:
         items = tuple(sorted(attrs.items()))
@@ -264,35 +280,47 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
     # iteration: O(1) device round trips instead of one per op. The
     # pullback node recomputes the op's forward inside jax.vjp at replay;
     # both copies land in one XLA module where CSE/fusion reconciles them.
+    # Steady state goes further: after K identical-signature iterations,
+    # lazy.build promotes the step to CAPTURED mode — these calls stop
+    # constructing nodes entirely (cursor verification against the
+    # captured trace) and the whole step replays as one cached,
+    # buffer-donating executable. See core/lazy.py.
     if _lazy.enabled() and needs_grad \
             and amp_cast_hook is None and capture_sink is None \
             and not _flags._FLAGS["FLAGS_check_nan_inf"]:
         lkey = _lazy.fn_key(fn)
         lattrs = _lazy.attrs_key(attrs) if lkey is not None else None
-        # int/bool inputs marked differentiable would yield float0
-        # cotangents the sanitized pullback can't represent — bail to the
-        # eager vjp for those (rare) ops
-        diffable = all(
-            not (isinstance(t, Tensor) and not t.stop_gradient)
-            or jax.numpy.issubdtype(
-                (t._data.dtype if hasattr(t._data, "dtype")
-                 else jax.numpy.result_type(t._data)), jax.numpy.inexact)
-            for t in inputs)
-        if lkey is not None and lattrs is not None and diffable:
-            raw = [unwrap(x) for x in inputs]
-            out = _lazy.build(fn, name, raw, attrs, lkey, lattrs)
-            multi = isinstance(out, tuple)
-            outs_flat = list(out) if multi else [out]
-            out_avals = [(o.shape, o.dtype) for o in outs_flat]
-            edges = []
-            for t in inputs:
-                if isinstance(t, Tensor) and not t.stop_gradient:
+        # single pass per input: edge wiring + the float0 guard (int/bool
+        # inputs marked differentiable would yield float0 cotangents the
+        # sanitized pullback can't represent — bail to the eager vjp for
+        # those rare ops). Fused because this runs per dispatched op in
+        # the captured-loop hot path.
+        diffable = lkey is not None and lattrs is not None
+        edges = []
+        raw = []
+        for t in inputs:
+            if isinstance(t, Tensor):
+                d = t._data
+                raw.append(d)
+                if not t.stop_gradient:
+                    if diffable and not _is_inexact(
+                            d.dtype if hasattr(d, "dtype")
+                            else jax.numpy.result_type(d)):
+                        diffable = False
                     if t._grad_node is not None:
                         edges.append((t._grad_node, t._out_idx))
                     else:
                         edges.append(("leaf", t))
                 else:
                     edges.append(None)
+            else:
+                raw.append(t)
+                edges.append(None)
+        if diffable:
+            out = _lazy.build(fn, name, raw, attrs, lkey, lattrs)
+            multi = isinstance(out, tuple)
+            outs_flat = list(out) if multi else [out]
+            out_avals = [(o.shape, o.dtype) for o in outs_flat]
             vfn = _vjp_kernel(fn, multi, len(raw))
             # composed from the op's stable key — vfn itself is a fresh
             # closure whose identity would defeat the segment cache

@@ -17,10 +17,31 @@ Scope (documented, enforced by dispatch.forward's gate): applies to
 no-grad, no-AMP-cast, non-recorded ops. Ops needing the tape, an autocast
 plan, or the static recorder run eagerly (lazy inputs are forced first),
 so correctness never depends on laziness.
+
+Steady-state step capture (this round): after _CAPTURE_K consecutive
+materializations of a segment with an IDENTICAL signature (same op
+sequence, keys, input avals), the segment is promoted to *captured*
+mode. Subsequent iterations stop re-recording at the Python level:
+each dispatched op is verified against the captured trace by a cursor
+(a tuple compare + input-wiring identity check, no _Node construction,
+no eval_shape) and handed a lightweight placeholder; the first force
+invokes the cached whole-step executable directly on the live
+parameter/optimizer buffers. Any divergence — new op, shape change,
+different wiring, a mid-step force — falls back by re-recording the
+verified prefix through the normal path (placeholders are transplanted
+onto the real nodes), so capture is never load-bearing for
+correctness. Loop-carried buffers (parameter/optimizer slots, flagged
+by the optimizers via Tensor._donatable) are donated to the captured
+executable once their carry pattern is stable, so updates happen in
+place instead of allocating fresh HBM; a donated buffer's placeholder
+slot is poisoned so a stale read raises instead of returning garbage.
+See DESIGN_DECISIONS.md ("Step capture lifecycle") for the full state
+machine and bail-out conditions.
 """
 from __future__ import annotations
 
 import itertools
+import os
 import threading
 import weakref
 
@@ -29,7 +50,7 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["LazyArray", "enabled", "lazy_guard", "build", "force",
-           "stats"]
+           "stats", "capture_guard", "donate_guard"]
 
 _state = threading.local()
 
@@ -41,7 +62,18 @@ from collections import OrderedDict
 
 _exec_cache: OrderedDict = OrderedDict()
 _EXEC_CACHE_MAX = 512
-_counters = {"materializations": 0, "cache_hits": 0, "nodes_built": 0}
+_counters = {"materializations": 0, "cache_hits": 0, "nodes_built": 0,
+             "replay_ops": 0, "captured_steps": 0, "capture_promotions": 0,
+             "capture_fallbacks": 0, "donated_steps": 0}
+
+# Step-capture knobs. _CAPTURE_K = consecutive identical-signature
+# materializations before promotion (>= 2: one to build the signature,
+# one to prove it steady).
+_CAPTURE_K = max(2, int(os.environ.get("PADDLE_TPU_CAPTURE_K", "3")))
+_capture_default = os.environ.get(
+    "PADDLE_TPU_STEP_CAPTURE", "1").lower() not in ("0", "false", "off")
+_donate_default = os.environ.get(
+    "PADDLE_TPU_CAPTURE_DONATE", "1").lower() not in ("0", "false", "off")
 
 # The lazy ON/OFF state is thread-local but the caches above are shared;
 # concurrent materialization from two threads would interleave OrderedDict
@@ -73,6 +105,48 @@ class lazy_guard:
 def stats():
     """Counters for tests/diagnostics."""
     return dict(_counters)
+
+
+class _tl_guard:
+    """Context manager flipping one thread-local override flag."""
+
+    _attr: str = ""
+
+    def __init__(self, flag=True):
+        self._flag = bool(flag)
+
+    def __enter__(self):
+        self._prev = getattr(_state, self._attr, None)
+        setattr(_state, self._attr, self._flag)
+        return self
+
+    def __exit__(self, *exc):
+        setattr(_state, self._attr, self._prev)
+        return False
+
+
+class capture_guard(_tl_guard):
+    """Enable/disable steady-state step capture (thread-local override of
+    the PADDLE_TPU_STEP_CAPTURE default). Used by tests and by callers
+    that need the plain record-every-step behavior for comparison."""
+
+    _attr = "capture_on"
+
+
+class donate_guard(_tl_guard):
+    """Enable/disable buffer donation inside captured steps."""
+
+    _attr = "donate_on"
+
+
+def _capture_enabled():
+    on = getattr(_state, "capture_on", None)
+    return _capture_default if on is None else on
+
+
+def _donate_enabled():
+    on = getattr(_state, "donate_on", None)
+    return _donate_default if on is None else on
 
 
 # strong refs for id-keyed objects (jnp singleton fns AND code objects):
@@ -108,10 +182,16 @@ def fn_key(fn):
     code = getattr(fn, "__code__", None)
     if len(_pinned) > 8192:
         return None  # runaway distinct callables: stop pinning/caching
+    # already-pinned fast path: a membership probe on a plain dict is safe
+    # without the lock in CPython, and this runs once per dispatched op —
+    # in a captured steady-state loop it is the costliest survivor of the
+    # per-op gate, so the lock is only taken on first sight
     if code is None:
-        with _lock:
-            _pinned[id(fn)] = fn
-        return ("id", id(fn))
+        i = id(fn)
+        if i not in _pinned:
+            with _lock:
+                _pinned[i] = fn
+        return ("id", i)
     cells = ()
     if fn.__closure__:
         try:
@@ -119,9 +199,11 @@ def fn_key(fn):
             hash(cells)
         except (ValueError, TypeError):
             return None  # empty cell / unhashable capture (e.g. an array)
-    with _lock:
-        _pinned[id(code)] = code  # dynamically-created code can be GC'd too
-    return (id(code), cells)
+    ci = id(code)
+    if ci not in _pinned:
+        with _lock:
+            _pinned[ci] = code  # dynamically-created code can be GC'd too
+    return (ci, cells)
 
 
 _aval_cache: dict = {}
@@ -163,7 +245,7 @@ class _Node:
 
     __slots__ = ("fn", "attrs", "inputs", "name", "avals", "values",
                  "multi", "key", "attrs_key", "refs", "serial",
-                 "sig_entry")
+                 "sig_entry", "donate_mask", "consumers", "__weakref__")
 
     def __init__(self, fn, attrs, inputs, name, key, attrs_key):
         self.fn = fn
@@ -172,10 +254,17 @@ class _Node:
         self.name = name
         self.key = key  # precomputed by the dispatch gate (hot path)
         self.attrs_key = attrs_key
+        # per-output bitmask: output slot was held by a donation-flagged
+        # Tensor (optimizer param/state slot) — consumed by step capture
+        self.donate_mask = 0
+        self.consumers = []
         self.multi, self.avals = _infer_avals(fn, key, attrs, inputs,
                                               attrs_key)
         self.values = None  # tuple of jax.Array once materialized
-        self.refs = weakref.WeakSet()  # live LazyArrays viewing this node
+        # weakrefs to LazyArrays viewing this node (plain list: cheaper
+        # than a WeakSet per node; stale entries are skipped on iteration
+        # and nodes are short-lived)
+        self.refs = []
         # Segment-signature entry, precomputed ONCE at record time
         # (round 5, VERDICT item 6: the per-step Python re-record cost
         # was dominated by rebuilding the signature structure every
@@ -198,6 +287,24 @@ class _Node:
                               len(self.avals))
         else:
             self.sig_entry = None
+        # Register as a consumer on pending producers, LAST — after
+        # _infer_avals above has either succeeded or raised, so a failed
+        # op (bad shapes) never leaves a half-initialized node reachable
+        # from the graph. A live pending consumer OUTSIDE a materializing
+        # segment (a train loop's deferred vjp nodes reading forward
+        # intermediates) forces the output to be stored: segments then
+        # PARTITION the op stream instead of re-collecting (and
+        # re-executing) the producer subgraph in the next segment —
+        # required for step capture's one-dispatch-one-trace-slot
+        # invariant, and it drops the hidden forward recompute the old
+        # keep rule caused.
+        wr = None
+        for inp in inputs:
+            if isinstance(inp, LazyArray) and inp.node.values is None \
+                    and type(inp.node) is _Node:
+                if wr is None:
+                    wr = weakref.ref(self)
+                inp.node.consumers.append(wr)
 
 
 def _aval_of(x):
@@ -212,20 +319,103 @@ class LazyArray:
     on first concrete use. Quacks like a jax.Array for the metadata the
     framework reads; any numeric coercion materializes the segment."""
 
-    __slots__ = ("node", "idx", "owners", "__weakref__")
+    __slots__ = ("node", "idx", "_own1", "_ownx", "_cur1", "_curx",
+                 "__weakref__")
 
     def __init__(self, node, idx=0):
         self.node = node
         self.idx = idx
-        # Tensors holding this payload, keyed by id: a WeakSet would hash
-        # and ==-compare Tensors, and Tensor.__eq__ is an elementwise OP
-        # (a duplicate add would dispatch it and recurse)
-        self.owners = weakref.WeakValueDictionary()
-        node.refs.add(self)
+        # Owner tracking, two levels (weakrefs — holding Tensors alive
+        # here would leak every intermediate):
+        #   sticky owners (_own1/_ownx): any Tensor that ever held the
+        #     payload and is still alive. The keep-mask depends on it —
+        #     an optimizer rebinds p._data past the update placeholder
+        #     BEFORE the step materializes, yet the update must still be
+        #     an executable output.
+        #   current holders (_cur1/_curx): who holds the payload RIGHT
+        #     NOW (disown removes). That is the donation-safety signal —
+        #     a buffer may only be donated when no live Tensor can read
+        #     it anymore.
+        # Single-slot fast path + overflow list: dispatch wraps every op
+        # output in exactly one Tensor, so the common case is one owner;
+        # per-payload weak-container construction (WeakValueDictionary,
+        # WeakSet) was the hottest line of the captured-step profile.
+        self._own1 = None
+        self._ownx = None
+        self._cur1 = None
+        self._curx = None
+        node.refs.append(weakref.ref(self))
 
-    def own(self, tensor):
-        """Register a Tensor currently holding this payload (keep-mask)."""
-        self.owners[id(tensor)] = tensor
+    def own(self, tensor, donatable=False):
+        """Register a Tensor holding this payload (keep-mask + current
+        holder). `donatable` marks the output slot as an
+        optimizer-managed buffer (param / accumulator): step capture may
+        donate it to the captured executable once it is loop-carried and
+        has no current holder."""
+        wr = weakref.ref(tensor)
+        o = self._own1
+        if o is None or o() is None:
+            self._own1 = wr
+        elif o() is not tensor:
+            if self._ownx is None:
+                self._ownx = [wr]
+            else:
+                self._ownx.append(wr)
+        c = self._cur1
+        if c is None or c() is None:
+            self._cur1 = wr
+        elif c() is not tensor:
+            if self._curx is None:
+                self._curx = [wr]
+            else:
+                self._curx.append(wr)
+        if donatable:
+            self.node.donate_mask |= 1 << self.idx
+
+    def disown(self, tensor):
+        """Drop a Tensor from the CURRENT-holder set (its _data was
+        rebound away). The sticky owner set is untouched — the keep-mask
+        must still see the rebound-away output as live."""
+        c = self._cur1
+        if c is not None and c() is tensor:
+            x = self._curx
+            self._cur1 = x.pop() if x else None
+            if x is not None and not x:
+                self._curx = None
+            return
+        x = self._curx
+        if x:
+            for i, r in enumerate(x):
+                if r() is tensor:
+                    del x[i]
+                    break
+            if not x:
+                self._curx = None
+
+    def has_owner(self):
+        """Any live Tensor ever held this payload (keep-mask test)."""
+        r = self._own1
+        if r is not None and r() is not None:
+            return True
+        x = self._ownx
+        if x:
+            for r in x:
+                if r() is not None:
+                    return True
+        return False
+
+    def has_current(self):
+        """Some live Tensor holds this payload right now (donation
+        blocker)."""
+        r = self._cur1
+        if r is not None and r() is not None:
+            return True
+        x = self._curx
+        if x:
+            for r in x:
+                if r() is not None:
+                    return True
+        return False
 
     # ---- metadata (no materialization) ----
     @property
@@ -250,9 +440,24 @@ class LazyArray:
 
     # ---- materialization ----
     def _force(self):
-        if self.node.values is None:
-            _materialize(self.node)
-        return self.node.values[self.idx]
+        node = self.node
+        if node.values is None:
+            if type(node) is _ReplayNode:
+                node.session._on_force(node)
+                node = self.node  # a fallback transplants us onto a _Node
+                if node.values is None:
+                    _materialize(node)
+            else:
+                _materialize(node)
+        v = self.node.values[self.idx]
+        if v is _DONATED:
+            raise RuntimeError(
+                "read of a buffer donated to a captured train-step "
+                "executable: a Tensor held this payload across the "
+                "optimizer update that invalidated it. Re-read the live "
+                "parameter/optimizer slot instead, or disable donation "
+                "with PADDLE_TPU_CAPTURE_DONATE=0.")
+        return v
 
     def __array__(self, dtype=None):
         a = np.asarray(self._force())
@@ -317,7 +522,51 @@ def lazy_add(a, b):
 def build(fn, name, input_arrays, attrs, key, attrs_key):
     """Record one op over (Lazy or concrete) input arrays; returns a
     LazyArray (or tuple of them for multi-output fns). `key`/`attrs_key`
-    come precomputed from the dispatch gate (both are non-None there)."""
+    come precomputed from the dispatch gate (both are non-None there).
+
+    Captured fast path: with a replay session active, the op is verified
+    against the captured trace instead of being recorded (no _Node, no
+    eval_shape); with no session active, an op matching a promoted
+    plan's first entry starts one. Verification failure falls back to
+    this function's normal record path (the session re-records its
+    prefix first), so capture never changes results."""
+    # capture_guard(False) must bypass ALREADY-PROMOTED plans too, not
+    # just promotion: sessions neither start nor continue while disabled
+    # (an in-flight session's placeholders re-record via the force-time
+    # fallback path)
+    no_cap = getattr(_state, "no_capture", False) or not _capture_enabled()
+    sess = None if no_cap else getattr(_state, "session", None)
+    if sess is not None:
+        out = sess.record(fn, name, input_arrays, attrs, key, attrs_key)
+        if out is _SESSION_DONE:
+            # complete session awaits its force (reachable through its
+            # placeholders); this op may start the next captured segment
+            _state.session = None
+            sess = None
+        elif out is not NotImplemented:
+            return out
+        else:
+            sess = False  # diverged: record this op plainly, no new session
+    if sess is None and not no_cap and key is not None \
+            and attrs_key is not None:
+        plans = getattr(_state, "plans", None)
+        if plans:
+            plan = plans.get((key, attrs_key, name, len(input_arrays)))
+            if plan is not None:
+                new = _Session(plan)
+                out = new.record(fn, name, input_arrays, attrs, key,
+                                 attrs_key)
+                if out is not NotImplemented and out is not _SESSION_DONE:
+                    _state.session = new
+                    return out
+    # a pending CAPTURED placeholder reaching the normal record path
+    # (mixed mode right after a divergence): resolve it now — forcing
+    # executes (or falls back) its owning session, so _Node/_collect
+    # only ever see real nodes or materialized leaves
+    for x in input_arrays:
+        if isinstance(x, LazyArray) and x.node.values is None \
+                and type(x.node) is _ReplayNode:
+            x._force()
     node = _Node(fn, attrs, list(input_arrays), name, key, attrs_key)
     _counters["nodes_built"] += 1
     if node.multi:
@@ -326,25 +575,47 @@ def build(fn, name, input_arrays, attrs, key, attrs_key):
 
 
 def _collect(root):
-    """Topological order of unmaterialized nodes feeding `root` —
-    iterative (lazy mode exists to accumulate LONG segments; recursive
-    DFS would hit the Python recursion limit around 1000 ops)."""
-    topo, seen = [], set()
-    stack = [(root, False)]
+    """Pending nodes to run when `root` is forced, in topological order.
+
+    The segment is the CONSUMER CLOSURE, not just the ancestor cone:
+    starting from the root, expand through pending inputs AND through
+    live pending consumers, to a fixpoint. In a train loop the loss
+    force then pulls the already-recorded backward and optimizer-update
+    nodes into the SAME segment — one self-contained fwd+bwd+update
+    executable per step, with activations fused inside it — instead of
+    deferring them to the next step's segment, which re-ran the whole
+    forward a second time (the vjp recompute crossed the executable
+    boundary, where XLA CSE cannot reach) and shipped every intermediate
+    through HBM as an executable output. Unrelated pending graphs are
+    untouched: they are not consumers of anything in the closure.
+
+    Iterative (lazy mode exists to accumulate LONG segments; recursion
+    would hit the Python limit around 1000 ops). Topological order is
+    serial order: an op's inputs always exist — and hold smaller
+    serials — before it records."""
+    seen = {id(root): root}
+    stack = [root]
     while stack:
-        node, expanded = stack.pop()
-        if expanded:
-            topo.append(node)
-            continue
-        if id(node) in seen:
-            continue
-        seen.add(id(node))
-        stack.append((node, True))
-        for inp in node.inputs:
-            if isinstance(inp, LazyArray) and inp.node.values is None \
-                    and id(inp.node) not in seen:
-                stack.append((inp.node, False))
+        n = stack.pop()
+        for inp in n.inputs:
+            if isinstance(inp, LazyArray):
+                nd = inp.node
+                if nd.values is None and id(nd) not in seen:
+                    seen[id(nd)] = nd
+                    stack.append(nd)
+        for wr in n.consumers:
+            c = wr()
+            if c is not None and type(c) is _Node and c.values is None \
+                    and id(c) not in seen:
+                seen[id(c)] = c
+                stack.append(c)
+    topo = list(seen.values())
+    topo.sort(key=_serial_of)
     return topo
+
+
+def _serial_of(n):
+    return n.serial
 
 
 def _signature(topo):
@@ -397,19 +668,21 @@ def _signature(topo):
     return (tuple(sig), leaf_avals), leaves
 
 
-def _make_replay(topo_template, keep):
+def _build_replay(topo_template, keep):
     """Build a pure replay fn for a segment STRUCTURE: takes the flat leaf
     list, returns outputs only for `keep`-marked nodes (the root plus
     nodes with live external LazyArray references) — purely-internal
     intermediates stay inside the jit where XLA fuses/DCEs them instead
-    of forcing one HBM output buffer per op."""
+    of forcing one HBM output buffer per op. Internal-vs-leaf inputs are
+    decided by topo MEMBERSHIP (not pendingness) so the same builder
+    works pre-run (_materialize) and post-run (capture-plan build)."""
     # capture per-node (fn, attrs, input wiring) — structure only
     wiring = []
     index = {id(n): i for i, n in enumerate(topo_template)}
     for n in topo_template:
         ins = []
         for inp in n.inputs:
-            if isinstance(inp, LazyArray) and inp.node.values is None:
+            if isinstance(inp, LazyArray) and id(inp.node) in index:
                 ins.append(("n", index[id(inp.node)], inp.idx))
             else:
                 ins.append(("l", None))  # position assigned at call
@@ -432,22 +705,484 @@ def _make_replay(topo_template, keep):
                        else (out,))
         return tuple(e for e, k in zip(env, keep) if k)
 
-    return jax.jit(replay)
+    return replay
+
+
+def _make_replay(topo_template, keep):
+    return jax.jit(_build_replay(topo_template, keep))
+
+
+def _make_expander(inner, class_of):
+    """Wrap a replay body so the executable takes one argument per UNIQUE
+    buffer (leaf positions holding the same array are collapsed to one
+    parameter). Required for donation — XLA rejects a buffer that enters
+    an executable both donated and non-donated — and it shrinks the
+    argument list of the captured step."""
+    def expand(*uleaves):
+        return inner([uleaves[c] for c in class_of])
+
+    return expand
+
+
+# ===================== steady-state step capture ============================
+
+# poison value for an output slot whose buffer was donated: any late read
+# raises loudly (LazyArray._force) instead of returning a dead buffer
+_DONATED = object()
+
+# returned by _Session.record when the session's trace is complete and the
+# op belongs to the NEXT segment: the caller hands the op to a fresh
+# session (sessions chain — vjp ops of step k arrive before step k's loss
+# force executes the session that ends with step k's forward)
+_SESSION_DONE = object()
+
+
+class _ReplayNode:
+    """Placeholder anchor for one captured op's outputs: carries the
+    promotion-time avals (shared objects, zero per-step inference) and
+    receives values when the captured executable runs. No fn/inputs —
+    that is the point: nothing is re-recorded."""
+
+    __slots__ = ("avals", "multi", "values", "refs", "donate_mask",
+                 "session", "rec_idx", "__weakref__")
+
+    def __init__(self, avals, multi, session, rec_idx):
+        self.avals = avals
+        self.multi = multi
+        self.values = None
+        self.refs = []  # weakrefs to viewing LazyArrays (see _Node.refs)
+        self.donate_mask = 0
+        self.session = session
+        self.rec_idx = rec_idx
+
+
+class _CapturePlan:
+    """Captured trace of one steady-state segment (normally a whole train
+    step: fwd + bwd + optimizer update).
+
+    ops[r] = (key, attrs_key, name, in_refs, avals, multi) in RECORD
+    order; in_refs entries are ("n", producer_rec_idx, out_idx) for
+    intra-segment wiring or ("l", leaf_pos, shape, dtype) for leaves.
+    Leaf positions follow the topo-order collection of _signature so the
+    replay body's argument order is reproduced exactly."""
+
+    __slots__ = ("key", "first_sig", "ops", "n_leaves", "classes",
+                 "class_of", "multi_classes", "keep_rec", "unkept_rec",
+                 "inner", "exec_plain", "exec_donate", "donate_classes",
+                 "carry", "carry_confirmed", "last_out", "misses")
+
+
+def _build_plan(key, topo, keep, leaves, outs):
+    """Construct a _CapturePlan from a just-materialized steady segment.
+    Must run BEFORE _materialize breaks the graph (needs node inputs).
+    Returns None when the segment is not capturable."""
+    # topo IS record order: _collect returns the segment sorted by
+    # serial, and serials are assigned at record time — so topo index ==
+    # replay-cursor position, no permutation needed
+    index = {id(n): i for i, n in enumerate(topo)}
+    refs_by_topo = []
+    leaf_pos = 0
+    for n in topo:
+        refs = []
+        for inp in n.inputs:
+            if isinstance(inp, LazyArray) and id(inp.node) in index:
+                refs.append(("n", index[id(inp.node)], inp.idx))
+            else:
+                if leaf_pos >= len(leaves):
+                    return None
+                a = leaves[leaf_pos]
+                if hasattr(a, "dtype"):
+                    shp, dt = tuple(a.shape), a.dtype
+                else:
+                    shp, dt = np.shape(a), np.result_type(a)
+                refs.append(("l", leaf_pos, shp, dt))
+                leaf_pos += 1
+        refs_by_topo.append(tuple(refs))
+    if leaf_pos != len(leaves):
+        return None
+    plan = _CapturePlan()
+    plan.key = key
+    plan.ops = tuple(
+        (n.key, n.attrs_key, n.name, refs, n.avals, n.multi)
+        for n, refs in zip(topo, refs_by_topo))
+    # a replay must verify intra-segment wiring forward in record order
+    for r, (_, _, _, refs, _, _) in enumerate(plan.ops):
+        for ref in refs:
+            if ref[0] == "n" and ref[1] >= r:
+                return None
+    plan.first_sig = (plan.ops[0][0], plan.ops[0][1], plan.ops[0][2],
+                      len(plan.ops[0][3]))
+    plan.n_leaves = len(leaves)
+    byid: dict = {}
+    for p, a in enumerate(leaves):
+        byid.setdefault(id(a), []).append(p)
+    plan.classes = tuple(tuple(v) for v in byid.values())
+    class_of = [0] * len(leaves)
+    for c, cls in enumerate(plan.classes):
+        for p in cls:
+            class_of[p] = c
+    plan.class_of = tuple(class_of)
+    plan.multi_classes = tuple(c for c in plan.classes if len(c) > 1)
+    plan.keep_rec = tuple(i for i in range(len(topo)) if keep[i])
+    plan.unkept_rec = tuple(i for i in range(len(topo)) if not keep[i])
+    plan.inner = _build_replay(topo, keep)
+    plan.exec_plain = jax.jit(_make_expander(plan.inner, plan.class_of))
+    plan.exec_donate = None
+    plan.donate_classes = ()
+    plan.carry = None
+    plan.carry_confirmed = False
+    plan.last_out = [a for tup in outs for a in tup]
+    plan.misses = 0
+    return plan
+
+
+def _unregister_plan(plan):
+    plans = getattr(_state, "plans", None)
+    if plans is not None and plans.get(plan.first_sig) is plan:
+        del plans[plan.first_sig]
+    streaks = getattr(_state, "streaks", None)
+    if streaks is not None:
+        streaks.pop(plan.key, None)
+
+
+def _note_steady(key, topo, keep, leaves, outs):
+    """Promotion tracker, called by _materialize on every cache-keyable
+    segment run: K consecutive identical signatures promote the segment
+    to captured mode."""
+    if not _capture_enabled():
+        return
+    streaks = getattr(_state, "streaks", None)
+    if streaks is None:
+        streaks = _state.streaks = {}
+    n = streaks.get(key, 0) + 1
+    if len(streaks) > 64:
+        streaks.clear()
+    streaks[key] = n
+    if n < _CAPTURE_K:
+        return
+    plans = getattr(_state, "plans", None)
+    if plans is None:
+        plans = _state.plans = {}
+    if any(p.key == key for p in plans.values()):
+        return  # already captured (first-sig collision keeps re-running)
+    plan = _build_plan(key, topo, keep, leaves, outs)
+    if plan is None:
+        return
+    if plans.get(plan.first_sig) is not None:
+        # a LIVE plan for a different segment shares our first op: do not
+        # overwrite it — alternating same-first-op loops would otherwise
+        # rebuild plans (fresh jits) every materialization. The loser
+        # stays in record mode; it gets its chance when the incumbent
+        # misses out (3 consecutive fallbacks unregister it).
+        return
+    if len(plans) > 8:
+        plans.clear()
+    plans[plan.first_sig] = plan
+    _counters["capture_promotions"] += 1
+
+
+class _SessionAnchor:
+    """Stand-in consumer for a session's pending real-node leaves: makes
+    the boundary materialization (the last record-mode segment before
+    captured steady state) KEEP those outputs, so the session's first
+    exec reads stored values instead of re-forcing tiny recompute
+    segments. Quacks like a pending node for the keep rule."""
+
+    __slots__ = ("values", "__weakref__")
+
+    def __init__(self):
+        self.values = None
+
+
+class _Session:
+    """One captured-mode iteration: a cursor over the plan's op trace.
+    Created when a dispatched op matches a plan's first entry; ends by
+    executing the whole-step executable at the first force, or by
+    falling back to recording on any divergence."""
+
+    __slots__ = ("plan", "cursor", "nodes", "fns", "in_store", "done",
+                 "anchor")
+
+    def __init__(self, plan):
+        self.plan = plan
+        self.cursor = 0
+        self.nodes = [None] * len(plan.ops)
+        self.fns = [None] * len(plan.ops)
+        self.in_store = [None] * plan.n_leaves
+        self.done = False
+        self.anchor = _SessionAnchor()
+
+    # -- per-op verification (the captured hot path) --------------------
+    def record(self, fn, name, inputs, attrs, key, attrs_key):
+        plan = self.plan
+        c = self.cursor
+        ops = plan.ops
+        if c >= len(ops):
+            # trace complete, awaiting its force — this op starts the
+            # NEXT segment (build() hands it to a fresh session)
+            return _SESSION_DONE
+        ekey, eattrs, ename, erefs, avals, multi = ops[c]
+        if key != ekey or attrs_key != eattrs or name != ename \
+                or len(inputs) != len(erefs):
+            return self._fall()
+        nodes = self.nodes
+        store = self.in_store
+        for inp, ref in zip(inputs, erefs):
+            if ref[0] == "n":
+                if not (type(inp) is LazyArray
+                        and inp.node is nodes[ref[1]]
+                        and inp.idx == ref[2]):
+                    return self._fall()
+            else:
+                # a leaf may still be PENDING here (an output of the
+                # previous, complete-but-not-yet-forced session): only
+                # its aval is checked now; _execute forces it, which
+                # cascades the earlier session first. An output of THIS
+                # session is different: the plan says leaf but the
+                # wiring says intra-step (a same-aval divergence) — and
+                # force()-ing it at exec time would recurse into our own
+                # _execute. Fall back to recording.
+                if type(inp) is LazyArray:
+                    nd = inp.node
+                    if type(nd) is _ReplayNode and nd.session is self:
+                        return self._fall()
+                    a = nd.avals[inp.idx]
+                    shp, dt = a.shape, a.dtype
+                    if nd.values is None and type(nd) is _Node:
+                        # pending REAL node (pre-capture tail): anchor it
+                        # so the boundary materialization keeps it
+                        nd.consumers.append(weakref.ref(self.anchor))
+                elif hasattr(inp, "dtype"):
+                    shp, dt = tuple(inp.shape), inp.dtype
+                else:
+                    shp, dt = np.shape(inp), np.result_type(inp)
+                if shp != ref[2] or dt != ref[3]:
+                    return self._fall()
+                store[ref[1]] = inp
+        node = _ReplayNode(avals, multi, self, c)
+        nodes[c] = node
+        self.fns[c] = (fn, attrs)
+        self.cursor = c + 1
+        _counters["replay_ops"] += 1
+        if multi:
+            return tuple(LazyArray(node, i) for i in range(len(avals)))
+        return LazyArray(node, 0)
+
+    # -- divergence: re-record the verified prefix ----------------------
+    def _fall(self):
+        _counters["capture_fallbacks"] += 1
+        self.anchor.values = ()  # retire the keep anchor
+        plan = self.plan
+        plan.misses += 1
+        if getattr(_state, "session", None) is self:
+            _state.session = None
+        if plan.misses >= 3:
+            _unregister_plan(plan)
+        self._rerecord()
+        return NotImplemented
+
+    def _rerecord(self):
+        """Replay the verified prefix through the NORMAL record path and
+        transplant every handed-out placeholder onto the real node, so
+        Tensors / GradNode closures holding placeholders keep working."""
+        upto = self.cursor
+        if upto == 0:
+            return
+        prev = getattr(_state, "no_capture", False)
+        _state.no_capture = True  # a prefix op must not restart a session
+        try:
+            ops = self.plan.ops
+            outs = [None] * upto
+            for r in range(upto):
+                ekey, eattrs, name, erefs, avals, multi = ops[r]
+                fn, attrs = self.fns[r]
+                ins = []
+                for ref in erefs:
+                    if ref[0] == "n":
+                        ins.append(outs[ref[1]][ref[2]])
+                    else:
+                        ins.append(self.in_store[ref[1]])
+                out = build(fn, name, ins, attrs, ekey, eattrs)
+                flat = list(out) if multi else [out]
+                outs[r] = flat
+                rnode = self.nodes[r]
+                real = flat[0].node
+                real.donate_mask |= rnode.donate_mask
+                for wr in rnode.refs:
+                    la = wr()
+                    if la is not None:
+                        la.node = real
+                        real.refs.append(wr)
+                rnode.session = None
+        finally:
+            _state.no_capture = prev
+
+    # -- forcing a placeholder ------------------------------------------
+    def _on_force(self, node):
+        plan = self.plan
+        if self.done:
+            raise RuntimeError(
+                f"captured step: output of op {node.rec_idx} "
+                f"({plan.ops[node.rec_idx][2]}) was not an executable "
+                "output when the step was captured (no Tensor owned it) "
+                "and cannot be recomputed after the captured executable "
+                "ran. Hold the value in a Tensor across the step, or "
+                "disable capture with PADDLE_TPU_STEP_CAPTURE=0.")
+        if self.cursor == len(plan.ops) \
+                and node.rec_idx not in plan.unkept_rec:
+            self._execute()
+        else:
+            # mid-step force, or a force of an output the captured keep
+            # set doesn't store: this step diverges from the captured
+            # behavior — record it instead
+            self._fall()
+
+    # -- whole-step execution -------------------------------------------
+    def _execute(self):
+        plan = self.plan
+        nodes = self.nodes
+        # keep-set adequacy: an unkept placeholder now owned by a live
+        # Tensor would be unreadable after the run — bail BEFORE running
+        for r in plan.unkept_rec:
+            for wr in nodes[r].refs:
+                la = wr()
+                if la is not None and la.has_owner():
+                    self._fall()
+                    return
+        store = self.in_store
+        vals = [force(o) for o in store]
+        # the executable was compiled over deduplicated unique buffers:
+        # promotion-time identity classes must still hold
+        for cls in plan.multi_classes:
+            v0 = vals[cls[0]]
+            for p in cls[1:]:
+                if vals[p] is not v0:
+                    self._fall()
+                    return
+        classes = plan.classes
+        uvals = [vals[cls[0]] for cls in classes]
+        donate = plan.exec_donate is not None and _donate_enabled()
+        if donate:
+            for c, j in plan.donate_classes:
+                o = store[classes[c][0]]
+                if not (type(o) is LazyArray
+                        and uvals[c] is plan.last_out[j]
+                        and (o.node.donate_mask >> o.idx) & 1
+                        and not o.has_current()):
+                    donate = False
+                    break
+            if donate:
+                # a donated buffer must not also enter through another
+                # class (XLA rejects donated+non-donated aliasing)
+                counts: dict = {}
+                for v in uvals:
+                    i = id(v)
+                    counts[i] = counts.get(i, 0) + 1
+                for c, _ in plan.donate_classes:
+                    if counts[id(uvals[c])] != 1:
+                        donate = False
+                        break
+        exe = plan.exec_donate if donate else plan.exec_plain
+        outs = exe(*uvals)
+        for j, r in enumerate(plan.keep_rec):
+            nodes[r].values = tuple(outs[j])
+        self.done = True
+        self.anchor.values = ()  # retire the keep anchor
+        if getattr(_state, "session", None) is self:
+            _state.session = None
+        with _lock:
+            _counters["materializations"] += 1
+            _counters["cache_hits"] += 1
+            _counters["captured_steps"] += 1
+        plan.misses = 0
+        new_flat = [a for tup in outs for a in tup]
+        if donate:
+            _counters["donated_steps"] += 1
+            # poison the donated slots: a stale Tensor reading one gets a
+            # loud error, never a dead buffer
+            for c, _ in plan.donate_classes:
+                o = store[classes[c][0]]
+                if getattr(uvals[c], "is_deleted", _never)():
+                    nd = o.node
+                    v = list(nd.values)
+                    v[o.idx] = _DONATED
+                    nd.values = tuple(v)
+        elif plan.exec_donate is None and _donate_enabled():
+            self._update_carry(uvals, store)
+        plan.last_out = new_flat
+        # release per-step state: stored inputs must not pin buffers
+        self.in_store = ()
+        self.fns = ()
+        self.nodes = ()
+
+    def _update_carry(self, uvals, store):
+        """Learn which unique leaves are loop-carried optimizer buffers
+        (this step's input IS the previous step's output, held by a
+        donation-flagged slot). One observation proposes the map, a
+        second confirms it; then the donating executable is compiled."""
+        plan = self.plan
+        prev = plan.last_out
+        cand = {}
+        for c, cls in enumerate(plan.classes):
+            o = store[cls[0]]
+            if not (type(o) is LazyArray
+                    and (o.node.donate_mask >> o.idx) & 1
+                    and not o.has_current()):
+                continue
+            v = uvals[c]
+            js = [j for j, a in enumerate(prev) if a is v]
+            if len(js) == 1:
+                cand[c] = js[0]
+        if not plan.carry:
+            # first NON-EMPTY proposal is the baseline: the transition
+            # exec right after promotion sees pre-capture buffers that
+            # match nothing, and an empty baseline must not stick
+            plan.carry = cand
+            return
+        stable = {c: j for c, j in cand.items() if plan.carry.get(c) == j}
+        plan.carry = stable
+        if stable and not plan.carry_confirmed:
+            plan.carry_confirmed = True
+            plan.donate_classes = tuple(sorted(stable.items()))
+            plan.exec_donate = jax.jit(
+                _make_expander(plan.inner, plan.class_of),
+                donate_argnums=tuple(c for c, _ in plan.donate_classes))
+
+
+def _never():
+    return False
 
 
 def _materialize(root):
     """Compile + run the whole pending segment feeding `root` in one
     device round trip, filling values for externally-referenced nodes."""
     topo = _collect(root)
-    # keep = nodes whose outputs are OWNED by a live Tensor (registered
-    # by dispatch._wrap_out) or the root: only those become executable
-    # outputs; consumer-wiring references alone don't count, so dead
-    # intermediates stay inside the jit for XLA to fuse/DCE. An
-    # under-count is safe: an unkept node keeps its graph and recomputes
-    # on a late force (see below).
-    keep = tuple(
-        n is root or any(len(la.owners) > 0 for la in n.refs)
-        for n in topo)
+    # keep = the root, nodes whose outputs are OWNED by a live Tensor
+    # (registered by the Tensor._data setter), or nodes with a live
+    # PENDING consumer outside this segment (a deferred vjp node holding
+    # a forward intermediate): those become executable outputs. In-segment
+    # wiring alone doesn't count, so dead intermediates stay inside the
+    # jit for XLA to fuse/DCE. The out-of-segment-consumer rule makes
+    # consecutive segments PARTITION the recorded op stream (nothing is
+    # re-collected into the next segment), which step capture's replay
+    # cursor depends on. An under-count is safe: an unkept node keeps its
+    # graph and recomputes on a late force (see below).
+    in_seg = {id(n) for n in topo}
+
+    def _kept(n):
+        if n is root:
+            return True
+        for wr in n.refs:
+            la = wr()
+            if la is not None and la.has_owner():
+                return True
+        for wr in n.consumers:
+            c = wr()
+            if c is not None and c.values is None and id(c) not in in_seg:
+                return True
+        return False
+
+    keep = tuple(_kept(n) for n in topo)
     key, leaves = _signature(topo)
     if key is not None:
         key = (key, keep)
@@ -468,11 +1203,15 @@ def _materialize(root):
     kept = [n for n, k in zip(topo, keep) if k]
     for n, vals in zip(kept, outs):
         n.values = tuple(vals)
+    # steady-state promotion bookkeeping — must run before the graph
+    # break below (the capture plan reads node inputs/attrs)
+    if key is not None:
+        _note_steady(key, topo, keep, leaves, outs)
     # break the graph for MATERIALIZED nodes: a surviving output Tensor
     # must pin only its own node's values, not every upstream
     # intermediate/leaf of the segment. Unkept nodes keep their wiring so
-    # a late force (an ownership path the WeakSet missed) recomputes
-    # correctly instead of crashing.
+    # a late force (an ownership path the owner tracking missed)
+    # recomputes correctly instead of crashing.
     for n, k in zip(topo, keep):
         if k:
             n.fn = None

@@ -23,6 +23,10 @@ from .place import current_place, jax_device, place_of, Place
 
 
 def _to_array(data, dtype=None, place=None):
+    # hot path: every lazy op output wraps a LazyArray in a Tensor — skip
+    # the jax.Array ABC __instancecheck__ walk for it
+    if type(data) is _lazy.LazyArray and dtype is None:
+        return data
     if isinstance(data, Tensor):
         data = data._data
     if isinstance(data, (jax.Array,)) or hasattr(data, "aval"):
@@ -59,6 +63,14 @@ class Tensor:
         self.persistable = False
         self._hooks = []
 
+    # donation eligibility: optimizers flip this to True on parameters and
+    # accumulator slots they manage. Step capture (core/lazy.py) may then
+    # donate the buffer to the captured whole-step executable once it is
+    # loop-carried and this Tensor has rebound past it — updates happen in
+    # place instead of allocating fresh HBM. Class attribute, not a slot:
+    # the default costs nothing per instance.
+    _donatable = False
+
     @property
     def _data(self):
         return self._payload
@@ -69,10 +81,21 @@ class Tensor:
         # dispatch outputs) is what lets `p._data = new_lazy` in an
         # optimizer mark the update node as live — without it the segment
         # never records the node's values and every later iteration
-        # re-executes the whole history (round-4 lazy-grad lesson)
+        # re-executes the whole history (round-4 lazy-grad lesson).
+        # Rebinding DISOWNS the previous payload from its CURRENT-holder
+        # set only (the sticky keep-mask owner set is untouched: an
+        # optimizer rebinds p._data past the update placeholder before
+        # the step materializes, and that update must still be an
+        # executable output). An empty current-holder set on the old
+        # placeholder is what proves no Tensor can read the buffer after
+        # the captured step donates it.
+        old = getattr(self, "_payload", None)
+        if old is not None and isinstance(old, _lazy.LazyArray) \
+                and old is not value:
+            old.disown(self)
         self._payload = value
         if isinstance(value, _lazy.LazyArray):
-            value.own(self)
+            value.own(self, self._donatable)
 
     # -- basic introspection --------------------------------------------------
     @property

@@ -195,8 +195,13 @@ def _shard_map_call(group, fn, *arrays, in_specs, out_specs):
     # to_tensor outputs) are incompatible with a multi-device shard_map —
     # spread them over the group mesh first; tracers (executor replay under
     # jit) already compose and must not be device_put
+    # NOTE: PartitionSpec itself subclasses tuple on jax <= 0.4.37, so a
+    # bare isinstance(tuple) check would unpack a single spec into its
+    # axis entries and device_put with a raw string
+    from jax.sharding import PartitionSpec as _P
+
     specs = in_specs if isinstance(in_specs, tuple) \
-        else (in_specs,) * len(arrays)
+        and not isinstance(in_specs, _P) else (in_specs,) * len(arrays)
     placed = []
     for a, spec in zip(arrays, specs):
         if not isinstance(a, jax.core.Tracer):

@@ -1119,10 +1119,16 @@ def convert_print(*args, sep=" ", end="\n", file=None, flush=False):
 
 class _StmtTransformer(ast.NodeTransformer):
     """assert/print statements → convert_* calls (reference
-    assert_transformer.py / print_transformer.py)."""
+    assert_transformer.py / print_transformer.py).
 
-    def __init__(self):
+    `local_names` (args + assigned names of the function being
+    transformed): when `print` is among them the call resolves to the
+    user's local binding, not the builtin — rewriting it to
+    convert_print would silently swap in different behavior."""
+
+    def __init__(self, local_names=()):
         self.changed = False
+        self._locals = frozenset(local_names)
 
     @staticmethod
     def _all_constant(nodes):
@@ -1149,6 +1155,7 @@ class _StmtTransformer(ast.NodeTransformer):
         call = node.value
         if isinstance(call, ast.Call) and \
                 isinstance(call.func, ast.Name) and call.func.id == "print" \
+                and "print" not in self._locals \
                 and not self._all_constant(
                     call.args + [k.value for k in call.keywords]):
             self.changed = True
@@ -1356,7 +1363,7 @@ def ast_transform(fn):
     norm = _ReturnNormalizer(_ret_fresh)
     norm.normalize_function(fdef)
     local_names = set(arg_names) | set(_assigned_names(fdef.body))
-    stmts = _StmtTransformer()
+    stmts = _StmtTransformer(local_names)
     stmts.visit(fdef)
     tr = _ControlFlowTransformer(local_names)
     tr.visit(fdef)

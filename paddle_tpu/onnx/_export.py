@@ -338,6 +338,14 @@ def _h_pad(cv, eqn):
     cfg = [(int(l), int(h), int(i)) for l, h, i in eqn.params["padding_config"]]
     if any(i != 0 for _, _, i in cfg):
         raise NotImplementedError("ONNX export: interior padding")
+    if any(l < 0 or h < 0 for l, h, _ in cfg):
+        # lax.pad with negative lo/hi CROPS; ONNX Pad cannot express
+        # that, and emitting the negative amounts would serialize a
+        # silently invalid model (ONNX runtimes reject or misread it)
+        raise NotImplementedError(
+            "ONNX export: negative padding (cropping) — lax.pad with "
+            "negative lo/hi has no ONNX Pad equivalent; rewrite as a "
+            "slice")
     operand, value = (cv.name_of(v) for v in eqn.invars)
     pads = cv.const(np.asarray([c[0] for c in cfg] + [c[1] for c in cfg],
                                np.int64))

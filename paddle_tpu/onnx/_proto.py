@@ -122,8 +122,21 @@ def attr(name: str, value) -> bytes:
     elif isinstance(value, np.ndarray):
         out += fmsg(5, tensor_proto(name + "_t", value)) + fint(20, A_TENSOR)
     elif isinstance(value, (list, tuple)):
-        if value and isinstance(value[0], float):
-            out += b"".join(ffloat(7, v) for v in value) + fint(20, A_FLOATS)
+        # infer the list type from ALL elements, not just the first:
+        # [1, 2.5] must serialize as A_FLOATS (the old first-element rule
+        # truncated the 2.5 to an int), and a non-numeric element is a
+        # caller bug that must not serialize at all
+        is_num = lambda v: isinstance(v, (bool, int, float,  # noqa: E731
+                                          np.integer, np.floating))
+        if not all(is_num(v) for v in value):
+            bad = next(v for v in value if not is_num(v))
+            raise TypeError(
+                f"attr {name}: list element {bad!r} is neither int nor "
+                "float; mixed/non-numeric attribute lists are not "
+                "serializable")
+        if any(isinstance(v, (float, np.floating)) for v in value):
+            out += b"".join(ffloat(7, float(v)) for v in value) \
+                + fint(20, A_FLOATS)
         else:
             out += b"".join(fint(8, int(v)) for v in value) + fint(20, A_INTS)
     else:

@@ -181,20 +181,25 @@ class Adamax(Optimizer):
         self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
 
     def _apply_one(self, p, g):
-        lr = self._lr_for(p)
         b1, b2, eps = self._beta1, self._beta2, self._eps
-        t = self._opt_step
         m = self._acc("moment", p, dtype=jnp.float32)
         u = self._acc("inf_norm", p, dtype=jnp.float32)
+        # dynamic lr/step as INPUTS (see Adam._apply_one): a closure cell
+        # holding the changing step count would rotate this op's fn_key
+        # every iteration — the lazy segment cache would recompile each
+        # step and step capture could never see a steady signature
+        lr_t = self._scalar_input("lr", self._lr_for(p))
+        t_t = self._scalar_input("t", self._opt_step)
 
-        def f(w, gg, mm, uu):
+        def f(w, gg, mm, uu, lr, t):
             gf = gg.astype(jnp.float32)
             mm = b1 * mm + (1 - b1) * gf
             uu = jnp.maximum(b2 * uu, jnp.abs(gf))
             new = w.astype(jnp.float32) - lr / (1 - b1 ** t) * mm / (uu + eps)
             return new.astype(w.dtype), mm, uu
 
-        outs = forward(f, (p, g, m, u), name="adamax", nondiff=True)
+        outs = forward(f, (p, g, m, u, lr_t, t_t), name="adamax",
+                       nondiff=True)
         p._data, m._data, u._data = outs[0]._data, outs[1]._data, outs[2]._data
 
 

@@ -9,6 +9,7 @@ reference needed multi_tensor/fused_* ops for that — on TPU it's free).
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
 from ..core.dispatch import forward
 from ..core.tensor import Parameter, Tensor
@@ -29,6 +30,15 @@ class Optimizer:
                     "model.parameters()); static mode uses minimize().")
             parameters = []
         self._parameter_list = list(parameters)
+        # donation-awareness (step capture, core/lazy.py): parameters this
+        # optimizer updates are loop-carried slots — each step's input
+        # buffer is the previous step's update output and the Tensor
+        # rebinds past it in _apply_one. Flagging them lets the captured
+        # whole-step executable donate the old buffer (in-place update)
+        # once the Tensor no longer owns it; the flag alone never donates.
+        for p in self._parameter_list:
+            if p is not None:
+                p._donatable = True
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
         if isinstance(weight_decay, float):
@@ -70,22 +80,53 @@ class Optimizer:
         cache = getattr(self, "_scalar_cache", None)
         if cache is None:
             cache = self._scalar_cache = {}
-        key = (name, value)
-        hit = cache.get(key)
-        if hit is None:
-            if len(cache) > 16:
-                cache.clear()
-            hit = Tensor(jnp.asarray(value, jnp.float32))
-            cache[key] = hit
-        return hit
+        # one small value->tensor map PER NAME (the step count changes
+        # monotonically; lr takes a handful of values — scheduler steps
+        # and per-param optimize_attr multipliers). The old flat
+        # (name, value)-keyed LRU accumulated one step-count entry per
+        # iteration and its size-triggered clear could fire between two
+        # parameters of the SAME step, handing them different scalar
+        # objects — which broke the step-capture leaf identity classes
+        # once every cache-lifetime. A per-name map keeps hits for
+        # per-param lr multipliers too, and a per-name clear can only
+        # land before a value's FIRST use in a step (identity within the
+        # step is preserved: the re-created entry serves the rest).
+        by_name = cache.get(name)
+        if by_name is None:
+            by_name = cache[name] = {}
+        hit = by_name.get(value)
+        if hit is not None:
+            return hit
+        # 0-d NUMPY payload, not jnp.asarray: the step count changes
+        # every iteration, and minting a device scalar per step costs a
+        # full jax eager dispatch (~0.5 ms/step on CPU, measured) on the
+        # captured hot path. jit/XLA converts the numpy scalar at the
+        # executable boundary for free, and its aval is identical.
+        if len(by_name) > 64:
+            by_name.clear()
+        t = Tensor.__new__(Tensor)
+        t._data = np.asarray(value, np.float32)
+        t.stop_gradient = True
+        t.grad = None
+        t._grad_node = None
+        t._out_idx = 0
+        t.name = None
+        t.persistable = False
+        t._hooks = []
+        by_name[value] = t
+        return t
 
     # -- accumulators (reference Optimizer._add_accumulator) ------------------
     def _acc(self, name, p, init=0.0, dtype=None):
         store = self._accumulators.setdefault(name, {})
         key = id(p)
         if key not in store:
-            store[key] = Tensor(jnp.full(p._data.shape, init,
-                                         dtype or p._data.dtype))
+            t = Tensor(jnp.full(p._data.shape, init,
+                                dtype or p._data.dtype))
+            # accumulator slots are loop-carried like the params they
+            # track: donation-eligible under step capture (see __init__)
+            t._donatable = True
+            store[key] = t
         return store[key]
 
     # -- step -----------------------------------------------------------------
@@ -171,7 +212,9 @@ class Optimizer:
                 key = f"{name}/{p.name or id(p)}"
                 if p is not None and key in state_dict:
                     v = state_dict[key]
-                    store[id(p)] = v if isinstance(v, Tensor) else Tensor(v)
+                    t = v if isinstance(v, Tensor) else Tensor(v)
+                    t._donatable = True  # restored slot stays loop-carried
+                    store[id(p)] = t
 
     # -- static (declarative) mode hooks --------------------------------------
     _STATIC_ACCS: list[str] = []

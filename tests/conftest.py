@@ -24,6 +24,15 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 try:
     jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # jaxlib without the jax_num_cpu_devices option (<=0.4.37): the
+    # XLA_FLAGS --xla_force_host_platform_device_count fallback above
+    # provides the 8-device mesh — but only at backend init, so drop any
+    # backend sitecustomize already initialized (same reasoning as the
+    # RuntimeError branch below)
+    from jax.extend.backend import clear_backends
+
+    clear_backends()
 except RuntimeError:  # a backend already initialized — reset, then retry
     from jax.extend.backend import clear_backends
 

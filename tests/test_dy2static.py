@@ -814,3 +814,31 @@ class TestAssertPrintTransformers:
         np.testing.assert_allclose(np.asarray(out), 6.0)
         assert buf.getvalue().startswith("v=3") and \
             buf.getvalue().endswith("|")
+
+
+class TestPrintShadowing:
+    """ISSUE-2 satellite: the print→convert_print rewrite must not fire
+    when `print` is shadowed by a local binding."""
+
+    def test_shadowed_print_not_rewritten(self):
+        def f(x):
+            out = []
+            print = out.append  # noqa: A001 — deliberate shadow
+            print(float(x.numpy().sum()))
+            return x * 2, out
+
+        g = ast_transform(f)
+        y, out = g(paddle.to_tensor(np.ones((2,), np.float32)))
+        np.testing.assert_allclose(y.numpy(), [2.0, 2.0])
+        assert out == [2.0]  # the LOCAL print ran, not convert_print
+
+    def test_print_as_argument_not_rewritten(self):
+        def f(x, print):
+            print(x)
+            return x + 1
+
+        g = ast_transform(f)
+        seen = []
+        y = g(paddle.to_tensor(np.ones((2,), np.float32)), seen.append)
+        np.testing.assert_allclose(y.numpy(), [2.0, 2.0])
+        assert len(seen) == 1  # the parameter was called

@@ -167,3 +167,21 @@ class TestLazyModelLoop:
             for _ in range(1500):
                 z = z + 1.0
         np.testing.assert_allclose(z.numpy(), [1500.0, 1500.0])
+
+
+def test_failed_op_does_not_poison_pending_graph():
+    # code-review regression: an op whose shape inference raises (and
+    # whose exception is retained) must not leave a half-initialized
+    # node reachable through its producers' consumer lists — the next
+    # force of any graph sharing an input crashed before the fix
+    x = paddle.to_tensor(np.ones((4, 4), np.float32))
+    with paddle.no_grad(), paddle.incubate.lazy_eval():
+        h = x * 2.0
+        err = None
+        try:
+            h.matmul(paddle.to_tensor(np.ones((3, 3), np.float32)))
+        except Exception as e:  # noqa: BLE001 — retain it deliberately
+            err = e
+        out = np.asarray(h.numpy())
+    assert err is not None
+    np.testing.assert_allclose(out, np.full((4, 4), 2.0))

@@ -111,6 +111,40 @@ class TestLazyTrainLoop:
         np.testing.assert_allclose(g[1:4], np.ones((3, 8)), atol=1e-6)
         np.testing.assert_allclose(g[5:], np.zeros((5, 8)), atol=1e-6)
 
+    def test_steady_state_cache_hit_rate_no_capture(self):
+        # the pre-capture contract still holds with capture disabled:
+        # every steady-state step is one materialization + one segment
+        # cache hit (round 5 signature caching)
+        with lazy.capture_guard(False):
+            paddle.seed(3)
+            net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(),
+                                nn.Linear(12, 2))
+            opt = optimizer.Adam(learning_rate=0.01,
+                                 parameters=net.parameters())
+            x = paddle.to_tensor(np.random.default_rng(0).normal(
+                size=(16, 6)).astype(np.float32))
+            y = paddle.to_tensor(np.random.default_rng(1).normal(
+                size=(16, 2)).astype(np.float32))
+
+            def step():
+                with paddle.incubate.lazy_eval():
+                    loss = ((net(x) - y) ** 2).mean()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+                    return float(loss)
+
+            for _ in range(5):
+                step()
+            s0 = lazy.stats()
+            for _ in range(20):
+                step()
+            s1 = lazy.stats()
+            mats = s1["materializations"] - s0["materializations"]
+            hits = s1["cache_hits"] - s0["cache_hits"]
+            assert mats == 20, mats
+            assert hits == 20, f"steady-state key wobble: {hits}/20 hits"
+
     def test_steady_state_cache_hit_rate(self):
         # round 5 (VERDICT item 6): signature entries are precomputed at
         # record time with serial-distance refs + a drift bitmask for
@@ -145,3 +179,213 @@ class TestLazyTrainLoop:
         hits = s1["cache_hits"] - s0["cache_hits"]
         assert mats == 20, mats
         assert hits == 20, f"steady-state key wobble: {hits}/20 hits"
+
+
+class TestStepCapture:
+    """ISSUE 2 tentpole: steady-state step capture-and-replay with buffer
+    donation (core/lazy.py). After _CAPTURE_K identical-signature steps
+    the loop is promoted to captured mode: zero Python-level op
+    re-recording, whole-step replay from the live parameter/optimizer
+    buffers, in-place (donated) updates, record-mode fallback on any
+    divergence."""
+
+    def _mk(self, seed=11, dtype=None):
+        paddle.seed(seed)
+        net = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        if dtype is not None:
+            for p in net.parameters():
+                p._data = p._data.astype(dtype)
+        opt = optimizer.Adam(learning_rate=0.01,
+                             parameters=net.parameters())
+        return net, opt
+
+    @staticmethod
+    def _data(dtype=np.float32, batch=16):
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(batch, 8)).astype(np.float32)
+        y = rng.normal(size=(batch, 4)).astype(np.float32)
+        import jax.numpy as jnp
+
+        xt = paddle.to_tensor(jnp.asarray(x, dtype))
+        yt = paddle.to_tensor(jnp.asarray(y, dtype))
+        return xt, yt
+
+    @staticmethod
+    def _step(net, opt, xt, yt):
+        with paddle.incubate.lazy_eval():
+            loss = ((net(xt) - yt) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return float(loss)
+
+    def test_promotion_after_k_identical_steps_and_zero_rerecord(self):
+        net, opt = self._mk()
+        xt, yt = self._data()
+        s_start = lazy.stats()
+        losses = [self._step(net, opt, xt, yt) for _ in range(8)]
+        s_mid = lazy.stats()
+        assert s_mid["capture_promotions"] - s_start["capture_promotions"] \
+            >= 1, "no promotion after K identical steps"
+        # the dispatch-counter contract: captured steps perform ZERO
+        # Python-level op re-recording — nodes_built must stay flat
+        # while captured_steps advances
+        for _ in range(5):
+            self._step(net, opt, xt, yt)
+        s0 = lazy.stats()
+        for _ in range(6):
+            self._step(net, opt, xt, yt)
+        s1 = lazy.stats()
+        assert s1["captured_steps"] - s0["captured_steps"] == 6
+        assert s1["nodes_built"] == s0["nodes_built"], (
+            "captured steps still re-record ops: "
+            f"{s1['nodes_built'] - s0['nodes_built']} nodes built")
+        assert s1["materializations"] - s0["materializations"] == 6
+        assert all(np.isfinite(losses))
+
+    def test_fallback_on_shape_change(self):
+        net, opt = self._mk()
+        xt, yt = self._data()
+        for _ in range(10):
+            self._step(net, opt, xt, yt)
+        s0 = lazy.stats()
+        # shape change mid-loop: must fall back to recording without
+        # error or wrong results, then keep training
+        xt2, yt2 = self._data(batch=9)
+        l_small = [self._step(net, opt, xt2, yt2) for _ in range(3)]
+        s1 = lazy.stats()
+        assert s1["capture_fallbacks"] > s0["capture_fallbacks"]
+        assert all(np.isfinite(l_small))
+        # returning to the captured shape resumes replay
+        self._step(net, opt, xt, yt)
+        s2 = lazy.stats()
+        for _ in range(3):
+            self._step(net, opt, xt, yt)
+        s3 = lazy.stats()
+        assert s3["captured_steps"] > s2["captured_steps"]
+
+    def test_fallback_on_op_sequence_change(self):
+        net, opt = self._mk()
+        xt, yt = self._data()
+        ref_net, ref_opt = self._mk()
+        with lazy.capture_guard(False):
+            ref = [self._step(ref_net, ref_opt, xt, yt)
+                   for _ in range(14)]
+
+        def odd_step():
+            # extra op spliced into the loss: different op sequence
+            with paddle.incubate.lazy_eval():
+                loss = (((net(xt) - yt) ** 2).mean() * 2.0) / 2.0
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return float(loss)
+
+        losses = []
+        for i in range(14):
+            if i == 10:
+                losses.append(odd_step())  # diverges mid-captured-loop
+            else:
+                losses.append(self._step(net, opt, xt, yt))
+        np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=1e-6)
+
+    def _parity(self, dtype, rtol):
+        import jax.numpy as jnp
+
+        xt, yt = self._data(dtype)
+        runs = {}
+        for mode in ("donated", "plain", "uncaptured"):
+            net, opt = self._mk(dtype=dtype)
+            cap = lazy.capture_guard(mode != "uncaptured")
+            don = lazy.donate_guard(mode == "donated")
+            with cap, don:
+                s0 = lazy.stats()
+                losses = [self._step(net, opt, xt, yt)
+                          for _ in range(10)]
+                s1 = lazy.stats()
+            params = [np.asarray(lazy.force(p._data))
+                      for p in net.parameters()]
+            runs[mode] = (losses, params)
+            if mode == "donated":
+                assert s1["donated_steps"] > s0["donated_steps"], \
+                    "donation never engaged in captured mode"
+        # donated vs non-donated captured: bit-identical (same HLO,
+        # donation only changes buffer aliasing)
+        np.testing.assert_array_equal(runs["donated"][0],
+                                      runs["plain"][0])
+        for a, b in zip(runs["donated"][1], runs["plain"][1]):
+            np.testing.assert_array_equal(a, b)
+        # captured vs plain record mode: numerically equivalent
+        np.testing.assert_allclose(runs["donated"][0],
+                                   runs["uncaptured"][0], rtol=rtol)
+        for a, b in zip(runs["donated"][1], runs["uncaptured"][1]):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=rtol, atol=1e-5)
+
+    def test_donation_parity_fp32(self):
+        self._parity(np.float32, rtol=2e-4)
+
+    def test_donation_parity_bf16(self):
+        import jax.numpy as jnp
+
+        self._parity(jnp.bfloat16, rtol=2e-2)
+
+    def test_donated_buffer_updates_in_place(self):
+        # params/optimizer slots must be updated without allocating a
+        # fresh buffer: the previous step's param buffer is donated (on
+        # backends that support donation, jax deletes it)
+        net, opt = self._mk()
+        xt, yt = self._data()
+        for _ in range(12):
+            self._step(net, opt, xt, yt)
+        s0 = lazy.stats()
+        p = net.parameters()[0]
+        before = lazy.force(p._data)  # live buffer entering next step
+        self._step(net, opt, xt, yt)
+        s1 = lazy.stats()
+        if s1["donated_steps"] > s0["donated_steps"]:
+            # buffer donated in-place: the old array is dead
+            assert getattr(before, "is_deleted", lambda: False)()
+        # the live param reads back fine either way
+        assert np.isfinite(np.asarray(lazy.force(p._data))).all()
+
+    def test_stale_tensor_blocks_donation(self):
+        # a detach() that still holds the previous param buffer must
+        # BLOCK donation (current-holder check), not read a dead buffer
+        net, opt = self._mk()
+        xt, yt = self._data()
+        for _ in range(12):
+            self._step(net, opt, xt, yt)
+        p = net.parameters()[0]
+        held = p.detach()  # current holder of the live param payload
+        s0 = lazy.stats()
+        self._step(net, opt, xt, yt)
+        lazy.stats()
+        # regardless of whether this step donated OTHER buffers, the
+        # held payload must still be readable
+        assert np.isfinite(np.asarray(held.numpy())).all()
+
+    def test_same_aval_wiring_divergence_falls_back(self):
+        # code-review regression: a planned-LEAF position later fed by a
+        # same-shape intra-step output must fall back to recording, not
+        # recurse into the session's own executable
+        import jax.numpy as jnp
+
+        c = paddle.to_tensor(np.full((4, 4), 2.0, np.float32))
+        x = paddle.to_tensor(np.ones((4, 4), np.float32))
+
+        def step(second):
+            with paddle.incubate.lazy_eval():
+                with paddle.no_grad():
+                    h = x * 3.0
+                    y = h + (h if second is None else second)
+                return np.asarray(y.numpy())
+
+        for _ in range(6):
+            ref = step(c)  # h + c promotes
+        np.testing.assert_allclose(ref, np.full((4, 4), 5.0))
+        out = step(None)  # h + h: same avals, different wiring
+        np.testing.assert_allclose(out, np.full((4, 4), 6.0))
+        out = step(c)  # and back
+        np.testing.assert_allclose(out, np.full((4, 4), 5.0))
