@@ -91,23 +91,28 @@ CPU_RESERVE = 150.0
 
 
 def peak_flops_per_chip():
-    """bf16 peak FLOP/s of the local accelerator."""
-    import jax
+    """bf16 peak FLOP/s of the local accelerator (shared MFU denominator,
+    moved to the cost model so profiler.summary() uses the same table)."""
+    from paddle_tpu.cost_model import device_peak_flops
 
-    dev = jax.devices()[0]
-    kind = getattr(dev, "device_kind", "").lower()
-    # TPU v5 lite (v5e): 197 TFLOP/s bf16; v5p: 459; v4: 275; v3: 123
-    if "v5 lite" in kind or "v5e" in kind:
-        return 197e12
-    if "v5p" in kind or "v5" in kind:
-        return 459e12
-    if "v4" in kind:
-        return 275e12
-    if "v3" in kind:
-        return 123e12
-    if dev.platform == "cpu":
-        return 1e12  # nominal, for degraded CPU-fallback runs
-    return 197e12  # default to v5e
+    return device_peak_flops()
+
+
+def _telemetry_line(extra=None):
+    """One structured counters line per run (ISSUE 3): the registry
+    snapshot — lazy capture counters, jit cache hits/misses, collective
+    bytes, dataloader waits, step FLOPs/token gauges — as a driver-
+    parseable JSON record. Emitted BEFORE the metric line so the parent
+    (which treats the LAST line as the result) forwards both."""
+    from paddle_tpu import profiler
+
+    snap = profiler.stats()
+    rec = {"metric": "telemetry", "value": 0, "unit": "",
+           "vs_baseline": 0, "counters": snap["counters"],
+           "gauges": snap["gauges"], "timings": snap["timings"]}
+    if extra:
+        rec.update(extra)
+    print(json.dumps(rec), flush=True)
 
 
 def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16",
@@ -170,6 +175,10 @@ def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16",
     tps = tokens_per_step / dt
     flops = cfg.flops_per_token() * tokens_per_step
     mfu = flops / dt / peak_flops_per_chip()
+    # cost-model-derived per-step work → profiler gauges, so
+    # Profiler.summary() and the telemetry line report MFU/tokens-per-sec
+    paddle.profiler.set_step_metrics(flops_per_step=flops,
+                                     tokens_per_step=tokens_per_step)
     return tps, mfu, final, platform
 
 
@@ -259,14 +268,17 @@ def _run_ratio_child():
         "donated_steps": s1["donated_steps"] - s0["donated_steps"],
         "platform": "cpu",
     }
+    _telemetry_line()
     print(json.dumps(rec), flush=True)
     return 0
 
 
 def _run_child(preset, batch, seq, policy="full"):
-    """--run mode: execute one config and print its JSON line."""
+    """--run mode: execute one config and print its JSON lines
+    (telemetry first, the metric record last)."""
     tps, mfu, loss, platform = run(preset, int(batch), int(seq),
                                    policy=policy)
+    _telemetry_line()
     rec = {
         "metric": f"GPT({preset}) train tokens/sec/chip "
                   f"(bf16, seq{seq}, bs{batch}, remat={policy})",
@@ -310,6 +322,18 @@ def _note(text):
           file=sys.stderr, flush=True)
 
 
+def _forward_json_lines(lines):
+    """Re-print every JSON-parseable child line except the last (the
+    record line, which each caller validates and prints itself) — how
+    the telemetry record survives the last-line-wins driver contract."""
+    for ln in lines[:-1]:
+        try:
+            json.loads(ln)
+        except ValueError:
+            continue
+        print(ln, flush=True)
+
+
 def _replay_line(history, note):
     """Best banked on-chip line, re-tagged for replay. ADVICE r4: a
     replay must never carry "best": true — only a freshly-measured line
@@ -335,11 +359,15 @@ def _attempt(cfg, env, watchdog):
         return None, f"{preset}: watchdog timeout after {watchdog:.0f}s"
     if r.returncode != 0:
         return None, f"{preset}: " + (r.stderr or r.stdout).strip()[-300:]
-    line = r.stdout.strip().splitlines()[-1]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    if not lines:
+        return None, f"{preset}: empty output"
+    line = lines[-1]
     try:
         rec = json.loads(line)
     except ValueError:
         return None, f"{preset}: unparseable output {line[-200:]!r}"
+    _forward_json_lines(lines)
     print(line, flush=True)
     return rec, None
 
@@ -369,12 +397,14 @@ def _ratio_line(deadline):
         _note("ratio microbench failed: "
               + (r.stderr or r.stdout).strip()[-200:])
         return
-    line = r.stdout.strip().splitlines()[-1]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    line = lines[-1] if lines else ""
     try:
         json.loads(line)
     except ValueError:
         _note(f"ratio microbench: unparseable output {line[-200:]!r}")
         return
+    _forward_json_lines(lines)
     print(line, flush=True)
 
 

@@ -20,13 +20,20 @@ InferMeta (shape/dtype inference, `phi/infermeta/`) falls out of
 from __future__ import annotations
 
 import functools
+import time
+from collections import OrderedDict
 
 import jax
 
 from . import autograd as ag
 from . import flags as _flags
 from . import lazy as _lazy
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
 from .tensor import Tensor
+
+_counters = _registry.scoped_counters("dispatch", {
+    "ops_dispatched": 0, "jit_cache_hits": 0, "jit_cache_misses": 0})
 
 # Pluggable hooks -------------------------------------------------------------
 # static graph recorder: callable(fn, name, inputs, attrs) -> outputs or None
@@ -133,10 +140,38 @@ def note(name):
         _coverage_sink.add(name)
 
 
-@functools.lru_cache(maxsize=8192)
+# per-op jit compile cache (was a bare lru_cache): a manual LRU so
+# hits/misses are counted in the registry and every miss — a compile —
+# records its cause in the explainer ring (the eager-path recompile
+# storm detector; the lazy path has its own segment cache)
+_jit_cache: OrderedDict = OrderedDict()
+_JIT_CACHE_MAX = 8192
+
+
 def _jitted(fn, attr_items):
-    attrs = dict(attr_items)
-    return jax.jit(functools.partial(fn, **attrs))
+    key = (fn, attr_items)
+    hit = _jit_cache.get(key)
+    if hit is not None:
+        _counters["jit_cache_hits"] += 1
+        try:
+            _jit_cache.move_to_end(key)
+        except KeyError:
+            # dispatch runs from prefetch threads too (the old lru_cache
+            # was internally locked): a concurrent eviction between the
+            # get and the move loses only LRU recency — reinsert
+            _jit_cache[key] = hit
+        return hit
+    _counters["jit_cache_misses"] += 1
+    _explain.record(
+        "jit_cache_miss", op=getattr(fn, "__name__", str(fn)),
+        why="first compile of this (kernel, attrs) signature on the "
+            "eager no-grad path",
+        attrs=dict(attr_items))
+    jitted = jax.jit(functools.partial(fn, **dict(attr_items)))
+    _jit_cache[key] = jitted
+    if len(_jit_cache) > _JIT_CACHE_MAX:
+        _jit_cache.popitem(last=False)
+    return jitted
 
 
 def _vjp_kernel(fn, multi, n_in):
@@ -207,10 +242,27 @@ def _check_finite(out, name):
             continue
         if not bool(jnp.isfinite(a).all()):
             kind = "Nan" if bool(jnp.isnan(a).any()) else "Inf"
+            # the explainer tail rides along: the events leading up to
+            # the bad op (fallbacks, recompiles) are usually the clue
             raise RuntimeError(
                 f"Operator '{name}' output contains {kind} "
                 f"(shape {tuple(a.shape)}, dtype {a.dtype}). "
-                "Triggered by FLAGS_check_nan_inf.")
+                "Triggered by FLAGS_check_nan_inf."
+                + _explain.ring_dump())
+
+
+def _bench_record(name, out, t0):
+    """FLAGS_benchmark consumer (reference semantics: block on every
+    op's result so per-op wall time is real, not dispatch time). Records
+    into the registry's `op_time` scope; read via profiler.stats()."""
+    for a in (out if isinstance(out, (tuple, list)) else (out,)):
+        block = getattr(a, "block_until_ready", None)
+        if block is not None:
+            try:
+                block()
+            except Exception:  # tracer under an outer jit: nothing to block
+                break
+    _registry.timing(name, time.perf_counter() - t0, scope="op_time")
 
 
 def _wrap_out(arrays, node, multi):
@@ -238,6 +290,10 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
     """
     attrs = attrs or {}
     name = name or getattr(fn, "__name__", "op")
+    _counters["ops_dispatched"] += 1
+    # FLAGS_benchmark forces per-op eager execution (bypassing the lazy
+    # accumulator — a fused segment has no per-op boundaries to time)
+    bench = _flags._FLAGS["FLAGS_benchmark"]
 
     if _coverage_sink is not None:
         _coverage_sink.add(name)
@@ -264,7 +320,7 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
     # cache-keyable kernels + attrs (keys computed ONCE here, reused by
     # the node and the segment signature).
     if _lazy.enabled() and not needs_grad \
-            and amp_cast_hook is None \
+            and amp_cast_hook is None and not bench \
             and not _flags._FLAGS["FLAGS_check_nan_inf"]:
         lkey = _lazy.fn_key(fn)
         lattrs = _lazy.attrs_key(attrs) if lkey is not None else None
@@ -287,6 +343,7 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
     # buffer-donating executable. See core/lazy.py.
     if _lazy.enabled() and needs_grad \
             and amp_cast_hook is None and capture_sink is None \
+            and not bench \
             and not _flags._FLAGS["FLAGS_check_nan_inf"]:
         lkey = _lazy.fn_key(fn)
         lattrs = _lazy.attrs_key(attrs) if lkey is not None else None
@@ -359,10 +416,13 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
         # through JAX eager dispatch, which is itself compiled per-primitive.
         items = (_hashable_attrs(attrs)
                  if getattr(fn, "__closure__", None) is None else None)
+        t0 = time.perf_counter() if bench else 0.0
         if items is not None:
             out = _jitted(fn, items)(*arrays)
         else:
             out = fn(*arrays, **attrs)
+        if bench:
+            _bench_record(name, out, t0)
         if _flags._FLAGS["FLAGS_check_nan_inf"]:
             _check_finite(out, name)
         return _wrap_out(out, None, isinstance(out, (tuple, list)))
@@ -377,7 +437,10 @@ def forward(fn, inputs, attrs=None, name=None, nondiff=False):
             )
             return base_f(*xs)
 
+    t0 = time.perf_counter() if bench else 0.0
     out, vjp_fn = jax.vjp(f, *arrays)
+    if bench:
+        _bench_record(name, out, t0)
     if _flags._FLAGS["FLAGS_check_nan_inf"]:
         _check_finite(out, name)
     multi = isinstance(out, (tuple, list))

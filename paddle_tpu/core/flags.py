@@ -47,6 +47,10 @@ def flag(name: str):
 # (phi/core/flags.cc exports 95; the allocator/cudnn ones are owned by PJRT).
 define_flag("FLAGS_check_nan_inf", False, "scan op outputs for nan/inf")
 define_flag("FLAGS_benchmark", False, "block on every op for timing")
+define_flag("FLAGS_log_compiles", False,
+            "log every compile/recompile/capture-fallback cause event "
+            "(jax.log_compiles analog; events always land in "
+            "profiler.explain() regardless)")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "no-op on TPU (PJRT GC)")
 define_flag("FLAGS_use_autotune", True, "let XLA autotune (always on)")
 define_flag("FLAGS_cudnn_deterministic", False, "deterministic ops (XLA flag)")

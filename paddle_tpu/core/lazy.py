@@ -43,11 +43,16 @@ from __future__ import annotations
 import itertools
 import os
 import threading
+import time
 import weakref
 
 import numpy as np
 import jax
 import jax.numpy as jnp
+
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+from ..profiler import timeline as _timeline
 
 __all__ = ["LazyArray", "enabled", "lazy_guard", "build", "force",
            "stats", "capture_guard", "donate_guard"]
@@ -62,9 +67,13 @@ from collections import OrderedDict
 
 _exec_cache: OrderedDict = OrderedDict()
 _EXEC_CACHE_MAX = 512
-_counters = {"materializations": 0, "cache_hits": 0, "nodes_built": 0,
-             "replay_ops": 0, "captured_steps": 0, "capture_promotions": 0,
-             "capture_fallbacks": 0, "donated_steps": 0}
+# registry-backed (profiler.stats() surfaces these as "lazy.*"): the
+# registry hands back a plain dict, so the per-op/per-step bumps below
+# stay single dict stores — no call overhead on the hot path
+_counters = _registry.scoped_counters("lazy", {
+    "materializations": 0, "cache_hits": 0, "nodes_built": 0,
+    "replay_ops": 0, "captured_steps": 0, "capture_promotions": 0,
+    "capture_fallbacks": 0, "donated_steps": 0})
 
 # Step-capture knobs. _CAPTURE_K = consecutive identical-signature
 # materializations before promotion (>= 2: one to build the signature,
@@ -879,6 +888,11 @@ def _note_steady(key, topo, keep, leaves, outs):
         plans.clear()
     plans[plan.first_sig] = plan
     _counters["capture_promotions"] += 1
+    _explain.record(
+        "capture_promotion", op=plan.ops[0][2],
+        why=(f"segment steady for {_CAPTURE_K} identical-signature "
+             f"iterations; promoted to captured whole-step replay"),
+        n_ops=len(plan.ops), n_leaves=plan.n_leaves)
 
 
 class _SessionAnchor:
@@ -924,15 +938,29 @@ class _Session:
         ekey, eattrs, ename, erefs, avals, multi = ops[c]
         if key != ekey or attrs_key != eattrs or name != ename \
                 or len(inputs) != len(erefs):
-            return self._fall()
+            if name != ename:
+                why = (f"op sequence diverged: captured op #{c} is "
+                       f"{ename!r} but {name!r} was dispatched")
+            elif attrs_key != eattrs:
+                why = (f"attrs of {name!r} changed (a hyperparameter "
+                       f"became a different baked-in constant?)")
+            elif key != ekey:
+                why = f"kernel identity of {name!r} changed"
+            else:
+                why = (f"arity of {name!r} changed: captured "
+                       f"{len(erefs)} inputs, got {len(inputs)}")
+            return self._fall("signature", op=ename, got_op=name, why=why)
         nodes = self.nodes
         store = self.in_store
-        for inp, ref in zip(inputs, erefs):
+        for i, (inp, ref) in enumerate(zip(inputs, erefs)):
             if ref[0] == "n":
                 if not (type(inp) is LazyArray
                         and inp.node is nodes[ref[1]]
                         and inp.idx == ref[2]):
-                    return self._fall()
+                    return self._fall(
+                        "wiring", op=ename,
+                        why=(f"input {i} of {ename!r} (op #{c}) is wired "
+                             f"to a different producer than when captured"))
             else:
                 # a leaf may still be PENDING here (an output of the
                 # previous, complete-but-not-yet-forced session): only
@@ -945,7 +973,11 @@ class _Session:
                 if type(inp) is LazyArray:
                     nd = inp.node
                     if type(nd) is _ReplayNode and nd.session is self:
-                        return self._fall()
+                        return self._fall(
+                            "wiring", op=ename,
+                            why=(f"input {i} of {ename!r} (op #{c}): an "
+                                 f"intra-step value arrived where the "
+                                 f"capture expected a fresh leaf"))
                     a = nd.avals[inp.idx]
                     shp, dt = a.shape, a.dtype
                     if nd.values is None and type(nd) is _Node:
@@ -957,7 +989,13 @@ class _Session:
                 else:
                     shp, dt = np.shape(inp), np.result_type(inp)
                 if shp != ref[2] or dt != ref[3]:
-                    return self._fall()
+                    return self._fall(
+                        "aval", op=ename,
+                        why=(f"input {i} of {ename!r} (op #{c}) changed "
+                             f"aval: captured {tuple(ref[2])}/{ref[3]} "
+                             f"got {tuple(shp)}/{dt}"),
+                        old_aval=(tuple(ref[2]), str(ref[3])),
+                        new_aval=(tuple(shp), str(dt)))
                 store[ref[1]] = inp
         node = _ReplayNode(avals, multi, self, c)
         nodes[c] = node
@@ -969,8 +1007,13 @@ class _Session:
         return LazyArray(node, 0)
 
     # -- divergence: re-record the verified prefix ----------------------
-    def _fall(self):
+    def _fall(self, reason="divergence", op=None, why=None, **detail):
         _counters["capture_fallbacks"] += 1
+        # cold path by construction (a fallback re-records the prefix,
+        # which dwarfs one ring append) — full cause detail is cheap here
+        _explain.record("capture_fallback", op=op, why=why, reason=reason,
+                        cursor=self.cursor, plan_ops=len(self.plan.ops),
+                        **detail)
         self.anchor.values = ()  # retire the keep anchor
         plan = self.plan
         plan.misses += 1
@@ -1035,7 +1078,20 @@ class _Session:
             # mid-step force, or a force of an output the captured keep
             # set doesn't store: this step diverges from the captured
             # behavior — record it instead
-            self._fall()
+            opname = plan.ops[node.rec_idx][2]
+            if self.cursor < len(plan.ops):
+                self._fall(
+                    "mid-step force", op=opname,
+                    why=(f"output of {opname!r} (op #{node.rec_idx}) was "
+                         f"forced at cursor {self.cursor}/{len(plan.ops)} "
+                         f"— the captured step only materializes at its "
+                         f"end"))
+            else:
+                self._fall(
+                    "unkept force", op=opname,
+                    why=(f"output of {opname!r} (op #{node.rec_idx}) is "
+                         f"not a stored output of the captured executable "
+                         f"(no Tensor owned it at capture time)"))
 
     # -- whole-step execution -------------------------------------------
     def _execute(self):
@@ -1047,7 +1103,11 @@ class _Session:
             for wr in nodes[r].refs:
                 la = wr()
                 if la is not None and la.has_owner():
-                    self._fall()
+                    self._fall(
+                        "keep-set", op=plan.ops[r][2],
+                        why=(f"output of {plan.ops[r][2]!r} (op #{r}) "
+                             f"gained a Tensor owner but is not a stored "
+                             f"output of the captured executable"))
                     return
         store = self.in_store
         vals = [force(o) for o in store]
@@ -1057,7 +1117,10 @@ class _Session:
             v0 = vals[cls[0]]
             for p in cls[1:]:
                 if vals[p] is not v0:
-                    self._fall()
+                    self._fall(
+                        "identity-class",
+                        why=("two leaf slots that shared one buffer at "
+                             "capture time now hold different buffers"))
                     return
         classes = plan.classes
         uvals = [vals[cls[0]] for cls in classes]
@@ -1083,7 +1146,12 @@ class _Session:
                         donate = False
                         break
         exe = plan.exec_donate if donate else plan.exec_plain
-        outs = exe(*uvals)
+        if _timeline.active():
+            _t0 = time.perf_counter()
+            outs = exe(*uvals)
+            _timeline.add_span("captured_step", _t0, time.perf_counter())
+        else:
+            outs = exe(*uvals)
         for j, r in enumerate(plan.keep_rec):
             nodes[r].values = tuple(outs[j])
         self.done = True
@@ -1193,13 +1261,25 @@ def _materialize(root):
             _exec_cache.move_to_end(key)
             _counters["cache_hits"] += 1
     if compiled is None:
+        _explain.record(
+            "segment_compile", op=getattr(root, "name", None),
+            why=("uncacheable segment (unhashable attrs or wiring "
+                 "drift): re-traced on every materialization"
+                 if key is None else
+                 "new segment structure: traced + compiled once"),
+            n_ops=len(topo), kept=sum(keep), cacheable=key is not None)
         compiled = _make_replay(topo, keep)  # compile outside the lock
         if key is not None:
             with _lock:
                 _exec_cache[key] = compiled
                 if len(_exec_cache) > _EXEC_CACHE_MAX:
                     _exec_cache.popitem(last=False)
-    outs = compiled(leaves)
+    if _timeline.active():
+        _t0 = time.perf_counter()
+        outs = compiled(leaves)
+        _timeline.add_span("lazy_segment", _t0, time.perf_counter())
+    else:
+        outs = compiled(leaves)
     kept = [n for n, k in zip(topo, keep) if k]
     for n, vals in zip(kept, outs):
         n.values = tuple(vals)

@@ -10,7 +10,29 @@ import json
 import os
 import time
 
-__all__ = ["CostModel"]
+__all__ = ["CostModel", "device_peak_flops"]
+
+
+def device_peak_flops():
+    """bf16 peak FLOP/s of the local accelerator — the MFU denominator
+    shared by bench.py and profiler.Profiler.summary(). CPU gets a
+    nominal 1e12 so degraded runs still produce a (tagged) number."""
+    import jax
+
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", "").lower()
+    # TPU v5 lite (v5e): 197 TFLOP/s bf16; v5p: 459; v4: 275; v3: 123
+    if "v5 lite" in kind or "v5e" in kind:
+        return 197e12
+    if "v5p" in kind or "v5" in kind:
+        return 459e12
+    if "v4" in kind:
+        return 275e12
+    if "v3" in kind:
+        return 123e12
+    if dev.platform == "cpu":
+        return 1e12
+    return 197e12  # default to v5e
 
 
 class CostModel:

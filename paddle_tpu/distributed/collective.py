@@ -34,6 +34,12 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..core.dispatch import forward
 from ..core.tensor import Tensor
+from ..profiler import registry as _registry
+
+# call + byte counters per collective (profiler.stats() "collective.*").
+# Bytes come from shape/dtype metadata, so traced arrays count too; in a
+# traced context the bump lands once per compile, not per executed step.
+_tally = functools.partial(_registry.tally, "collective")
 
 __all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
            "all_gather", "reduce_scatter", "broadcast", "reduce", "scatter",
@@ -253,6 +259,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     The tensor is expected to be sharded (or shardable) over the group axis;
     a replicated tensor is returned unchanged times nranks semantics apply
     only across real shards."""
+    _tally("all_reduce", tensor._data)
     group = group or _default_group()
     if group.nranks == 1:
         return _Task([tensor._data])
@@ -277,6 +284,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
     """Gather each rank's shard; eager SPMD form: the input's leading dim is
     sharded over the group, output list holds each shard's copy."""
     _note('all_gather')
+    _tally("all_gather", tensor._data)
     group = group or _default_group()
     if group.nranks == 1:
         tensor_list.append(tensor.clone())
@@ -289,6 +297,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
     _note('broadcast')
+    _tally("broadcast", tensor._data)
     group = group or _default_group()
     if group.nranks == 1:
         return _Task([tensor._data])
@@ -318,6 +327,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
     src = tensor_or_tensor_list
     if isinstance(src, (list, tuple)):
         src = Tensor(jnp.concatenate([t._data for t in src], axis=0))
+    _tally("reduce_scatter", src._data)
     if group.nranks == 1:
         tensor._data = src._data
         return _Task([tensor._data])
@@ -333,6 +343,7 @@ def reduce_scatter(tensor, tensor_or_tensor_list, op=ReduceOp.SUM, group=None,
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    _tally("scatter", tensor._data)
     group = group or _default_group()
     if tensor_list:
         tensor._data = tensor_list[group.get_group_rank(
@@ -346,6 +357,7 @@ def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
         x = in_tensor_list._data
     else:
         x = jnp.stack([t._data for t in in_tensor_list])
+    _tally("all_to_all", x)
     if group.nranks == 1:
         out = x
     else:

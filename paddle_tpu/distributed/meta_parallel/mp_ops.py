@@ -28,6 +28,14 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ...profiler import registry as _registry
+
+# call + byte counters per mp primitive (profiler.stats() "mp.*"). These
+# ops run inside traces, so a bump lands once per COMPILE of the
+# enclosing region, not once per executed step — a usage/topology
+# signal, same trace-time semantics as jax.log_compiles.
+_tally = functools.partial(_registry.tally, "mp")
+
 __all__ = ["axis_in_scope", "mp_axis_size", "mp_rank",
            "_c_identity", "_c_concat", "_c_split", "_mp_allreduce",
            "_c_lookup_table", "_c_softmax_with_cross_entropy",
@@ -155,6 +163,7 @@ _allreduce_manual.defvjp(_allreduce_manual_fwd, _allreduce_manual_bwd)
 def _c_identity(x, group=None, axis: str = MP_AXIS):
     """Forward identity / backward allreduce (mp_ops.py:27) — marks the
     replicated input of a ColumnParallelLinear."""
+    _tally("_c_identity", x)
     if axis_in_scope(axis):
         return _identity_manual(x, axis)
     return x  # GSPMD: backward partial-sums reduce automatically
@@ -163,6 +172,7 @@ def _c_identity(x, group=None, axis: str = MP_AXIS):
 def _mp_allreduce(x, group=None, axis: str = MP_AXIS):
     """Forward allreduce / backward identity (mp_ops.py:211) — reduces the
     partial outputs of a RowParallelLinear."""
+    _tally("_mp_allreduce", x)
     if axis_in_scope(axis):
         return _allreduce_manual(x, axis)
     return x  # GSPMD inserts the reduce where the contraction is sharded
@@ -170,6 +180,7 @@ def _mp_allreduce(x, group=None, axis: str = MP_AXIS):
 
 def _c_split(x, group=None, axis: str = MP_AXIS):
     """Keep this rank's chunk of the last dim (mp_ops.py:145)."""
+    _tally("_c_split", x)
     if axis_in_scope(axis):
         n = _axis_size(axis)
         rank = jax.lax.axis_index(axis)
@@ -180,6 +191,7 @@ def _c_split(x, group=None, axis: str = MP_AXIS):
 
 def _c_concat(x, group=None, axis: str = MP_AXIS):
     """All-gather chunks along the last dim (mp_ops.py:83)."""
+    _tally("_c_concat", x)
     if axis_in_scope(axis):
         return jax.lax.all_gather(x, axis, axis=x.ndim - 1, tiled=True)
     return _constrain(x, P(*([None] * x.ndim)))
@@ -189,6 +201,7 @@ def _c_lookup_table(table, ids, start_index=0, axis: str = MP_AXIS):
     """Vocab-sharded embedding lookup (c_embedding_op.cc semantics): each
     rank owns rows [start, start + V_local); out-of-range ids contribute
     zeros and the psum over mp assembles the full lookup."""
+    _tally("_c_lookup_table", table)
     if axis_in_scope(axis):
         v_local = table.shape[0]
         rank = jax.lax.axis_index(axis)
@@ -210,6 +223,7 @@ def _c_softmax_with_cross_entropy(logits, label, axis: str = MP_AXIS,
 
     Works on both shard-local logits (inside an mp shard_map region) and
     global logits (GSPMD partitions the same reductions)."""
+    _tally("_c_softmax_with_cross_entropy", logits)
     lg = logits.astype(jnp.float32)
     if axis_in_scope(axis):
         v_local = lg.shape[-1]

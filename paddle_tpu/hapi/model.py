@@ -10,8 +10,11 @@ import numpy as np
 
 from ..core.tensor import Tensor
 from ..io import DataLoader
+from ..profiler import RecordEvent
 
 __all__ = ["Model"]
+
+_END = object()  # fit-loop iterator sentinel (a batch may be any value)
 
 
 class Model:
@@ -64,11 +67,16 @@ class Model:
 
                     ctx = auto_cast(enable=True, level=amp,
                                     dtype="bfloat16")  # TPU-first default
-                with ctx:
+                # spans land at TrainStep trace time (the step is one
+                # compiled executable afterwards) — the profiler still
+                # sees the forward/backward split of the traced step;
+                # Optimizer.step() carries its own "optimizer-step" span
+                with ctx, RecordEvent("forward"):
                     out = self.network(*ins)
                     loss = self._compute_loss(out, list(labs)
                                               if len(labs) > 1 else labs[0])
-                loss.backward()
+                with RecordEvent("backward"):
+                    loss.backward()
                 self._optimizer.step()
                 self._optimizer.clear_grad()
                 return loss
@@ -80,7 +88,8 @@ class Model:
         inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels_l = labels if isinstance(labels, (list, tuple)) else (
             [labels] if labels is not None else [])
-        loss = self._train_step(*inputs_l, *labels_l)
+        with RecordEvent("train_step"):
+            loss = self._train_step(*inputs_l, *labels_l)
         return [float(loss)]
 
     def eval_batch(self, inputs, labels=None):
@@ -123,7 +132,21 @@ class Model:
             if self.stop_training:
                 break
             cbs.on_epoch_begin(epoch)
-            for step, batch in enumerate(loader):
+            it = iter(loader)
+            step = -1
+            logs = {}  # an epoch with zero batches still closes cleanly
+            while True:
+                # explicit next() so the batch-fetch wait is a span of
+                # its own ("dataloader") in the host timeline
+                ev = RecordEvent("dataloader")
+                ev.begin()
+                try:
+                    batch = next(it, _END)
+                finally:
+                    ev.end()  # a raising loader must not leak the span
+                if batch is _END:
+                    break
+                step += 1
                 cbs.on_train_batch_begin(step)
                 *xs, y = batch if isinstance(batch, (list, tuple)) else [batch]
                 loss = self.train_batch(xs, y)

@@ -16,10 +16,12 @@ from __future__ import annotations
 import itertools
 import queue
 import threading
+import time
 
 import numpy as np
 
 from ..core.tensor import Tensor
+from ..profiler import registry as _registry
 from .dataset import BatchSampler, IterableDataset
 
 __all__ = ["DataLoader", "default_collate_fn"]
@@ -132,6 +134,7 @@ class _PrefetchIterator:
         # must surface as an error/StopIteration, never an infinite block
         # (reference: fluid/dataloader/dataloader_iter.py's timeout +
         # SIGCHLD handling).
+        t0 = time.perf_counter()
         while True:
             try:
                 item = self._q.get(timeout=1.0)
@@ -149,6 +152,9 @@ class _PrefetchIterator:
             if self._err is not None:
                 raise self._err
             raise StopIteration
+        # host-prep stall the training loop actually saw for this batch
+        # ("timings.dataloader.wait"); the teardown wait above is not one
+        _registry.timing("dataloader.wait", time.perf_counter() - t0)
         return item
 
 
@@ -292,6 +298,20 @@ class DataLoader:
                             f"DataLoader worker raised {name}: {message}\n"
                             f"{tb}")
                     if tag == _DONE_TAG:
+                        # DONE frames carry the worker's telemetry since
+                        # ISSUE 3 (empty payload = older/erroring worker)
+                        if payload:
+                            try:
+                                info = pickle.loads(payload)
+                                _registry.timing(
+                                    "dataloader.worker_busy",
+                                    float(info.get("busy_s", 0.0)))
+                                _registry.inc(
+                                    "worker_batches",
+                                    int(info.get("n_batches", 0)),
+                                    scope="dataloader")
+                            except Exception:
+                                pass
                         done_workers += 1
                         if done_workers == nw:
                             raise RuntimeError(

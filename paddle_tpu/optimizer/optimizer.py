@@ -141,7 +141,12 @@ class Optimizer:
     def step(self):
         from ..core.selected_rows import SelectedRows
         from ..core.tensor import Tensor
+        from ..profiler import RecordEvent
 
+        with RecordEvent("optimizer-step"):
+            self._step_impl(SelectedRows, Tensor)
+
+    def _step_impl(self, SelectedRows, Tensor):
         pg = self._params_grads()
         # SelectedRows grads (sparse embedding, eager): row-capable
         # optimizers apply row-wise updates; anything that needs the

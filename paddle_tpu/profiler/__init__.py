@@ -1,16 +1,30 @@
-"""Profiler.
+"""Profiler — the unified runtime observability layer.
 
-Reference: `python/paddle/profiler/profiler.py:344` (Profiler with scheduler
-states, chrome-trace export) over the C++ unified profiler
-(`fluid/platform/profiler/profiler.h:47`: HostTracer + CudaTracer/CUPTI +
-CustomTracer).
+Reference: `python/paddle/profiler/profiler.py:344` (Profiler with
+scheduler states, chrome-trace export) over the C++ unified profiler
+(`fluid/platform/profiler/profiler.h:47`: HostTracer + CudaTracer/CUPTI
++ CustomTracer).
 
-TPU re-design: the device tracer is libtpu's, surfaced through
-`jax.profiler` (XPlane). `Profiler` keeps the reference's state machine
-(CLOSED/READY/RECORD/RECORD_AND_RETURN) and emits a TensorBoard-compatible
-trace directory; `RecordEvent` maps to `jax.profiler.TraceAnnotation`
-(host events nested into the device timeline, same UX as the reference's
-RecordEvent → chrome trace).
+TPU re-design, three pillars (ISSUE 3):
+
+1. **Metrics registry** (`registry.py`): process-wide counters / gauges
+   / timings with named scopes. The lazy capture engine, the eager
+   jit cache, collectives, and the dataloader all publish here;
+   `stats()` is the one query point.
+2. **Recompile/fallback explainer** (`explainer.py`): every lazy
+   capture fallback, segment recompile, capture promotion, and eager
+   jit-cache miss records a structured cause event into a ring buffer —
+   `explain()` reads it back; `FLAGS_log_compiles` logs live.
+3. **Host span timeline** (`timeline.py`): `RecordEvent` buffers host
+   spans while a Profiler window records, and `export_chrome_tracing`
+   writes valid chrome-trace JSON with no libtpu. The device tracer is
+   still libtpu's, surfaced through `jax.profiler` (XPlane) into the
+   same directory when available; `RecordEvent` maps each begin to a
+   `jax.profiler.TraceAnnotation` so host events nest into the device
+   timeline too.
+
+`Profiler` keeps the reference's state machine
+(CLOSED/READY/RECORD/RECORD_AND_RETURN).
 """
 from __future__ import annotations
 
@@ -20,8 +34,12 @@ import time
 
 import jax
 
-__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result"]
+from . import explainer, registry, timeline
+
+__all__ = ["Profiler", "ProfilerTarget", "ProfilerState", "ProfilerResult",
+           "RecordEvent", "make_scheduler", "export_chrome_tracing",
+           "load_profiler_result", "stats", "explain", "reset_stats",
+           "set_step_metrics"]
 
 
 class ProfilerTarget(enum.Enum):
@@ -60,18 +78,40 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """on_trace_ready handler: write the host-span chrome trace (plus
+    the telemetry snapshot) into `dir_name` when a record window closes.
+    A jax/xprof device trace, when one ran, is written by jax into the
+    same directory — TensorBoard merges the two views."""
+
     def handler(prof):
         prof._export_dir = dir_name
+        prof._worker_name = worker_name
+        prof._export_host_trace()
 
+    # attributes let Profiler.__init__ route the jax trace into the same
+    # directory from the very first record window (the handler itself
+    # only runs when the window closes)
+    handler._export_dir = dir_name
+    handler._worker_name = worker_name
     return handler
 
 
 class RecordEvent:
-    """Host-side event annotation (reference event_tracing.h RecordEvent)."""
+    """Host-side event annotation (reference event_tracing.h RecordEvent).
+
+    begin/end form a STACK: re-entrant begin() calls each open a span
+    and end() closes the innermost one (the old single-slot `_ctx`
+    leaked the first TraceAnnotation on a double begin); end() without a
+    matching begin is a no-op. Each begin enters a
+    `jax.profiler.TraceAnnotation` (device/xprof nesting when a device
+    trace is active) and, while a Profiler window records, stamps a
+    host span into the pure-host timeline."""
+
+    __slots__ = ("name", "_stack")
 
     def __init__(self, name, event_type=None):
         self.name = name
-        self._ctx = None
+        self._stack = []
 
     def __enter__(self):
         self.begin()
@@ -82,13 +122,22 @@ class RecordEvent:
         return False
 
     def begin(self):
-        self._ctx = jax.profiler.TraceAnnotation(self.name)
-        self._ctx.__enter__()
+        try:
+            ctx = jax.profiler.TraceAnnotation(self.name)
+            ctx.__enter__()
+        except Exception:
+            ctx = None
+        self._stack.append(
+            (ctx, time.perf_counter() if timeline.active() else None))
 
     def end(self):
-        if self._ctx is not None:
-            self._ctx.__exit__(None, None, None)
-            self._ctx = None
+        if not self._stack:
+            return
+        ctx, t0 = self._stack.pop()
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+        if t0 is not None:
+            timeline.add_span(self.name, t0, time.perf_counter())
 
 
 class Profiler:
@@ -101,9 +150,16 @@ class Profiler:
                                                           ProfilerState.RECORD))
         self._on_trace_ready = on_trace_ready
         self._timer_only = timer_only
-        self._export_dir = None
+        self._export_dir = getattr(on_trace_ready, "_export_dir", None)
+        self._worker_name = getattr(on_trace_ready, "_worker_name", None)
         self._step = 0
-        self._running = False
+        self._host_tracing = False
+        self._jax_running = False
+        self._host_spans = []
+        self._last_export = None
+        self._export_count = 0
+        self._pending_export = False  # closed window not yet delivered
+        self._delivered = 0           # on_trace_ready invocations
         self._step_times = []
         self._last_t = None
 
@@ -116,20 +172,30 @@ class Profiler:
         self._last_t = time.perf_counter()
 
     def _begin_trace(self):
-        if not self._running:
+        if not self._host_tracing:
+            timeline.start()
+            self._host_tracing = True
+        if not self._jax_running:
             d = self._export_dir or os.environ.get(
                 "PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
             os.makedirs(d, exist_ok=True)
             try:
                 jax.profiler.start_trace(d)
-                self._running = True
-            except RuntimeError:
-                pass
+                self._jax_running = True
+            except Exception:
+                pass  # no device tracer — the host timeline still records
 
     def _end_trace(self):
-        if self._running:
-            jax.profiler.stop_trace()
-            self._running = False
+        if self._host_tracing:
+            self._host_spans = timeline.stop()
+            self._host_tracing = False
+            self._pending_export = True
+        if self._jax_running:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass
+            self._jax_running = False
 
     def step(self, num_samples=None):
         now = time.perf_counter()
@@ -148,11 +214,21 @@ class Profiler:
                 self._end_trace()
             if self._on_trace_ready:
                 self._on_trace_ready(self)
+                self._delivered += 1
+            self._pending_export = False
 
     def stop(self):
         self._end_trace()
-        if self._on_trace_ready:
+        # skip the handler when step() already delivered every closed
+        # window (a second call would re-deliver the last window's stale
+        # spans — true for custom handlers too, hence the delivery
+        # counter, not the export counter); a profiler that never
+        # recorded still gets one callback (timer_only use)
+        if self._on_trace_ready and (self._pending_export
+                                     or self._delivered == 0):
             self._on_trace_ready(self)
+            self._delivered += 1
+        self._pending_export = False
 
     def __enter__(self):
         self.start()
@@ -162,6 +238,22 @@ class Profiler:
         self.stop()
         return False
 
+    def _export_host_trace(self):
+        """Write the last record window's host spans as chrome-trace
+        JSON (with the telemetry snapshot embedded); returns the path."""
+        d = self._export_dir or os.environ.get(
+            "PADDLE_TPU_PROFILE_DIR", "/tmp/paddle_tpu_profile")
+        os.makedirs(d, exist_ok=True)
+        name = self._worker_name or f"paddle_tpu_host_{os.getpid()}"
+        if self._export_count:  # later record windows get their own file
+            name = f"{name}.{self._export_count}"
+        self._export_count += 1
+        meta = registry.snapshot()
+        meta["step_times_ms"] = [t * 1e3 for t in self._step_times]
+        self._last_export = timeline.write_chrome_trace(
+            os.path.join(d, name + ".json"), self._host_spans, meta)
+        return self._last_export
+
     def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
                 time_unit="ms"):
         if not self._step_times:
@@ -169,11 +261,94 @@ class Profiler:
         import numpy as np
 
         ts = np.asarray(self._step_times) * 1e3
-        return (f"steps={len(ts)} avg={ts.mean():.3f}ms p50="
+        line = (f"steps={len(ts)} avg={ts.mean():.3f}ms p50="
                 f"{np.percentile(ts, 50):.3f}ms p99="
                 f"{np.percentile(ts, 99):.3f}ms")
+        # cost-model-derived throughput: set_step_metrics declares the
+        # per-step work; MFU = model FLOPs / time / device peak
+        avg_s = float(np.mean(self._step_times))
+        tokens = registry.gauge("step.tokens")
+        flops = registry.gauge("step.flops")
+        if tokens:
+            line += f" tokens/s={tokens / avg_s:,.1f}"
+        if flops:
+            from ..cost_model import device_peak_flops
+
+            line += f" MFU={flops / avg_s / device_peak_flops():.2%}"
+        return line
+
+
+def set_step_metrics(flops_per_step=None, tokens_per_step=None):
+    """Declare per-step model FLOPs / token counts (cost-model output)
+    so `Profiler.summary()` and bench telemetry can report MFU and
+    tokens/sec alongside step-time percentiles."""
+    if flops_per_step is not None:
+        registry.gauge_set("step.flops", float(flops_per_step))
+    if tokens_per_step is not None:
+        registry.gauge_set("step.tokens", float(tokens_per_step))
+
+
+def stats(scope=None):
+    """Telemetry snapshot: {"counters", "gauges", "timings"} — flat
+    "<scope>.<name>" keys. With `scope`, just that scope's counters.
+    Includes the lazy engine (promotions, fallbacks, cache hits), the
+    dispatch jit cache, collective call/byte counters, and dataloader
+    waits; see DESIGN_DECISIONS.md for each counter's meaning."""
+    if scope is not None:
+        return registry.counters(scope)
+    return registry.snapshot()
+
+
+def explain(n=None, kind=None):
+    """Recent structured cause events (capture fallbacks, segment
+    recompiles, promotions, jit-cache misses), oldest first."""
+    return explainer.events(n, kind)
+
+
+def reset_stats():
+    """Zero all counters/timings/gauges and clear the explainer ring."""
+    registry.reset()
+    explainer.clear()
+
+
+class ProfilerResult:
+    """Parsed chrome trace: host spans + the embedded telemetry
+    snapshot (`load_profiler_result` return type)."""
+
+    def __init__(self, doc):
+        self.events = [e for e in doc.get("traceEvents", ())
+                       if e.get("ph") == "X"]
+        self.telemetry = doc.get("paddle_tpu", {})
+
+    def span_totals(self):
+        """name -> {"count", "total_ms"} aggregated over all spans."""
+        out = {}
+        for e in self.events:
+            rec = out.setdefault(e.get("name", "?"),
+                                 {"count": 0, "total_ms": 0.0})
+            rec["count"] += 1
+            rec["total_ms"] += float(e.get("dur", 0.0)) / 1e3
+        return out
+
+    def summary(self):
+        tot = self.span_totals()
+        rows = sorted(tot.items(), key=lambda kv: -kv[1]["total_ms"])
+        lines = [f"{'name':<40} {'count':>8} {'total_ms':>12} {'avg_ms':>10}"]
+        for name, rec in rows:
+            lines.append(f"{name:<40} {rec['count']:>8} "
+                         f"{rec['total_ms']:>12.3f} "
+                         f"{rec['total_ms'] / rec['count']:>10.3f}")
+        return "\n".join(lines)
 
 
 def load_profiler_result(filename):
-    raise NotImplementedError(
-        "use TensorBoard / xprof on the exported trace directory")
+    """Parse an exported chrome-trace JSON back into a ProfilerResult
+    with per-name span totals (reference load_profiler_result)."""
+    import json
+
+    with open(filename) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(
+            f"{filename} is not a chrome-trace JSON (no traceEvents key)")
+    return ProfilerResult(doc)

@@ -1,0 +1,96 @@
+"""paddle_tpu.profiler.explainer — recompile/fallback cause ring.
+
+Every event the runtime can explain — a lazy capture fallback, a
+segment (re)compile, a capture promotion, an eager jit-cache miss —
+lands here as one structured dict in a bounded ring buffer:
+
+    {"seq": 17, "ts": 1722700000.1, "kind": "capture_fallback",
+     "op": "adamax", "why": "input 3 of 'adamax' changed aval: captured
+      ()/float32 got (1,)/float32", "reason": "aval", ...}
+
+`paddle_tpu.profiler.explain()` reads it back, turning "step 500 got
+slow" into "which op diverged and how". `FLAGS_log_compiles` (the
+jax.log_compiles analog, opt-in) additionally logs each event as it is
+recorded. Recording is a deque append — O(1), no formatting until a
+reader asks — so producers may call it from warm (not per-op-hot)
+paths; the ring keeps the most recent PADDLE_TPU_EXPLAIN_RING
+(default 256) events.
+
+Event kinds and their extra fields are documented in
+DESIGN_DECISIONS.md ("Observability layer").
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import logging
+import os
+import time
+
+_RING = max(16, int(os.environ.get("PADDLE_TPU_EXPLAIN_RING", "256")))
+_events: collections.deque = collections.deque(maxlen=_RING)
+_seq = itertools.count(1)
+_log = logging.getLogger("paddle_tpu.profiler")
+
+
+def record(kind, op=None, why=None, **detail):
+    """Append one structured cause event; returns the event dict."""
+    ev = {"seq": next(_seq), "ts": time.time(), "kind": kind}
+    if op is not None:
+        ev["op"] = op
+    if why is not None:
+        ev["why"] = why
+    if detail:
+        ev.update(detail)
+    _events.append(ev)
+    if _log_compiles():
+        _log.warning("%s: op=%s — %s", kind, op, why or detail or "")
+    return ev
+
+
+def _log_compiles():
+    # function-level flag read: keeps this module import-cycle-free
+    # (core.flags may not be initialized yet when profiler loads)
+    try:
+        from ..core.flags import _FLAGS
+
+        return _FLAGS.get("FLAGS_log_compiles", False)
+    except Exception:
+        return False
+
+
+def events(n=None, kind=None):
+    """The most recent events, oldest first; optionally the last `n`
+    and/or only one `kind`."""
+    evs = list(_events)
+    if kind is not None:
+        evs = [e for e in evs if e["kind"] == kind]
+    if n is not None:
+        evs = evs[-int(n):]
+    return evs
+
+
+def clear():
+    _events.clear()
+
+
+def format_tail(n=8):
+    """Human-readable render of the last `n` events ('' when empty)."""
+    lines = []
+    for e in list(_events)[-n:]:
+        extra = {k: v for k, v in e.items()
+                 if k not in ("seq", "ts", "kind", "op", "why")}
+        lines.append(
+            f"  #{e['seq']} {e['kind']}"
+            + (f" op={e['op']!r}" if "op" in e else "")
+            + (f": {e['why']}" if "why" in e else "")
+            + (f" {extra}" if extra else ""))
+    return "\n".join(lines)
+
+
+def ring_dump(n=8):
+    """Suffix for runtime error messages (FLAGS_check_nan_inf): the
+    recent cause events, so an abort carries its own context."""
+    tail = format_tail(n)
+    return ("\nRecent runtime events (paddle_tpu.profiler.explain()):\n"
+            + (tail if tail else "  (no events recorded)"))

@@ -1,0 +1,168 @@
+"""paddle_tpu.profiler.registry — process-wide structured metrics.
+
+One registry for every runtime counter/gauge/timing the framework
+produces (reference: the C++ unified profiler's HostEventRecorder stats
+plus the scattered `VLOG` counters — here they are a queryable API).
+
+Hot-path contract: `scoped_counters(scope)` hands the producer a plain
+dict it bumps directly (`d["x"] += 1` — one dict store, no registry
+call, no lock). The registry keeps that same dict object forever:
+`reset()` zeroes values IN PLACE, so module-level aliases like
+`core.lazy._counters` stay valid across resets. Gauges and timings go
+through tiny functions; none of this allocates on the steady path.
+
+Scopes in use (see DESIGN_DECISIONS.md "Observability layer" for the
+meaning of each counter): `lazy` (capture/replay engine), `dispatch`
+(eager per-op jit cache), `collective` / `mp` (call + byte counters),
+`dataloader` (worker batches), timings scopes `timings` (host waits),
+`op_time` (FLAGS_benchmark per-op wall time).
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+_lock = threading.Lock()
+_counter_scopes: dict = {}
+_timing_scopes: dict = {}
+_gauges: dict = {}
+
+
+def scoped_counters(scope, initial=None):
+    """The counter table (a plain dict) for `scope`, created on first
+    use. `initial` pre-seeds keys with defaults (existing values win, so
+    re-import / reload never clobbers live counts)."""
+    d = _counter_scopes.get(scope)
+    if d is None:
+        with _lock:
+            d = _counter_scopes.setdefault(scope, {})
+    if initial:
+        for k, v in initial.items():
+            d.setdefault(k, v)
+    return d
+
+
+def inc(name, n=1, scope="misc"):
+    d = _counter_scopes.get(scope)
+    if d is None:
+        d = scoped_counters(scope)
+    d[name] = d.get(name, 0) + n
+
+
+def gauge_set(name, value):
+    _gauges[name] = value
+
+
+def gauge(name, default=None):
+    return _gauges.get(name, default)
+
+
+def timing(name, seconds, scope="timings"):
+    """Accumulate one duration observation: [count, total_seconds]."""
+    s = _timing_scopes.get(scope)
+    if s is None:
+        with _lock:
+            s = _timing_scopes.setdefault(scope, {})
+    rec = s.get(name)
+    if rec is None:
+        s[name] = [1, float(seconds)]
+    else:
+        rec[0] += 1
+        rec[1] += seconds
+
+
+class time_block:
+    """`with time_block("phase"):` records one timing observation."""
+
+    __slots__ = ("_name", "_scope", "_t0")
+
+    def __init__(self, name, scope="timings"):
+        self._name = name
+        self._scope = scope
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        timing(self._name, time.perf_counter() - self._t0, self._scope)
+        return False
+
+
+def array_nbytes(a):
+    """Byte size from shape/dtype metadata only — works on concrete
+    arrays AND tracers (collective byte counters bump at trace time)."""
+    dt = getattr(a, "dtype", None)
+    if dt is None:
+        return 0
+    try:
+        import numpy as np
+
+        n = 1
+        for s in getattr(a, "shape", ()):
+            n *= int(s)
+        return n * np.dtype(dt).itemsize
+    except Exception:
+        return 0
+
+
+def tally(scope, name, *arrays):
+    """Bump `<name>.calls` and `<name>.bytes` in `scope` — the shared
+    accumulation shape for collective/mp traffic counters."""
+    d = _counter_scopes.get(scope)
+    if d is None:
+        d = scoped_counters(scope)
+    d[name + ".calls"] = d.get(name + ".calls", 0) + 1
+    nb = 0
+    for a in arrays:
+        nb += array_nbytes(a)
+    d[name + ".bytes"] = d.get(name + ".bytes", 0) + nb
+
+
+def counters(scope=None):
+    """Flat snapshot: {"<scope>.<name>": value} (or one scope's dict)."""
+    if scope is not None:
+        return dict(_counter_scopes.get(scope, ()))
+    out = {}
+    for sc, d in list(_counter_scopes.items()):
+        for k, v in list(d.items()):
+            out[f"{sc}.{k}"] = v
+    return out
+
+
+def timings(scope=None):
+    scopes = [scope] if scope is not None else list(_timing_scopes)
+    out = {}
+    for sc in scopes:
+        for k, rec in list(_timing_scopes.get(sc, {}).items()):
+            cnt, tot = rec
+            out[f"{sc}.{k}"] = {"count": cnt, "total_s": tot,
+                                "mean_ms": (tot / cnt * 1e3) if cnt else 0.0}
+    return out
+
+
+def gauges():
+    return dict(_gauges)
+
+
+def snapshot():
+    return {"counters": counters(), "gauges": gauges(), "timings": timings()}
+
+
+def reset(scope=None):
+    """Zero counters and drop timings (one scope, or everything plus
+    gauges). Counter KEYS survive with value 0 — producers pre-seed keys
+    and bump with `+=`, so deleting them would break the hot path."""
+    with _lock:
+        # list() copies throughout: producers bump/insert without the
+        # lock, and a first-time key landing mid-iteration must not
+        # raise "dictionary changed size during iteration"
+        for sc, d in list(_counter_scopes.items()):
+            if scope is None or sc == scope:
+                for k in list(d):
+                    d[k] = 0
+        for sc, s in list(_timing_scopes.items()):
+            if scope is None or sc == scope:
+                s.clear()
+        if scope is None:
+            _gauges.clear()

@@ -1,0 +1,73 @@
+"""paddle_tpu.profiler.timeline — pure-host span buffer + chrome trace.
+
+The host backend of `RecordEvent`: while a Profiler record window is
+open, begin/end pairs append (name, tid, t0, t1) spans here
+(perf_counter seconds). Export renders them as chrome-trace "X"
+complete events — a valid trace JSON with zero libtpu involvement, so
+`export_chrome_tracing` works on a CPU-only process. When a real
+device trace also ran, jax/xprof writes its own files into the same
+directory and TensorBoard overlays both views.
+
+`add_span` outside an active window is a single boolean check — span
+cost exists only inside a recording Profiler (the telemetry-overhead
+contract in ISSUE 3's acceptance criteria).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+_lock = threading.Lock()
+_active = False
+_spans: list = []
+
+
+def active():
+    return _active
+
+
+def start():
+    global _active
+    with _lock:
+        _spans.clear()
+        _active = True
+
+
+def stop():
+    """Close the window and return its spans."""
+    global _active
+    with _lock:
+        _active = False
+        out = list(_spans)
+        _spans.clear()
+    return out
+
+
+def add_span(name, t0, t1, tid=None):
+    if not _active:
+        return
+    _spans.append((name, tid if tid is not None else threading.get_ident(),
+                   t0, t1))
+
+
+def to_chrome_trace(spans, meta=None):
+    """Chrome-trace document (dict) for a span list; `meta` (telemetry
+    snapshot, step times) rides along under the "paddle_tpu" key —
+    chrome://tracing ignores unknown top-level keys, and
+    `load_profiler_result` reads it back."""
+    pid = os.getpid()
+    evs = [{"name": n, "ph": "X", "cat": "host",
+            "ts": round(t0 * 1e6, 3), "dur": round((t1 - t0) * 1e6, 3),
+            "pid": pid, "tid": tid}
+           for n, tid, t0, t1 in spans]
+    doc = {"traceEvents": evs, "displayTimeUnit": "ms"}
+    if meta:
+        doc["paddle_tpu"] = meta
+    return doc
+
+
+def write_chrome_trace(path, spans, meta=None):
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(spans, meta), f)
+    return path

@@ -7,6 +7,9 @@ from __future__ import annotations
 
 import itertools
 import random as _random
+import time as _time
+
+from .profiler import registry as _registry
 
 __all__ = ["cache", "map_readers", "buffered", "compose", "chain",
            "shuffle", "firstn", "xmap_readers", "multiprocess_reader",
@@ -149,11 +152,16 @@ def buffered(reader, size):
         t.start()
         try:
             while True:
+                # consumer-side wait = how far the producer lags; feeds
+                # the "timings.reader.buffered_wait" telemetry
+                t0 = _time.perf_counter()
                 sample = q.get()
                 if sample is end:
                     if err:
                         raise err[0]
                     return
+                _registry.timing("reader.buffered_wait",
+                                 _time.perf_counter() - t0)
                 yield sample
         finally:
             stop.set()

@@ -74,7 +74,14 @@ class UserCollate:
 def worker_main(ring_name, job_blob, worker_id, nw):
     """`job_blob` is cloudpickle-serialized (dataset, collate, batches,
     worker_init_fn) — cloudpickle so datasets/collates defined in local
-    scopes or __main__ survive the forkserver/spawn boundary."""
+    scopes or __main__ survive the forkserver/spawn boundary.
+
+    The DONE frame carries this worker's telemetry (batches produced,
+    busy seconds — collate + pickle, ring-write backpressure excluded);
+    the parent folds it into the profiler registry and tolerates an
+    empty payload."""
+    import time
+
     import cloudpickle
 
     ShmRing = _shm_ring_cls()
@@ -83,11 +90,17 @@ def worker_main(ring_name, job_blob, worker_id, nw):
     try:
         if worker_init_fn is not None:
             worker_init_fn(worker_id)
+        busy = 0.0
+        produced = 0
         for bi in range(worker_id, len(batches), nw):
+            t0 = time.perf_counter()
             payload = pickle.dumps(
                 collate([dataset[i] for i in batches[bi]]), protocol=4)
+            busy += time.perf_counter() - t0
             wring.write(payload, tag=bi)
-        wring.write(b"", tag=_DONE_TAG)
+            produced += 1
+        wring.write(pickle.dumps({"n_batches": produced, "busy_s": busy}),
+                    tag=_DONE_TAG)
     except BaseException as e:  # surface the real error to the parent
         wring.write(pickle.dumps(
             (type(e).__name__, str(e), traceback.format_exc())),

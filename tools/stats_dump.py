@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""Pretty-print paddle_tpu observability artifacts.
+
+Accepts any of:
+  * a chrome-trace JSON exported by `profiler.export_chrome_tracing`
+    (host spans + embedded telemetry snapshot),
+  * a bench.py log / JSONL stream containing `{"metric": "telemetry"}`
+    lines,
+  * a bare counters/snapshot JSON dict.
+
+Pure stdlib on purpose — no paddle_tpu / jax import, so it runs anywhere
+the artifact landed (CI box, laptop) in milliseconds.
+
+Usage:
+    python tools/stats_dump.py /tmp/paddle_tpu_profile/worker0.json
+    python tools/stats_dump.py bench_output.log
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _fmt_bytes(n):
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+
+
+def _print_counters(counters, indent="  "):
+    if not counters:
+        return
+    width = max(len(k) for k in counters)
+    for k in sorted(counters):
+        v = counters[k]
+        shown = _fmt_bytes(v) if k.endswith(".bytes") else v
+        print(f"{indent}{k:<{width}}  {shown}")
+
+
+def _print_timings(timings, indent="  "):
+    if not timings:
+        return
+    width = max(len(k) for k in timings)
+    print(f"{indent}{'name':<{width}}  {'count':>8} {'total_ms':>12} "
+          f"{'mean_ms':>10}")
+    for k in sorted(timings):
+        rec = timings[k]
+        print(f"{indent}{k:<{width}}  {rec.get('count', 0):>8} "
+              f"{rec.get('total_s', 0.0) * 1e3:>12.3f} "
+              f"{rec.get('mean_ms', 0.0):>10.3f}")
+
+
+def _print_snapshot(snap):
+    if snap.get("counters"):
+        print("counters:")
+        _print_counters(snap["counters"])
+    if snap.get("gauges"):
+        print("gauges:")
+        _print_counters(snap["gauges"])
+    if snap.get("timings"):
+        print("timings:")
+        _print_timings(snap["timings"])
+
+
+def _dump_trace(doc):
+    spans = {}
+    for e in doc.get("traceEvents", ()):
+        if e.get("ph") != "X":
+            continue
+        rec = spans.setdefault(e.get("name", "?"), [0, 0.0])
+        rec[0] += 1
+        rec[1] += float(e.get("dur", 0.0)) / 1e3
+    if spans:
+        print("host spans:")
+        width = max(len(k) for k in spans)
+        print(f"  {'name':<{width}}  {'count':>8} {'total_ms':>12} "
+              f"{'avg_ms':>10}")
+        for name, (cnt, tot) in sorted(spans.items(), key=lambda kv:
+                                       -kv[1][1]):
+            print(f"  {name:<{width}}  {cnt:>8} {tot:>12.3f} "
+                  f"{tot / cnt:>10.3f}")
+    else:
+        print("host spans: (none)")
+    meta = doc.get("paddle_tpu", {})
+    if meta:
+        steps = meta.pop("step_times_ms", None)
+        _print_snapshot(meta)
+        if steps:
+            print(f"steps: {len(steps)} "
+                  f"avg={sum(steps) / len(steps):.3f}ms")
+
+
+def _dump_jsonl(path):
+    found = 0
+    with open(path) as f:
+        for ln in f:
+            ln = ln.strip()
+            if not ln:
+                continue
+            try:
+                rec = json.loads(ln)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("metric") == "telemetry":
+                found += 1
+                print(f"-- telemetry record #{found} --")
+                _print_snapshot(rec)
+    return found
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="trace JSON / telemetry JSONL / "
+                                 "counters dict")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            doc = json.load(f)
+    except ValueError:
+        # not one JSON document: scan it as a JSONL/log stream
+        if not _dump_jsonl(args.path):
+            print(f"{args.path}: no JSON document and no telemetry lines",
+                  file=sys.stderr)
+            return 1
+        return 0
+    if isinstance(doc, dict) and "traceEvents" in doc:
+        _dump_trace(doc)
+    elif isinstance(doc, dict) and ("counters" in doc or "timings" in doc
+                                    or "gauges" in doc):
+        _print_snapshot(doc)
+    elif isinstance(doc, dict):
+        _print_counters(doc, indent="")
+    else:
+        print(f"{args.path}: unrecognized JSON shape", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
