@@ -31,6 +31,12 @@ def set_flags(flags: dict):
         if k not in _FLAGS:
             raise KeyError(f"unknown flag {k!r}")
         _FLAGS[k] = v
+        if k == "FLAGS_fault_inject":
+            # re-arm the injection harness live (testing/faults.py reads
+            # the flag once at import; runtime flips go through here)
+            from ..testing import faults
+
+            faults.configure(v)
 
 
 def get_flags(flags):
@@ -56,3 +62,6 @@ define_flag("FLAGS_use_autotune", True, "let XLA autotune (always on)")
 define_flag("FLAGS_cudnn_deterministic", False, "deterministic ops (XLA flag)")
 define_flag("FLAGS_embedding_deterministic", 0, "deterministic embedding grad")
 define_flag("FLAGS_jit_ops", True, "per-op jit compile cache for eager mode")
+define_flag("FLAGS_fault_inject", "",
+            "deterministic fault-injection spec (testing/faults.py), e.g. "
+            "'kill_at_step:step=7;store_flaky:fails=2' — empty = disarmed")

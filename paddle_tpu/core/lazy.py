@@ -55,7 +55,7 @@ from ..profiler import registry as _registry
 from ..profiler import timeline as _timeline
 
 __all__ = ["LazyArray", "enabled", "lazy_guard", "build", "force",
-           "stats", "capture_guard", "donate_guard"]
+           "stats", "capture_guard", "donate_guard", "drop_plans"]
 
 _state = threading.local()
 
@@ -73,7 +73,8 @@ _EXEC_CACHE_MAX = 512
 _counters = _registry.scoped_counters("lazy", {
     "materializations": 0, "cache_hits": 0, "nodes_built": 0,
     "replay_ops": 0, "captured_steps": 0, "capture_promotions": 0,
-    "capture_fallbacks": 0, "donated_steps": 0})
+    "capture_fallbacks": 0, "donated_steps": 0,
+    "capture_invalidations": 0})
 
 # Step-capture knobs. _CAPTURE_K = consecutive identical-signature
 # materializations before promotion (>= 2: one to build the signature,
@@ -843,6 +844,37 @@ def _build_plan(key, topo, keep, leaves, outs):
     plan.last_out = [a for tup in outs for a in tup]
     plan.misses = 0
     return plan
+
+
+def drop_plans(why="external state change"):
+    """Invalidate every captured step plan of THIS thread (checkpoint
+    restore with changed avals, a model surgery, a test boundary).
+
+    This is the explicit invalidation path for the fault-tolerance
+    stack: a resume that restores buffers IN PLACE (same Tensor
+    identity, same avals — incubate/checkpoint.restore_training_state)
+    must NOT call this: the captured plan verifies per-op against avals
+    and wiring, so same-shape restored values replay the cached
+    executable directly — no retrace storm after a restart. Only an
+    aval-changing restore needs the plans gone, and dropping them here
+    (one explainer event, one counter) beats the implicit alternative:
+    three divergence fallbacks per plan, each re-recording a full
+    prefix. Returns the number of plans dropped."""
+    plans = getattr(_state, "plans", None)
+    n = len(plans) if plans else 0
+    if plans:
+        for plan in list(plans.values()):
+            _unregister_plan(plan)
+        plans.clear()
+    streaks = getattr(_state, "streaks", None)
+    if streaks is not None:
+        streaks.clear()
+    if getattr(_state, "session", None) is not None:
+        _state.session = None
+    if n:
+        _counters["capture_invalidations"] += n
+        _explain.record("capture_invalidate", why=why, n_plans=n)
+    return n
 
 
 def _unregister_plan(plan):

@@ -27,7 +27,15 @@ import sys
 import threading
 import time
 
+from ...profiler import explainer as _explain
+from ...profiler import registry as _registry
+
 __all__ = ["ElasticManager", "ElasticStatus"]
+
+# recoveries are observable (ISSUE 4): every trainer restart / world
+# resize lands in the fault.* telemetry scope + explainer ring
+_counters = _registry.scoped_counters("fault", {
+    "elastic.restarts": 0, "elastic.resizes": 0})
 
 
 class ElasticStatus:
@@ -274,6 +282,12 @@ class ElasticManager:
                     return ElasticStatus.EXIT
                 self.register()  # lease under the new generation
                 self.need_restart = False
+                _counters["elastic.resizes"] += 1
+                _explain.record(
+                    "elastic_resize", op="run",
+                    why=f"re-rendezvous at generation {self.gen} with "
+                        f"world {len(self.members)}",
+                    gen=self.gen, members=list(self.members))
                 continue  # resize restart is not a failure
             if proc.returncode == 0:
                 self.stop()
@@ -282,4 +296,12 @@ class ElasticManager:
             if restarts > max_restarts:
                 self.stop()
                 return ElasticStatus.ERROR
-            time.sleep(1.0)
+            _counters["elastic.restarts"] += 1
+            _explain.record(
+                "elastic_restart", op="run",
+                why=f"trainer crashed rc={proc.returncode}; in-place "
+                    f"restart {restarts}/{max_restarts} with backoff",
+                rc=proc.returncode, attempt=restarts)
+            # exponential backoff: a crash-looping trainer must not spin
+            # the host (reference elastic manager waits before respawn)
+            time.sleep(min(1.0 * (2 ** (restarts - 1)), 30.0))

@@ -38,9 +38,31 @@ def _parse():
     p.add_argument("--log_dir", default="log")
     p.add_argument("--devices", default=None,
                    help="visible device ids (TPU_VISIBLE_DEVICES)")
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get(
+                       "PADDLE_LAUNCH_MAX_RESTARTS", "3")),
+                   help="per-rank restart budget before the pod gives up "
+                        "(reference elastic manager contract; env "
+                        "PADDLE_LAUNCH_MAX_RESTARTS overrides the default)")
+    p.add_argument("--restart_backoff", type=float, default=1.0,
+                   help="base seconds for exponential restart backoff "
+                        "(doubles per consecutive restart of one rank)")
+    p.add_argument("--terminate_grace", type=float, default=10.0,
+                   help="seconds between SIGTERM and SIGKILL on teardown "
+                        "(TPU preemption grace for emergency checkpoints)")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
+
+
+def _rc_describe(rc):
+    """Human-readable exit status: 'rc=1' or 'signal SIGKILL (rc=-9)'."""
+    if rc is not None and rc < 0:
+        try:
+            return f"signal {signal.Signals(-rc).name} (rc={rc})"
+        except ValueError:
+            return f"signal {-rc} (rc={rc})"
+    return f"rc={rc}"
 
 
 def _local_ip(probe_ip=None):
@@ -64,47 +86,139 @@ def _free_port():
 
 
 class Pod:
-    """Group of local trainer procs (reference launch/job/pod.py)."""
+    """Group of local trainer procs (reference launch/job/pod.py).
 
-    def __init__(self):
+    Fault tolerance (ISSUE 4 tentpole level 3): a crashed rank is
+    restarted in place with exponential backoff up to `max_restarts`
+    times instead of tearing down the whole pod; when a rendezvous
+    store exists the restart publishes a new elastic generation so
+    surviving ranks re-rendezvous (fleet/elastic.py contract) rather
+    than dying with the failed one. Teardown escalates SIGTERM →
+    SIGKILL after a grace window and REAPS every child (a trainer that
+    ignores SIGTERM used to hang the launcher forever).
+    """
+
+    def __init__(self, max_restarts=3, restart_backoff=1.0,
+                 terminate_grace=10.0, store=None, log=None):
         self.procs: list[subprocess.Popen] = []
+        self.specs: list[tuple] = []  # (cmd, env, log_path) per local rank
+        self.restarts: list[int] = []
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff = float(restart_backoff)
+        self.terminate_grace = float(terminate_grace)
+        self.store = store
+        self._log = log or (lambda msg: print(f"[launch] {msg}",
+                                              file=sys.stderr, flush=True))
 
     def spawn(self, cmd, env, log_path):
         os.makedirs(os.path.dirname(log_path) or ".", exist_ok=True)
-        f = open(log_path, "w")
+        f = open(log_path, "a")
         proc = subprocess.Popen(cmd, env=env, stdout=f, stderr=f)
         self.procs.append(proc)
+        self.specs.append((cmd, env, log_path))
+        self.restarts.append(0)
         return proc
 
+    def _respawn(self, i):
+        cmd, env, log_path = self.specs[i]
+        env = dict(env)
+        env["PADDLE_RESTART_COUNT"] = str(self.restarts[i])
+        f = open(log_path, "a")
+        self.procs[i] = subprocess.Popen(cmd, env=env, stdout=f, stderr=f)
+
+    def _bump_generation(self):
+        """Publish a new elastic generation through the rendezvous store
+        so surviving ranks re-rendezvous with the restarted trainer.
+        Mirrors fleet/elastic.py _publish exactly: exclusive claim via
+        add()==1 (a racing launcher/survivor must not double-bump),
+        members written FIRST (a bump without members wedges every
+        watcher), then the gen pointer. Membership is the unchanged
+        GLOBAL world — an in-place restart replaces a rank, it does not
+        shrink the job (local proc indices would evict every remote
+        rank)."""
+        if self.store is None:
+            return
+        try:
+            env = self.specs[0][1] or {}
+            world = int(env.get("PADDLE_TRAINERS_NUM", len(self.procs)))
+            gen = int(self.store.add("elastic/gen", 0))
+            if int(self.store.add(f"elastic/claim/{gen + 1}", 1)) != 1:
+                return  # another publisher owns generation gen+1
+            members = ",".join(str(r) for r in range(world))
+            self.store.set(f"elastic/members/{gen + 1}", members)
+            if int(self.store.add("elastic/gen", 0)) == gen:
+                self.store.add("elastic/gen", 1)
+        except Exception as e:  # rendezvous best-effort: restart anyway
+            self._log(f"elastic generation bump failed: {e}")
+
     def watch(self):
-        """Reference watcher: exit when any proc fails, kill the rest."""
+        """Supervise until every rank exits 0 (return 0), a rank exhausts
+        its restart budget (return its rc), or Ctrl-C. Restart backoff is
+        a per-rank DEADLINE, not an inline sleep: one crash-looping rank
+        at the 30 s cap must not stall death-detection, respawns, or
+        Ctrl-C for its siblings."""
+        done = [False] * len(self.procs)
+        respawn_at = [None] * len(self.procs)  # pending backoff deadline
         try:
             while True:
-                for p in self.procs:
+                now = time.time()
+                for i, p in enumerate(self.procs):
+                    if done[i]:
+                        continue
+                    if respawn_at[i] is not None:
+                        if now >= respawn_at[i]:
+                            respawn_at[i] = None
+                            self._bump_generation()
+                            self._respawn(i)
+                        continue
                     rc = p.poll()
-                    if rc is not None:
-                        if rc != 0:
-                            self.terminate()
-                            return rc
-                        if all(q.poll() is not None for q in self.procs):
-                            return 0
-                time.sleep(0.5)
+                    if rc is None:
+                        continue
+                    if rc == 0:
+                        done[i] = True
+                        self._log(f"rank {i} finished (rc=0)")
+                        continue
+                    self._log(f"rank {i} died: {_rc_describe(rc)} "
+                              f"(restart {self.restarts[i] + 1}/"
+                              f"{self.max_restarts})")
+                    if self.restarts[i] >= self.max_restarts:
+                        self._log(f"rank {i} exhausted its restart budget"
+                                  f" — terminating pod")
+                        self.terminate()
+                        return rc
+                    delay = min(self.restart_backoff *
+                                (2 ** self.restarts[i]), 30.0)
+                    self.restarts[i] += 1
+                    respawn_at[i] = now + delay
+                if all(done):
+                    return 0
+                time.sleep(0.2)
         except KeyboardInterrupt:
             self.terminate()
             return 1
 
     def terminate(self):
-        for p in self.procs:
+        for i, p in enumerate(self.procs):
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
         t0 = time.time()
-        while time.time() - t0 < 10:
+        while time.time() - t0 < self.terminate_grace:
             if all(p.poll() is not None for p in self.procs):
-                return
+                break
             time.sleep(0.2)
-        for p in self.procs:
+        for i, p in enumerate(self.procs):
             if p.poll() is None:
+                self._log(f"rank {i} ignored SIGTERM for "
+                          f"{self.terminate_grace:.0f}s — escalating to "
+                          f"SIGKILL")
                 p.kill()
+        for i, p in enumerate(self.procs):
+            # reap: wait() collects the zombie and records the final rc
+            try:
+                rc = p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                rc = None
+            self._log(f"rank {i} terminated: {_rc_describe(rc)}")
 
 
 def _rendezvous(args):
@@ -150,8 +264,10 @@ def _rendezvous(args):
 
 def launch():
     args = _parse()
-    pod = Pod()
     endpoints, coordinator, store = _rendezvous(args)
+    pod = Pod(max_restarts=args.max_restarts,
+              restart_backoff=args.restart_backoff,
+              terminate_grace=args.terminate_grace, store=store)
     world = args.nnodes * args.nproc_per_node
     master = args.master or "127.0.0.1:8070"
 

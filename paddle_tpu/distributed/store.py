@@ -12,9 +12,21 @@ import ctypes
 import os
 import time
 
+from ..profiler import registry as _registry
+from ..testing import faults as _faults
+
 __all__ = ["TCPStore"]
 
 _LIB = None
+
+# rendezvous ops ride over real networks: one dropped packet during the
+# join window must not kill a pod (ISSUE 4). Transient transport errors
+# (ConnectionError from the injection harness, RuntimeError transport
+# failures from the C ABI) are retried with exponential backoff; retry
+# counts land in the fault.* telemetry scope so flaky links are visible.
+_RETRIES = max(0, int(os.environ.get("PADDLE_TPU_STORE_RETRIES", "3")))
+_BACKOFF_S = float(os.environ.get("PADDLE_TPU_STORE_BACKOFF", "0.05"))
+_counters = _registry.scoped_counters("fault", {"store.retries": 0})
 
 
 def _load():
@@ -81,20 +93,52 @@ class TCPStore:
         if not self._client:
             raise TimeoutError(f"cannot connect TCPStore at {host}:{port}")
 
+    def _retry(self, opname, attempt_fn):
+        """Run one store op, retrying transient transport errors with
+        exponential backoff (the reference TCPStore client reconnects
+        inside libc10d; this is the ctypes-binding equivalent)."""
+        tries = 0
+        while True:
+            try:
+                return attempt_fn()
+            except (ConnectionError, RuntimeError):
+                if tries >= _RETRIES:
+                    raise
+                _counters["store.retries"] += 1
+                time.sleep(_BACKOFF_S * (2 ** tries))
+                tries += 1
+
     def set(self, key, value):
         if isinstance(value, str):
             value = value.encode()
-        with self._lock:
-            rc = self._lib.tcpstore_set(self._client, key.encode(), value,
-                                        len(value))
-        if rc != 0:
-            raise RuntimeError("TCPStore.set failed")
+
+        def attempt():
+            if _faults.ACTIVE:
+                _faults.store_op("set")
+            with self._lock:
+                rc = self._lib.tcpstore_set(self._client, key.encode(),
+                                            value, len(value))
+            if rc != 0:
+                raise RuntimeError("TCPStore.set transport failure")
+
+        self._retry("set", attempt)
 
     def get(self, key):
         """Blocking get (reference TCPStore::get waits for the key)."""
         deadline = time.time() + self.timeout
         buf = ctypes.create_string_buffer(1 << 20)
+        transient = 0
         while True:
+            if _faults.ACTIVE:
+                try:
+                    _faults.store_op("get")
+                except ConnectionError:
+                    transient += 1
+                    if transient > _RETRIES:
+                        raise
+                    _counters["store.retries"] += 1
+                    time.sleep(_BACKOFF_S * (2 ** (transient - 1)))
+                    continue
             with self._lock:
                 n = self._lib.tcpstore_get(self._client, key.encode(), buf,
                                            len(buf))
@@ -105,17 +149,32 @@ class TCPStore:
             if n >= 0:
                 return buf.raw[:n]
             if n == -2:
-                raise RuntimeError("TCPStore.get transport error")
+                transient += 1
+                if transient > _RETRIES:
+                    raise RuntimeError("TCPStore.get transport error")
+                _counters["store.retries"] += 1
+                time.sleep(_BACKOFF_S * (2 ** (transient - 1)))
+                continue
             if time.time() > deadline:
                 raise TimeoutError(f"TCPStore.get({key!r}) timed out")
             time.sleep(0.02)
 
     def add(self, key, amount=1):
-        with self._lock:
-            v = self._lib.tcpstore_add(self._client, key.encode(), amount)
-        if v == -(2 ** 63):
-            raise RuntimeError("TCPStore.add failed")
-        return v
+        # NOTE: add() retries only failures reported BEFORE the server
+        # applied the increment (local rc sentinel / injected pre-call
+        # faults) — the elastic claim protocol's add()==1 exclusivity is
+        # preserved across retries.
+        def attempt():
+            if _faults.ACTIVE:
+                _faults.store_op("add")
+            with self._lock:
+                v = self._lib.tcpstore_add(self._client, key.encode(),
+                                           amount)
+            if v == -(2 ** 63):
+                raise RuntimeError("TCPStore.add transport failure")
+            return v
+
+        return self._retry("add", attempt)
 
     def wait(self, keys, timeout=None):
         if isinstance(keys, str):
@@ -133,7 +192,21 @@ class TCPStore:
 
     def check(self, key):
         """Non-blocking existence test (reference TCPStore::check)."""
-        return self._check_locked(key) == 1
+
+        def attempt():
+            if _faults.ACTIVE:
+                _faults.store_op("check")
+            rc = self._check_locked(key)
+            if rc < 0:
+                # C ABI: 1=exists, 0=missing, -1=transport error — the
+                # error must RAISE (and be retried), not read as
+                # "missing": elastic polls leases via check(), and one
+                # dropped packet misread as an expired lease evicts a
+                # live member
+                raise RuntimeError("TCPStore.check transport failure")
+            return rc == 1
+
+        return self._retry("check", attempt)
 
     def num_keys(self):
         with self._lock:

@@ -41,19 +41,69 @@ def _from_saveable(obj):
     return obj
 
 
-def save(obj, path, protocol=4, **configs):
-    """`paddle.save` — pickle of numpy-converted nests (io.py:656)."""
+def _fsync_dir(d):
+    """Make a just-committed rename durable (best effort: some
+    filesystems refuse O_RDONLY dir fds)."""
+    try:
+        fd = os.open(d or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_bytes(data: bytes, path: str):
+    """tmp + fsync + rename: a reader never observes a partial file —
+    either the previous content or the complete new one (ISSUE 4; the
+    reference's fleet checkpointing relies on the same rename contract).
+    The tmp name is pid-qualified so concurrent writers (per-rank
+    sharded saves into one directory) never clobber each other."""
     d = os.path.dirname(path)
     if d:
         os.makedirs(d, exist_ok=True)
-    with open(path, "wb") as f:
-        pickle.dump(_to_saveable(obj), f, protocol=protocol)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(d)
+
+
+def save(obj, path, protocol=4, **configs):
+    """`paddle.save` — pickle of numpy-converted nests (io.py:656).
+
+    The write is atomic: the object is serialized fully in memory, then
+    committed via tmp+fsync+rename — a crash mid-save (preemption, OOM
+    kill) leaves the previous checkpoint intact instead of a truncated
+    pickle."""
+    atomic_write_bytes(pickle.dumps(_to_saveable(obj), protocol=protocol),
+                       path)
 
 
 def load(path, **configs):
     """`paddle.load` (io.py:898). return_numpy=True yields raw ndarrays."""
-    with open(path, "rb") as f:
-        obj = pickle.load(f)
+    try:
+        with open(path, "rb") as f:
+            obj = pickle.load(f)
+    except (EOFError, pickle.UnpicklingError) as e:
+        raise RuntimeError(
+            f"checkpoint {path!r} is corrupt or truncated "
+            f"({type(e).__name__}: {e}). If this file is one of a series "
+            f"of training checkpoints, use "
+            f"paddle_tpu.incubate.checkpoint.load_latest(dir) to fall "
+            f"back to the newest valid one.") from e
     if configs.get("return_numpy"):
         def strip(o):
             if isinstance(o, dict) and o.get("__tensor__"):

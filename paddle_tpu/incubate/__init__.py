@@ -4,6 +4,7 @@ from . import nn  # noqa: F401
 from . import distributed  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .optimizer import ModelAverage  # noqa: F401
 # NOTE: incubate.multiprocessing is intentionally NOT imported eagerly —
 # importing it registers ForkingPickler reducers that change how Tensors

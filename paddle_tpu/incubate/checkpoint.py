@@ -1,0 +1,498 @@
+"""Fault-tolerant checkpoint engine (ISSUE 4 tentpole, levels 1–2).
+
+The GPT-6.7B north star trains for days on preemptible v5p pods: every
+layer here exists so a SIGKILL at any instant loses at most one save
+interval and never a checkpoint.
+
+Checkpoint layout — one directory per step under the user's base dir::
+
+    <dir>/ckpt-00000042/
+        data-rank00000.pkl        payload: pickled numpy-snapshot nest
+        data-rank00001.pkl        (per-rank shards in distributed runs)
+        MANIFEST-rank00001.json   per-shard integrity record (ranks > 0)
+        MANIFEST.json             rank 0's record + global commit marker
+
+Write protocol (per rank): serialize the snapshot in memory → payload
+via tmp+fsync+rename → manifest via tmp+fsync+rename, LAST.  The
+manifest doubles as the commit marker: a crash at any point leaves
+either a fully-valid checkpoint or a prefix that `load_latest` skips
+(missing manifest, checksum mismatch, or truncated pickle all count as
+"not committed").
+
+MANIFEST.json schema (v1)::
+
+    {"schema": 1, "step": 42, "epoch": 3, "time": 1722700000.0,
+     "rank": 0, "world_size": 1,
+     "files": {"data-rank00000.pkl": {"crc32": 912..., "bytes": 10240}},
+     "rng": {"data": [1818844716, 7], "typed": true},
+     "user": {...}}                        # caller-supplied metadata
+
+Async saves: `save()` snapshots device buffers to host numpy on the
+caller (train) thread — the only part that must see a consistent
+step boundary — and hands serialization + disk I/O to a single writer
+thread, so the train loop never blocks on storage (the bench.py ratio
+gate runs with this on).  Retention keeps the newest `max_to_keep`
+committed checkpoints; pruning runs on the writer thread after each
+commit and never touches the checkpoint just written.
+
+Telemetry (PR-3 registry): `checkpoint.saves/async_saves/restores/
+skipped_corrupt/pruned` counters, `checkpoint:save.snapshot/save.write/
+restore` timings, and `checkpoint_save`/`checkpoint_restore`/
+`checkpoint_skip` explainer events — every recovery is observable.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import queue
+import re
+import shutil
+import signal
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..core.tensor import Tensor
+from ..framework import _from_saveable, _to_saveable, atomic_write_bytes
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+from ..testing import faults as _faults
+
+__all__ = ["CheckpointManager", "CheckpointHook", "load_latest",
+           "save_checkpoint", "latest_step", "capture_training_state",
+           "restore_training_state"]
+
+SCHEMA = 1
+_CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
+
+_counters = _registry.scoped_counters("checkpoint", {
+    "saves": 0, "async_saves": 0, "restores": 0, "skipped_corrupt": 0,
+    "pruned": 0, "emergency_saves": 0})
+
+
+def _ckpt_dir(base, step):
+    return os.path.join(base, f"ckpt-{int(step):08d}")
+
+
+def _payload_name(rank):
+    return f"data-rank{int(rank):05d}.pkl"
+
+
+def _manifest_name(rank):
+    return "MANIFEST.json" if rank == 0 else f"MANIFEST-rank{int(rank):05d}.json"
+
+
+def list_steps(base):
+    """Committed-or-partial checkpoint steps under `base`, ascending."""
+    try:
+        entries = os.listdir(base)
+    except OSError:
+        return []
+    steps = []
+    for e in entries:
+        m = _CKPT_RE.match(e)
+        if m:
+            steps.append(int(m.group(1)))
+    return sorted(steps)
+
+
+# -- RNG state ----------------------------------------------------------------
+
+def _rng_snapshot():
+    """Global PRNG key → JSON-able blob (typed keys via key_data)."""
+    import jax
+
+    from ..core import random as prandom
+
+    k = prandom.get_rng_state()
+    try:
+        data = jax.random.key_data(k)
+        typed = True
+    except (TypeError, ValueError):
+        data, typed = k, False
+    return {"data": np.asarray(data).astype(np.uint32).tolist(),
+            "typed": typed}
+
+
+def _rng_restore(blob):
+    import jax
+    import jax.numpy as jnp
+
+    from ..core import random as prandom
+
+    if not blob:
+        return
+    data = jnp.asarray(np.asarray(blob["data"], np.uint32))
+    key = jax.random.wrap_key_data(data) if blob.get("typed") else data
+    prandom.set_rng_state(key)
+
+
+# -- manager ------------------------------------------------------------------
+
+class CheckpointManager:
+    """Atomic + async checkpoint writer with rolling retention.
+
+    Usage::
+
+        mgr = CheckpointManager(dir, max_to_keep=3)
+        mgr.save(state, step=i)        # returns before the disk write
+        ...
+        mgr.wait()                     # barrier (end of training / tests)
+
+    `state` is any `paddle_tpu.save`-able nest (Tensors are snapshotted
+    to numpy on the calling thread). Distributed runs construct one
+    manager per rank with `rank`/`world_size`; each rank writes its own
+    shard + manifest and only rank 0 prunes.
+    """
+
+    def __init__(self, dir, max_to_keep=3, async_save=True, rank=0,
+                 world_size=1):
+        self.dir = str(dir)
+        self.max_to_keep = max(1, int(max_to_keep)) if max_to_keep else None
+        self.rank = int(rank)
+        self.world_size = int(world_size)
+        self._async = bool(async_save)
+        self._q: queue.Queue = queue.Queue(maxsize=2)
+        self._writer = None
+        self._error = None
+        os.makedirs(self.dir, exist_ok=True)
+
+    # -- save ---------------------------------------------------------------
+    def save(self, state, step, epoch=None, user_meta=None, block=False):
+        """Snapshot `state` and commit it as checkpoint `step`.
+
+        Returns once the snapshot (device→host copy) is taken; the
+        serialization + write happen on the writer thread unless the
+        manager is synchronous or `block=True`. A failed write surfaces
+        on the NEXT save()/wait() call."""
+        self._reraise()
+        with _registry.time_block("save.snapshot", scope="checkpoint"):
+            payload = _to_saveable(state)
+            rng = _rng_snapshot()
+        job = {"step": int(step), "epoch": epoch, "payload": payload,
+               "rng": rng, "user": user_meta}
+        if self._async and not block:
+            if self._writer is None:
+                self._writer = threading.Thread(
+                    target=self._writer_loop, daemon=True,
+                    name="ckpt-writer")
+                self._writer.start()
+            self._q.put(job)  # maxsize bounds in-flight host copies
+            _counters["async_saves"] += 1
+        else:
+            self._write(job)
+        return _ckpt_dir(self.dir, step)
+
+    def wait(self):
+        """Block until every queued save is durable; re-raise the first
+        writer error if one occurred."""
+        if self._writer is not None:
+            self._q.join()
+        self._reraise()
+
+    def _reraise(self):
+        if self._error is not None:
+            err, self._error = self._error, None
+            raise err
+
+    def _writer_loop(self):
+        while True:
+            job = self._q.get()
+            try:
+                self._write(job)
+            except BaseException as e:  # surfaced on next save()/wait()
+                self._error = e
+            finally:
+                self._q.task_done()
+
+    def _write(self, job):
+        t0 = time.perf_counter()
+        step = job["step"]
+        d = _ckpt_dir(self.dir, step)
+        os.makedirs(d, exist_ok=True)
+        blob = pickle.dumps(job["payload"], protocol=4)
+        payload_path = os.path.join(d, _payload_name(self.rank))
+        atomic_write_bytes(blob, payload_path)
+        if _faults.ACTIVE:
+            # deterministic torn-write simulation: fires AFTER the commit
+            # so load_latest's skip-and-fall-back path is what's tested
+            _faults.fire("truncate_checkpoint", path=payload_path)
+        manifest = {
+            "schema": SCHEMA, "step": step, "epoch": job["epoch"],
+            "time": time.time(), "rank": self.rank,
+            "world_size": self.world_size,
+            "files": {_payload_name(self.rank):
+                      {"crc32": zlib.crc32(blob), "bytes": len(blob)}},
+            "rng": job["rng"], "user": job["user"],
+        }
+        atomic_write_bytes(
+            json.dumps(manifest, indent=1).encode(),
+            os.path.join(d, _manifest_name(self.rank)))
+        dt = time.perf_counter() - t0
+        _registry.timing("save.write", dt, scope="checkpoint")
+        _counters["saves"] += 1
+        _explain.record("checkpoint_save", op="save",
+                        why=f"step {step} committed in {dt * 1e3:.1f} ms",
+                        step=step, dir=d, bytes=len(blob))
+        if self.rank == 0 and self.max_to_keep:
+            self._prune()
+
+    def _prune(self):
+        steps = list_steps(self.dir)
+        committed = [s for s in steps if os.path.exists(
+            os.path.join(_ckpt_dir(self.dir, s), "MANIFEST.json"))]
+        if not committed:
+            return
+        keep = set(committed[-self.max_to_keep:])
+        newest = committed[-1]
+        for s in steps:
+            # anything newer than the newest commit may be mid-commit
+            # (another rank's writer); uncommitted leftovers OLDER than
+            # it are dead writers and go with the retention sweep
+            if s in keep or s >= newest:
+                continue
+            shutil.rmtree(_ckpt_dir(self.dir, s), ignore_errors=True)
+            _counters["pruned"] += 1
+
+
+# -- load ---------------------------------------------------------------------
+
+def _read_manifest(d, rank):
+    try:
+        with open(os.path.join(d, _manifest_name(rank))) as f:
+            m = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(m, dict) or m.get("schema") != SCHEMA:
+        return None
+    return m
+
+
+def _load_one(base, step, rank):
+    """One checkpoint dir → (state, manifest) or (None, reason)."""
+    d = _ckpt_dir(base, step)
+    commit = _read_manifest(d, 0)
+    if commit is None:
+        return None, "no commit marker (MANIFEST.json missing/invalid)"
+    manifest = commit if rank == 0 else _read_manifest(d, rank)
+    if manifest is None:
+        return None, f"rank {rank} shard manifest missing/invalid"
+    name = _payload_name(rank)
+    rec = (manifest.get("files") or {}).get(name)
+    if rec is None:
+        return None, f"manifest has no record for {name}"
+    try:
+        with open(os.path.join(d, name), "rb") as f:
+            blob = f.read()
+    except OSError as e:
+        return None, f"payload unreadable ({e})"
+    if len(blob) != rec.get("bytes") or zlib.crc32(blob) != rec.get("crc32"):
+        return None, (f"payload checksum mismatch (got {len(blob)} bytes, "
+                      f"manifest says {rec.get('bytes')})")
+    try:
+        state = _from_saveable(pickle.loads(blob))
+    except Exception as e:
+        return None, f"payload unpicklable ({type(e).__name__}: {e})"
+    return state, commit
+
+
+def load_latest(base, rank=0):
+    """Newest VALID checkpoint under `base` → (state, manifest), or
+    (None, None) when none exists. Corrupt/partial checkpoints (torn
+    payload, missing manifest, bad checksum) are skipped with a
+    `checkpoint_skip` explainer event — never a crash."""
+    t0 = time.perf_counter()
+    for step in reversed(list_steps(base)):
+        state, man = _load_one(base, step, rank)
+        if state is not None:
+            _registry.timing("restore", time.perf_counter() - t0,
+                             scope="checkpoint")
+            _counters["restores"] += 1
+            _explain.record("checkpoint_restore", op="load_latest",
+                            why=f"restored step {man['step']} from "
+                                f"{_ckpt_dir(base, step)}",
+                            step=man["step"], rank=rank)
+            return state, man
+        _counters["skipped_corrupt"] += 1
+        _explain.record("checkpoint_skip", op="load_latest",
+                        why=f"skipping ckpt-{step:08d}: {man}",
+                        step=step, rank=rank)
+    return None, None
+
+
+def latest_step(base, rank=0):
+    """Step of the newest valid checkpoint, or None."""
+    for step in reversed(list_steps(base)):
+        if _load_one(base, step, rank)[0] is not None:
+            return step
+    return None
+
+
+def save_checkpoint(base, state, step, epoch=None, user_meta=None,
+                    max_to_keep=None, rank=0, world_size=1):
+    """One-shot synchronous checkpoint commit (atomic, checksummed)."""
+    mgr = CheckpointManager(base, max_to_keep=max_to_keep, async_save=False,
+                            rank=rank, world_size=world_size)
+    return mgr.save(state, step, epoch=epoch, user_meta=user_meta)
+
+
+# -- training-state capture/restore ------------------------------------------
+
+def capture_training_state(network, optimizer=None):
+    """Model params/buffers + optimizer slots as one saveable nest.
+
+    The nest ALIASES the live Tensors (zero-copy): hand it straight to
+    `CheckpointManager.save`, which snapshots to host numpy on the
+    calling thread before the train loop mutates anything."""
+    net = getattr(network, "network", network)  # hapi Model or raw Layer
+    state = {"model": dict(net.state_dict())}
+    if optimizer is not None:
+        state["optimizer"] = optimizer.state_dict()
+    return state
+
+
+def restore_training_state(network, optimizer, state):
+    """Restore params + optimizer slots IN PLACE.
+
+    Identity preservation is the point: the lazy step-capture engine
+    (core/lazy.py) keys its captured plans on leaf Tensor identity and
+    avals — restoring by `set_value` into the live Tensors means a
+    resume continues replaying the already-captured whole-step
+    executable instead of re-tracing. Only when a restored aval differs
+    (shape/dtype change — a different model) are the thread's capture
+    plans dropped, explicitly and observably."""
+    net = getattr(network, "network", network)
+    sd = state.get("model", state)
+    own = net.state_dict()
+    changed = []
+    for name, t in own.items():
+        if name not in sd:
+            continue
+        v = sd[name]
+        arr = v.numpy() if isinstance(v, Tensor) else np.asarray(v)
+        if tuple(arr.shape) == tuple(t._data.shape):
+            t.set_value(arr)  # dtype follows the live param (set_value casts)
+        else:
+            import jax.numpy as jnp
+
+            t._data = jnp.asarray(arr)
+            changed.append(name)
+    if optimizer is not None and "optimizer" in state:
+        optimizer._ensure_accumulators()
+        optimizer.set_state_dict(state["optimizer"])
+    if changed:
+        from ..core import lazy
+
+        lazy.drop_plans(
+            f"checkpoint restore changed avals of {changed[:3]}"
+            + ("…" if len(changed) > 3 else ""))
+    return changed
+
+
+# -- TrainStep-level hook -----------------------------------------------------
+
+class CheckpointHook:
+    """Step-loop driver tying the manager to preemption + injection.
+
+    Wire it into any train loop (hand-rolled, TrainStep, or lazy)::
+
+        hook = CheckpointHook(dir, net, opt, save_interval=50)
+        start = hook.restore()                  # 0 on a fresh run
+        for step in range(start, total):
+            loss = train_step(batch(step))
+            if hook.on_step_end(step) == "preempted":
+                break                            # emergency ckpt written
+        hook.wait()
+
+    On SIGTERM (TPU preemption grace) the handler only sets a flag; the
+    NEXT `on_step_end` writes a synchronous emergency checkpoint and
+    reports "preempted", so the save always lands on a step boundary
+    with consistent param/optimizer state.
+    """
+
+    def __init__(self, dir, network, optimizer=None, save_interval=100,
+                 max_to_keep=3, async_save=True, rank=0, world_size=1,
+                 install_sigterm=True):
+        self.manager = CheckpointManager(dir, max_to_keep=max_to_keep,
+                                         async_save=async_save, rank=rank,
+                                         world_size=world_size)
+        self._net = network
+        self._opt = optimizer
+        self.save_interval = max(1, int(save_interval))
+        self._preempt = threading.Event()
+        self._old_handler = None
+        if install_sigterm:
+            self.install_sigterm()
+
+    def install_sigterm(self):
+        """Install the preemption handler (main thread only — elsewhere
+        the caller owns signal routing and uses request_preempt())."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+        try:
+            self._old_handler = signal.signal(
+                signal.SIGTERM, lambda signum, frame: self._preempt.set())
+        except ValueError:
+            return False
+        return True
+
+    def uninstall_sigterm(self):
+        if self._old_handler is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._old_handler)
+            except ValueError:
+                pass
+            self._old_handler = None
+
+    def request_preempt(self):
+        """Programmatic preemption (tests; non-main-thread callers)."""
+        self._preempt.set()
+
+    @property
+    def preempt_requested(self):
+        return self._preempt.is_set()
+
+    def restore(self):
+        """Resume from the newest valid checkpoint: restores params,
+        optimizer slots, and RNG in place; returns the step to run next
+        (0 on a fresh start)."""
+        state, man = load_latest(self.manager.dir, rank=self.manager.rank)
+        if state is None:
+            return 0
+        restore_training_state(self._net, self._opt, state)
+        _rng_restore(man.get("rng"))
+        return int(man["step"]) + 1
+
+    def on_step_end(self, step, epoch=None, user_meta=None):
+        """Call once per completed step. Returns "preempted" after an
+        emergency save (caller should exit cleanly), else "saved" or
+        "ok"."""
+        if _faults.ACTIVE:
+            _faults.fire("kill_at_step", step=step)
+        state = None
+        if self._preempt.is_set():
+            state = capture_training_state(self._net, self._opt)
+            self.manager.save(state, step, epoch=epoch, block=True,
+                              user_meta={"emergency": True,
+                                         **(user_meta or {})})
+            _counters["emergency_saves"] += 1
+            _explain.record(
+                "checkpoint_save", op="emergency",
+                why=f"SIGTERM: emergency checkpoint at step boundary {step}",
+                step=step)
+            return "preempted"
+        if (step + 1) % self.save_interval == 0:
+            state = capture_training_state(self._net, self._opt)
+            self.manager.save(state, step, epoch=epoch, user_meta=user_meta)
+            return "saved"
+        return "ok"
+
+    def wait(self):
+        self.manager.wait()
+
+    def close(self):
+        self.wait()
+        self.uninstall_sigterm()

@@ -196,12 +196,21 @@ class Optimizer:
     clear_gradients = clear_grad
 
     # -- state ----------------------------------------------------------------
+    def _slot_key(self, name, p, i):
+        """Serialized key for one accumulator slot. Unnamed parameters
+        key by POSITION in the parameter list (`p<i>`), not `id(p)`:
+        object ids are meaningless in another process, and a checkpoint
+        written by one run must restore the slots of a freshly-built
+        model in the next (fault-tolerant resume, ISSUE 4). Construction
+        order is deterministic, so position is a stable identity."""
+        return f"{name}/{p.name or f'p{i}'}"
+
     def state_dict(self):
         sd = {}
         for name, store in self._accumulators.items():
-            for p in self._parameter_list:
+            for i, p in enumerate(self._parameter_list):
                 if p is not None and id(p) in store:
-                    sd[f"{name}/{p.name or id(p)}"] = store[id(p)]
+                    sd[self._slot_key(name, p, i)] = store[id(p)]
         if isinstance(self._learning_rate, LRScheduler):
             sd["LR_Scheduler"] = self._learning_rate.state_dict()
         sd["_opt_step"] = self._opt_step
@@ -213,13 +222,23 @@ class Optimizer:
                                                        LRScheduler):
             self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
         for name, store in self._accumulators.items():
-            for p in self._parameter_list:
-                key = f"{name}/{p.name or id(p)}"
+            for i, p in enumerate(self._parameter_list):
+                key = self._slot_key(name, p, i)
                 if p is not None and key in state_dict:
                     v = state_dict[key]
-                    t = v if isinstance(v, Tensor) else Tensor(v)
-                    t._donatable = True  # restored slot stays loop-carried
-                    store[id(p)] = t
+                    existing = store.get(id(p))
+                    arr = v._data if isinstance(v, Tensor) else v
+                    if existing is not None and \
+                            tuple(existing._data.shape) == \
+                            tuple(np.shape(arr)):
+                        # in-place: live captured-step plans key leaves by
+                        # Tensor identity — replacing the slot object would
+                        # force a re-capture after every resume
+                        existing.set_value(np.asarray(arr))
+                    else:
+                        t = v if isinstance(v, Tensor) else Tensor(arr)
+                        t._donatable = True  # restored slot stays loop-carried
+                        store[id(p)] = t
 
     # -- static (declarative) mode hooks --------------------------------------
     _STATIC_ACCS: list[str] = []

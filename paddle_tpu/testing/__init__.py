@@ -1,0 +1,8 @@
+"""paddle_tpu.testing — test-support utilities.
+
+`faults` is the deterministic fault-injection harness (ISSUE 4): every
+recovery path in the fault-tolerance stack — checkpoint corruption,
+rank death, flaky rendezvous store, NaN losses — can be triggered on
+demand, so resilience is tested, not assumed.
+"""
+from . import faults  # noqa: F401

@@ -1,0 +1,209 @@
+"""Deterministic fault injection (ISSUE 4 tentpole level 4).
+
+Every failure mode the fault-tolerance stack claims to survive has an
+injection point here, so recovery paths are exercised by ordinary tests
+instead of waiting for a real preemption. Injection is OFF by default
+and costs one module-global bool check per potential site; configuring
+a spec arms only the named points.
+
+Spec grammar (`FLAGS_fault_inject` / env `FLAGS_fault_inject`), also
+accepted by :func:`configure` directly::
+
+    point[:k=v[,k=v...]][;point...]
+
+    "kill_at_step:step=7"                die hard at step 7 (SIGKILL rc)
+    "kill_at_step:step=7,rank=1"         only on trainer rank 1
+    "nan_loss:step=5"                    loss becomes NaN at step 5
+    "truncate_checkpoint:nth=2"          2nd committed payload is torn
+    "truncate_checkpoint:nth=2,bytes=17" ... keeping only 17 bytes
+    "store_flaky:fails=3"                first 3 store ops raise
+    "store_flaky:fails=3,op=set"         ... only set()s
+    "store_slow:delay=0.2"               every store op sleeps 0.2 s
+
+Points (consumed by the named subsystems):
+
+    ==================  =======================================  ============
+    point               site                                     params
+    ==================  =======================================  ============
+    kill_at_step        checkpoint.CheckpointHook.on_step_end    step, rank
+    nan_loss            hapi Model.train_batch                   step, rank
+    truncate_checkpoint incubate/checkpoint writer (post-commit) nth, bytes
+    store_flaky         distributed/store.py TCPStore ops        fails, op
+    store_slow          distributed/store.py TCPStore ops        delay, op
+    ==================  =======================================  ============
+
+Each firing bumps `fault.injected.<point>` in the telemetry registry and
+records a `fault_injected` explainer event, so recoveries show up in
+`profiler.stats()` / `profiler.explain()` — observable, never silent.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+
+__all__ = ["configure", "reset", "fire", "store_op", "spec", "ACTIVE"]
+
+# fast-path gate: production call sites check this module global before
+# calling into fire() — an unarmed process pays one attribute load
+ACTIVE = False
+
+_points: dict = {}
+_counters = _registry.scoped_counters("fault", {})
+
+
+def _coerce(v):
+    try:
+        return int(v)
+    except ValueError:
+        try:
+            return float(v)
+        except ValueError:
+            return v
+
+
+def parse_spec(text):
+    """Parse the spec grammar into {point: {param: value}}."""
+    table: dict = {}
+    for part in (text or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        point, _, args = part.partition(":")
+        params = {}
+        for kv in args.split(","):
+            kv = kv.strip()
+            if not kv:
+                continue
+            k, _, v = kv.partition("=")
+            params[k.strip()] = _coerce(v.strip())
+        table[point.strip()] = params
+    return table
+
+
+def configure(spec_or_table):
+    """Arm the harness. Accepts a spec string or a parsed table; an
+    empty/falsy argument disarms (same as :func:`reset`)."""
+    global ACTIVE
+    table = parse_spec(spec_or_table) if isinstance(spec_or_table, str) \
+        else dict(spec_or_table or {})
+    _points.clear()
+    for point, params in table.items():
+        _points[point] = {"params": dict(params), "count": 0}
+        _counters.setdefault(f"armed.{point}", 0)
+        _counters[f"armed.{point}"] += 1
+    ACTIVE = bool(_points)
+    return dict(table)
+
+
+def reset():
+    """Disarm every injection point (does not clear fault.* counters —
+    the telemetry registry owns those)."""
+    global ACTIVE
+    _points.clear()
+    ACTIVE = False
+
+
+def spec():
+    """The armed table (for tests/diagnostics)."""
+    return {k: dict(v["params"]) for k, v in _points.items()}
+
+
+def _from_flag():
+    """Re-arm from FLAGS_fault_inject — called once per process at first
+    fire-site import; env var FLAGS_fault_inject seeds the flag default
+    (core/flags.py), so subprocesses inherit the spec for free."""
+    try:
+        from ..core.flags import flag
+
+        text = flag("FLAGS_fault_inject")
+    except Exception:
+        text = os.environ.get("FLAGS_fault_inject", "")
+    if text:
+        configure(text)
+
+
+_from_flag()
+
+
+def _record(point, why, **detail):
+    key = f"injected.{point}"
+    _counters[key] = _counters.get(key, 0) + 1
+    _explain.record("fault_injected", op=point, why=why, **detail)
+
+
+def fire(point, step=None, rank=None, path=None, op=None):
+    """Evaluate one injection point. Returns True when the fault fired
+    (for points whose effect the CALLER applies: nan_loss), raises for
+    store_flaky, sleeps for store_slow, truncates for
+    truncate_checkpoint, and never returns for kill_at_step."""
+    ent = _points.get(point)
+    if ent is None:
+        return False
+    p = ent["params"]
+    want_rank = p.get("rank")
+    if want_rank is not None and rank is None:
+        rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if want_rank is not None and int(want_rank) != int(rank):
+        return False
+
+    if point == "kill_at_step":
+        if step is None or int(step) != int(p.get("step", -1)):
+            return False
+        _record(point, f"killing rank at step {step}", step=step, rank=rank)
+        # die like a preempted/OOM-killed worker: no atexit, no flush of
+        # pending async checkpoint writes, SIGKILL-style return code
+        os._exit(137)
+
+    if point == "nan_loss":
+        if step is None or int(step) != int(p.get("step", -1)):
+            return False
+        _record(point, f"loss poisoned with NaN at step {step}", step=step)
+        return True
+
+    if point == "truncate_checkpoint":
+        ent["count"] += 1
+        if ent["count"] != int(p.get("nth", 1)):
+            return False
+        keep = int(p.get("bytes", 0))
+        try:
+            with open(path, "r+b") as f:
+                f.truncate(keep)
+        except OSError:
+            return False
+        _record(point, f"truncated committed checkpoint to {keep} bytes",
+                path=str(path))
+        return True
+
+    if point == "store_flaky":
+        want_op = p.get("op")
+        if want_op is not None and want_op != op:
+            return False
+        if ent["count"] >= int(p.get("fails", 1)):
+            return False
+        ent["count"] += 1
+        _record(point, f"transient store failure #{ent['count']} ({op})",
+                store_op=op)
+        raise ConnectionError(
+            f"injected transient TCPStore.{op} failure "
+            f"({ent['count']}/{int(p.get('fails', 1))})")
+
+    if point == "store_slow":
+        want_op = p.get("op")
+        if want_op is not None and want_op != op:
+            return False
+        _record(point, f"store {op} delayed {p.get('delay', 0.1)}s",
+                store_op=op)
+        time.sleep(float(p.get("delay", 0.1)))
+        return True
+
+    return False
+
+
+def store_op(op):
+    """Combined store_slow + store_flaky site for TCPStore methods (one
+    call per op keeps the store code to a single guarded line)."""
+    fire("store_slow", op=op)
+    fire("store_flaky", op=op)

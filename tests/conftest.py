@@ -42,6 +42,13 @@ except RuntimeError:  # a backend already initialized — reset, then retry
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`: the slow tier holds multi-process
+    # fault/elastic tests whose wall clock exceeds ~10s standalone
+    config.addinivalue_line(
+        "markers", "slow: long multi-process tests excluded from tier-1")
+
+
 @pytest.fixture(autouse=True, scope="module")
 def _clear_jax_caches_per_module():
     """Drop compiled executables between test modules: a full-suite process

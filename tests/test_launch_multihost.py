@@ -75,7 +75,7 @@ def test_two_node_world_allreduce(tmp_path):
         procs.append(subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.distributed.launch",
              "--master", master, "--nnodes", "2", "--rank", str(rank),
-             "--nproc_per_node", "1",
+             "--nproc_per_node", "1", "--max_restarts", "0",
              "--log_dir", str(tmp_path / f"log{rank}"), str(script)],
             env=env, cwd=str(tmp_path)))
     deadline = time.time() + proc_timeout(300)

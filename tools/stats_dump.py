@@ -52,16 +52,32 @@ def _print_timings(timings, indent="  "):
               f"{rec.get('mean_ms', 0.0):>10.3f}")
 
 
+_FT_PREFIXES = ("checkpoint.", "fault.")
+
+
 def _print_snapshot(snap):
-    if snap.get("counters"):
+    counters = dict(snap.get("counters") or {})
+    timings = dict(snap.get("timings") or {})
+    # fault-tolerance telemetry (ISSUE 4) gets its own section: recovery
+    # counters and checkpoint save/restore timings are the first thing an
+    # operator wants after a preemption, not buried in the general table
+    ft_counters = {k: counters.pop(k) for k in list(counters)
+                   if k.startswith(_FT_PREFIXES)}
+    ft_timings = {k: timings.pop(k) for k in list(timings)
+                  if k.startswith(_FT_PREFIXES)}
+    if ft_counters or ft_timings:
+        print("fault tolerance:")
+        _print_counters(ft_counters)
+        _print_timings(ft_timings)
+    if counters:
         print("counters:")
-        _print_counters(snap["counters"])
+        _print_counters(counters)
     if snap.get("gauges"):
         print("gauges:")
         _print_counters(snap["gauges"])
-    if snap.get("timings"):
+    if timings:
         print("timings:")
-        _print_timings(snap["timings"])
+        _print_timings(timings)
 
 
 def _dump_trace(doc):
