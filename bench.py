@@ -314,6 +314,77 @@ def _run_ratio_child():
     return 0
 
 
+def _run_serve_child():
+    """--serve mode: continuous-batching serving microbench on CPU. A
+    gpt-micro GenerationServer takes a staggered mixed workload (prompt
+    lengths spanning both buckets, different token budgets, greedy and
+    sampled requests) after a warmup pass, and the line reports sustained
+    tokens/sec plus mean batch occupancy — the serving-health pair the
+    ISSUE-5 acceptance gates on. Convention matches --ratio: the
+    telemetry line prints first, the {"metric": "serving"} result line
+    stays last."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import time as _t
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForPretraining,
+                                       GPTModel)
+    from paddle_tpu.profiler import registry as _reg
+    from paddle_tpu.serving import GenerationServer
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, n_layer=2, n_head=2, d_model=64,
+                    seq_len=64, initializer_range=0.3)
+    model = GPTForPretraining(GPTModel(cfg))
+    server = GenerationServer(model, max_batch_size=4, buckets=(16, 32),
+                              max_queue_size=32)
+    server.start()
+    rng = np.random.default_rng(0)
+
+    # warmup: compile prefill for BOTH buckets + the decode step once
+    for pl in (8, 20):
+        server.generate(list(rng.integers(1, 128, pl)), max_new_tokens=4)
+
+    c0 = dict(_reg.counters("serving"))
+    reqs = []
+    t0 = _t.perf_counter()
+    for i in range(12):
+        pl = int(rng.integers(4, 30))
+        reqs.append(server.submit(
+            list(rng.integers(1, 128, pl)),
+            max_new_tokens=int(rng.integers(8, 24)),
+            temperature=0.8 if i % 3 == 0 else 0.0, seed=i))
+        _t.sleep(0.01)  # staggered arrivals: admissions land mid-flight
+    for r in reqs:
+        r.result(timeout=300)
+    dt = _t.perf_counter() - t0
+    c1 = dict(_reg.counters("serving"))
+    server.shutdown()
+
+    tokens = sum(len(r.tokens) for r in reqs)
+    steps = c1["decode_steps"] - c0["decode_steps"]
+    occ = ((c1["active_slot_steps"] - c0["active_slot_steps"])
+           / (steps * server.engine.max_batch_size)) if steps else 0.0
+    ttft = _reg.timings("serving").get("serving.ttft", {})
+    _telemetry_line()
+    rec = {
+        "metric": "serving",
+        "value": round(tokens / dt, 1),
+        "unit": "tokens/s",
+        "mean_occupancy": round(occ, 4),
+        "requests": len(reqs),
+        "tokens": tokens,
+        "ttft_ms_mean": round(ttft.get("mean_ms", 0.0), 2),
+        "decode_compiles": c1["decode_compiles"],
+        "decode_compiles_after_warmup":
+            c1["decode_compiles"] - c0["decode_compiles"],
+        "prefill_compiles": c1["prefill_compiles"],
+        "platform": "cpu",
+    }
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
 def _run_child(preset, batch, seq, policy="full"):
     """--run mode: execute one config and print its JSON lines
     (telemetry first, the metric record last)."""
@@ -454,6 +525,8 @@ def main():
         return _run_child(*sys.argv[2:6])
     if len(sys.argv) > 1 and sys.argv[1] == "--ratio":
         return _run_ratio_child()
+    if len(sys.argv) > 1 and sys.argv[1] == "--serve":
+        return _run_serve_child()
 
     deadline = time.time() + TOTAL_BUDGET
     results = []

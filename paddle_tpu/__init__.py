@@ -109,6 +109,7 @@ import importlib as _importlib
 linalg = _importlib.import_module(".linalg", __name__)
 from . import fft  # noqa: F401,E402
 from . import inference  # noqa: F401,E402
+from . import serving  # noqa: F401,E402
 from . import quantization  # noqa: F401,E402
 from . import signal  # noqa: F401,E402
 from .framework import save, load, in_dynamic_mode, enable_static, \

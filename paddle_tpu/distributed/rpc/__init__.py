@@ -334,11 +334,22 @@ def shutdown():
         _state = None
     try:
         _store_barrier(store, "stop", agent.world_size)
-    except (RuntimeError, OSError):
+    except (ConnectionError, RuntimeError) as e:
         # the rank hosting the TCPStore exits as soon as ITS poll sees
         # the barrier complete; a slower rank's next poll then hits a
-        # dead store. The store being gone implies the host passed this
-        # same barrier, which implies every participant already arrived
-        # — proceeding is the barrier's postcondition, not a bypass.
-        pass
-    agent.stop()
+        # dead store — connection refused/reset, or the ctypes binding's
+        # transport-failure RuntimeError after its retries. The store
+        # being gone implies the host passed this same barrier, which
+        # implies every participant already arrived — proceeding is the
+        # barrier's postcondition, not a bypass. ONLY those two shapes
+        # are swallowed: any other RuntimeError/OSError is a genuine
+        # store failure BEFORE the barrier completed and must surface,
+        # not read as a finished barrier.
+        if isinstance(e, RuntimeError) and \
+                "transport" not in str(e).lower():
+            raise
+    finally:
+        # _state was already cleared, so a retried shutdown() is a no-op:
+        # stop the agent on EVERY path — a propagating store failure must
+        # not leak the listener thread/socket forever.
+        agent.stop()

@@ -19,7 +19,8 @@ import numpy as np
 import jax.numpy as jnp
 
 __all__ = ["Config", "Predictor", "Tensor", "create_predictor",
-           "PrecisionType", "PlaceType", "get_version"]
+           "create_generation_engine", "PrecisionType", "PlaceType",
+           "get_version"]
 
 
 def get_version():
@@ -282,3 +283,18 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+def create_generation_engine(model, **engine_options):
+    """Predictor-style entry for generation workloads: wrap a live decoder
+    model (GPT first) in a `paddle_tpu.serving.GenerationEngine` —
+    preallocated bucketed KV cache, compile-once prefill/decode,
+    continuous batching via `serving.GenerationServer`.
+
+    One-shot dense inference stays on `create_predictor` (a saved
+    StableHLO artifact); generation is a live-model loop, so this entry
+    takes the model object, not a Config. `engine_options` forward to
+    GenerationEngine (`max_batch_size`, `buckets`, `max_seq_len`)."""
+    from ..serving import GenerationEngine
+
+    return GenerationEngine(model, **engine_options)

@@ -19,12 +19,34 @@ TPU-first design decisions:
 from __future__ import annotations
 
 import math
+import warnings
 
 import numpy as np
 
 from .. import nn, ops
 from ..nn import functional as F
 from ..nn.initializer import Normal
+
+# one-time nudge off the growing-concat KV-cache path (below): it changes
+# the [B, t] cache shapes every generated token, so XLA recompiles the
+# whole decode step per token — serving.GenerationEngine's bucketed slot
+# cache is the shape-stable replacement (compiles once, then replays)
+_legacy_cache_warned = False
+
+
+def _warn_legacy_cache():
+    global _legacy_cache_warned
+    if _legacy_cache_warned:
+        return
+    _legacy_cache_warned = True
+    warnings.warn(
+        "GPTModel's growing-concat KV-cache path (caches= without "
+        "cache_offsets=) concatenates onto the cache, so every generated "
+        "token changes tensor shapes and forces a fresh XLA compile of the "
+        "decode step. For real generation use "
+        "paddle_tpu.serving.GenerationEngine, which preallocates a "
+        "bucketed slot cache and compiles the decode step exactly once.",
+        UserWarning, stacklevel=4)
 
 
 class GPTConfig:
@@ -108,10 +130,38 @@ class GPTAttention(nn.Layer):
         self.qkv_proj.weight.sharding_spec = (None, "mp")
         self.out_proj.weight.sharding_spec = ("mp", None)
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, cache_offset=None, seq_lens=None):
         B, T, D = x.shape
         qkv = self.qkv_proj(x).reshape([B, T, 3, self.n_head, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
+        if cache is not None and cache_offset is not None:
+            # Slot-cache path (paddle_tpu.serving): `cache` is a
+            # preallocated [B, S, H, Dh] buffer; the T new rows are written
+            # in place at per-slot positions cache_offset[b]..+T (a
+            # dynamic_update_slice-style scatter — fixed shapes, so the
+            # whole step compiles once), and attention reads the full
+            # buffer under a causal-by-absolute-position AND valid-length
+            # mask so neither stale slot rows nor bucket padding leak in.
+            k_buf, v_buf = cache
+            S = k_buf.shape[1]
+            rows = cache_offset.unsqueeze(1) + ops.arange(0, T,
+                                                          dtype="int32")
+            idx = ops.broadcast_to(
+                rows.unsqueeze(-1).unsqueeze(-1),
+                [B, T, self.n_head, self.head_dim])
+            k_buf = ops.put_along_axis(k_buf, idx, k, axis=1)
+            v_buf = ops.put_along_axis(v_buf, idx, v, axis=1)
+            jpos = ops.arange(0, S, dtype="int32")
+            mask = ops.logical_and(
+                jpos.unsqueeze(0).unsqueeze(0) <= rows.unsqueeze(-1),
+                jpos.unsqueeze(0).unsqueeze(0)
+                < seq_lens.unsqueeze(-1).unsqueeze(-1))
+            out = F.scaled_dot_product_attention(
+                q, k_buf, v_buf, attn_mask=mask.unsqueeze(1),
+                is_causal=False, dropout_p=self.dropout_p,
+                training=self.training)
+            out = self.out_proj(out.reshape([B, T, D]))
+            return out, (k_buf, v_buf)
         if cache is not None:
             k = ops.concat([cache[0], k], axis=1)
             v = ops.concat([cache[1], v], axis=1)
@@ -160,9 +210,11 @@ class GPTBlock(nn.Layer):
         x = x + self.dropout(self.attn(self.ln1(x)))
         return x + self.mlp(self.ln2(x))
 
-    def forward(self, x, cache=None):
+    def forward(self, x, cache=None, cache_offset=None, seq_lens=None):
         if cache is not None:
-            a, new_cache = self.attn(self.ln1(x), cache=cache)
+            a, new_cache = self.attn(self.ln1(x), cache=cache,
+                                     cache_offset=cache_offset,
+                                     seq_lens=seq_lens)
             x = x + self.dropout(a)
             return x + self.mlp(self.ln2(x)), new_cache
         if self._recompute and self.training:
@@ -206,12 +258,16 @@ class GPTModel(nn.Layer):
         if cfg.dtype != "float32":
             self.to(dtype=cfg.dtype)
 
-    def forward(self, input_ids, position_ids=None, caches=None):
+    def forward(self, input_ids, position_ids=None, caches=None,
+                cache_offsets=None, seq_lens=None):
+        if caches is not None and cache_offsets is None:
+            _warn_legacy_cache()
         x = self.embeddings(input_ids, position_ids)
         if caches is not None:
             new_caches = []
             for blk, c in zip(self.blocks, caches):
-                x, nc = blk(x, cache=c)
+                x, nc = blk(x, cache=c, cache_offset=cache_offsets,
+                            seq_lens=seq_lens)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
         for blk in self.blocks:

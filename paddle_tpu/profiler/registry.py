@@ -14,8 +14,12 @@ through tiny functions; none of this allocates on the steady path.
 Scopes in use (see DESIGN_DECISIONS.md "Observability layer" for the
 meaning of each counter): `lazy` (capture/replay engine), `dispatch`
 (eager per-op jit cache), `collective` / `mp` (call + byte counters),
-`dataloader` (worker batches), timings scopes `timings` (host waits),
-`op_time` (FLAGS_benchmark per-op wall time).
+`dataloader` (worker batches), `serving` (generation engine: request
+lifecycle, prefill/decode compiles, occupancy; plus `serving`-scope
+timings ttft/queue_wait/prefill/decode_step and the
+`serving.tokens_per_sec` / `serving.batch_occupancy` gauges), timings
+scopes `timings` (host waits), `op_time` (FLAGS_benchmark per-op wall
+time).
 """
 from __future__ import annotations
 

@@ -1,0 +1,39 @@
+"""paddle_tpu.serving — continuous-batching generation for decoder LMs.
+
+The inference-workload half of the north star: the reference framework's
+serving layer is AnalysisPredictor (one-shot ``Predictor.run()``, mirrored
+by ``paddle_tpu.inference``); generation traffic needs the opposite shape —
+long-lived, mid-flight batching, KV-cache reuse. This package provides it,
+following Orca's iteration-level continuous batching (Yu et al., OSDI'22)
+and vLLM's preallocate-don't-grow cache management (Kwon et al., SOSP'23),
+re-designed for XLA's static-shape world: length BUCKETS instead of pages,
+one contiguous slot-major cache instead of an indirection table, so prefill
+compiles once per bucket and the decode step compiles exactly once.
+
+Layers (one file each):
+  * ``engine``    — compiled prefill/decode over a preallocated slot cache
+  * ``scheduler`` — bounded admission queue + per-request stop conditions
+  * ``sampling``  — greedy/temperature/top-k/top-p, seed-deterministic
+  * ``server``    — threaded submit()/result()/generate() frontend with
+                    backpressure, deadlines, and SIGTERM-style drain
+
+Quickstart::
+
+    from paddle_tpu.serving import GenerationServer
+    server = GenerationServer(model, max_batch_size=8,
+                              buckets=(64, 256), max_queue_size=64).start()
+    req = server.submit(prompt_ids, max_new_tokens=64, temperature=0.8)
+    print(server.result(req).tokens)      # or: server.generate(prompt_ids)
+    server.shutdown()                     # graceful drain
+"""
+from .engine import GenerationEngine  # noqa: F401
+from .scheduler import (  # noqa: F401
+    ContinuousBatchScheduler, GenerationRequest, QueueFullError,
+    RequestStatus)
+from .server import GenerationServer  # noqa: F401
+from . import sampling  # noqa: F401
+
+__all__ = [
+    "GenerationEngine", "ContinuousBatchScheduler", "GenerationRequest",
+    "QueueFullError", "RequestStatus", "GenerationServer", "sampling",
+]

@@ -1,0 +1,386 @@
+"""paddle_tpu.serving.engine — slot-major generation engine for decoders.
+
+The continuous-batching design follows Orca (Yu et al., OSDI'22): the unit
+of scheduling is one decode ITERATION, not one request, so finished slots
+are evicted and refilled mid-flight without touching their neighbors. The
+cache-management idea follows vLLM's PagedAttention (Kwon et al.,
+SOSP'23) in spirit — preallocate KV memory up front instead of growing
+per-token — but adapted to XLA's static-shape world: instead of pages and
+an indirection table (a gather per attention read), the cache is one
+contiguous ``[max_batch, max_seq_len, heads, head_dim]`` buffer per layer,
+slot-major, and PROMPT shapes are padded to a small set of length buckets.
+
+Compile discipline (the whole point on a TPU):
+
+* prefill compiles once per bucket — the input is ``[1, bucket_len]``, the
+  real prompt length is data (``prompt_len`` array), never a shape;
+* the decode step compiles exactly once — fixed ``[max_batch, 1]`` query,
+  in-place ``dynamic_update_slice``-style cache writes at per-slot
+  positions (via ``ops.put_along_axis`` inside the model's slot-cache
+  forward path), valid-length masking instead of shape changes;
+* every per-request difference (current length, sampling config, RNG key,
+  activity) is an ARRAY argument, so no workload mix can retrace.
+
+The engine tracks call signatures itself, mirroring ``jax.jit``'s aval
+cache: any signature first-seen bumps ``serving.prefill_compiles`` /
+``serving.decode_compiles`` and lands a ``serving_prefill_compile`` /
+``serving_decode_compile`` event in the profiler explainer ring — a decode
+retrace storm is loud (``profiler.explain()``) instead of a silent 100x
+slowdown. Host spans (``serving_prefill`` / ``serving_decode_step``) and
+``serving.*`` counters/timings ride the same observability stack as the
+training runtime.
+
+Slot lifecycle: free → (prefill: prompt rows written at offset 0, first
+token sampled) → active (each decode step appends one row at the slot's
+own cursor) → released (eviction = flipping a host bit; the stale rows are
+masked by the next occupant's ``seq_lens`` until its prefill overwrites
+them). Inactive slots still flow through the decode step — their lane
+computes garbage that nothing reads — because a data-dependent batch size
+would be a shape change.
+"""
+from __future__ import annotations
+
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _ag
+from ..core import lazy as _lazy
+from ..core import random as _random
+from ..core.tensor import Tensor
+from ..profiler import RecordEvent
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+from . import sampling as _sampling
+
+_counters = _registry.scoped_counters("serving", {
+    "prefills": 0, "decode_steps": 0, "tokens_generated": 0,
+    "active_slot_steps": 0, "prefill_compiles": 0, "decode_compiles": 0,
+    "bucket_promotions": 0})
+
+
+def _default_buckets(max_seq_len):
+    """Powers-of-two ladder up to max_seq_len (always included): few enough
+    that prefill compiles stay cheap, dense enough that short prompts don't
+    pay full-length attention."""
+    out = []
+    b = 16
+    while b < max_seq_len:
+        out.append(b)
+        b *= 2
+    out.append(max_seq_len)
+    return tuple(out)
+
+
+class GenerationEngine:
+    """Wraps a decoder LM (GPT first) with a preallocated slot-major KV
+    cache and compiled prefill/decode steps. The engine owns device compute
+    and per-slot state; request lifecycle (stop conditions, queueing) lives
+    in ``serving.scheduler``. Not thread-safe — drive it from one thread
+    (``serving.GenerationServer`` does).
+    """
+
+    def __init__(self, model, max_batch_size=4, buckets=None,
+                 max_seq_len=None):
+        gpt = getattr(model, "gpt", model)
+        if not hasattr(gpt, "blocks") or not hasattr(gpt, "embeddings"):
+            raise TypeError(
+                "GenerationEngine needs a GPTModel-shaped decoder "
+                "(blocks + embeddings + ln_f); got "
+                f"{type(model).__name__}")
+        self._model = model
+        self._gpt = gpt
+        cfg = gpt.cfg
+        self.max_seq_len = int(max_seq_len or cfg.seq_len)
+        if self.max_seq_len > cfg.seq_len:
+            raise ValueError(
+                f"max_seq_len {self.max_seq_len} exceeds the model's "
+                f"position-embedding range {cfg.seq_len}")
+        self.max_batch_size = int(max_batch_size)
+        if self.max_batch_size < 1:
+            raise ValueError("max_batch_size must be >= 1")
+        if buckets is None:
+            buckets = _default_buckets(self.max_seq_len)
+        self.buckets = tuple(sorted(
+            {int(b) for b in buckets if 0 < int(b) <= self.max_seq_len}))
+        if not self.buckets:
+            raise ValueError(
+                f"no usable prompt buckets in {buckets!r} "
+                f"(need 0 < bucket <= max_seq_len={self.max_seq_len})")
+
+        # generation is inference: dropout off, or padded lanes would
+        # perturb nothing but sampled RNG streams would diverge
+        if hasattr(model, "eval"):
+            model.eval()
+
+        # params/buffers bound by name once; the pure step fns take the
+        # arrays as arguments (StaticFunction's state-swap idiom), so a
+        # weight update never needs an engine rebuild — same avals, same
+        # compiled steps
+        self._state = dict(gpt.state_dict())
+        self._names = list(self._state)
+        wt = gpt.embeddings.word_embeddings.weight
+        self._emb_idx = next(
+            i for i, n in enumerate(self._names) if self._state[n] is wt)
+        self._dtype = wt._data.dtype
+
+        B, S = self.max_batch_size, self.max_seq_len
+        self._kv_shapes = [(B, S, blk.attn.n_head, blk.attn.head_dim)
+                           for blk in gpt.blocks]
+        self._k = [jnp.zeros(s, self._dtype) for s in self._kv_shapes]
+        self._v = [jnp.zeros(s, self._dtype) for s in self._kv_shapes]
+
+        # host-side slot state, mirrored into the decode step as arrays
+        self._active = np.zeros(B, bool)
+        self._cur_lens = np.zeros(B, np.int32)
+        self._last_tokens = np.zeros(B, np.int32)
+        self._gen_idx = np.zeros(B, np.int32)
+        self._temps = np.zeros(B, np.float32)
+        self._top_ks = np.zeros(B, np.int32)
+        self._top_ps = np.ones(B, np.float32)
+        self._keys = np.zeros((B, 2), np.uint32)
+
+        # seed-determinism root: one split of the global generator, so
+        # paddle_tpu.seed(s) pins every sampled token this engine produces
+        self._base_key = _random.split_key()
+        self._seed_counter = itertools.count()
+
+        # donate the KV buffers (args 1, 2) so the per-step cache update
+        # is truly in place on device — without it XLA copies the whole
+        # [B, S, H, Dh]-per-layer cache every decode step. Accelerator
+        # only: XLA-CPU intermittently SIGABRTs with many donated
+        # executables co-resident in one process (hybrid_engine._compile
+        # has the same gate for the same reason).
+        donate = (1, 2) if jax.devices()[0].platform != "cpu" else ()
+        self._prefill_jit = jax.jit(self._prefill_pure,
+                                    donate_argnums=donate)
+        self._decode_jit = jax.jit(self._decode_pure,
+                                   donate_argnums=donate)
+        self._seen_sigs: set = set()
+
+    # ------------------------------------------------------------- slots --
+    def free_slots(self):
+        return [i for i in range(self.max_batch_size) if not self._active[i]]
+
+    def active_slots(self):
+        return [i for i in range(self.max_batch_size) if self._active[i]]
+
+    def release(self, slot):
+        """Evict a finished request: a host-bit flip. The slot's cache rows
+        stay until the next occupant's prefill overwrites them — masked by
+        seq_lens in the meantime, so no scrub pass is needed."""
+        self._active[slot] = False
+        self._cur_lens[slot] = 0
+        self._gen_idx[slot] = 0
+
+    def slot_len(self, slot):
+        return int(self._cur_lens[slot])
+
+    def reset(self):
+        for i in range(self.max_batch_size):
+            self.release(i)
+
+    def bucket_for(self, prompt_len):
+        """Smallest bucket holding the prompt; counts a promotion whenever
+        the smallest bucket didn't fit (bucket-ladder health signal)."""
+        if prompt_len < 1:
+            raise ValueError("prompt must contain at least one token")
+        for b in self.buckets:
+            if prompt_len <= b:
+                if b != self.buckets[0]:
+                    _counters["bucket_promotions"] += 1
+                return b
+        raise ValueError(
+            f"prompt length {prompt_len} exceeds the largest bucket "
+            f"{self.buckets[-1]} (buckets={self.buckets})")
+
+    # ----------------------------------------------------- pure step fns --
+    def _state_arrays(self):
+        return tuple(self._state[n]._data for n in self._names)
+
+    def _forward_slot(self, state_arrays, ids, positions, ks, vs, offsets,
+                      seq_lens):
+        """Run the model's slot-cache forward path on traced arrays by
+        temporarily binding them into the layer parameters (the
+        jit.StaticFunction state-swap idiom). Trace-time only — the jitted
+        executables never re-enter Python."""
+        old = {n: self._state[n]._data for n in self._names}
+        for n, arr in zip(self._names, state_arrays):
+            self._state[n]._data = arr
+        try:
+            with _ag.no_grad(), _lazy.lazy_guard(False):
+                caches = [(Tensor(k), Tensor(v)) for k, v in zip(ks, vs)]
+                hidden, new_caches = self._gpt(
+                    Tensor(ids), position_ids=Tensor(positions),
+                    caches=caches, cache_offsets=Tensor(offsets),
+                    seq_lens=Tensor(seq_lens))
+            return (hidden._data,
+                    tuple(c[0]._data for c in new_caches),
+                    tuple(c[1]._data for c in new_caches))
+        finally:
+            for n in self._names:
+                self._state[n]._data = old[n]
+
+    def _prefill_pure(self, state_arrays, ks, vs, ids, prompt_len, slot,
+                      key, temp, top_k, top_p):
+        """One request's prompt pass at bucket shape [1, L]: compute its KV
+        rows in a fresh [1, L] cache, sample the first token at position
+        prompt_len-1, then splice the rows into the big slot cache at
+        (slot, 0) — a true dynamic_update_slice, in place under XLA."""
+        L = ids.shape[1]
+        positions = jnp.arange(L, dtype=jnp.int32)[None]
+        zero_ks = [jnp.zeros((1, L, s[2], s[3]), self._dtype)
+                   for s in self._kv_shapes]
+        zero_vs = [jnp.zeros((1, L, s[2], s[3]), self._dtype)
+                   for s in self._kv_shapes]
+        offsets = jnp.zeros((1,), jnp.int32)
+        hidden, nk, nv = self._forward_slot(
+            state_arrays, ids, positions, zero_ks, zero_vs, offsets,
+            prompt_len)
+        last = jnp.take_along_axis(
+            hidden,
+            jnp.broadcast_to((prompt_len - 1)[:, None, None],
+                             (1, 1, hidden.shape[2])).astype(jnp.int32),
+            axis=1)[:, 0]
+        w = state_arrays[self._emb_idx]
+        logits = last.astype(jnp.float32) @ w.T.astype(jnp.float32)
+        gum = _sampling.gumbel_rows(key[None], jnp.zeros((1,), jnp.int32),
+                                    logits.shape[-1])
+        tok = _sampling.sample_tokens(logits, temp, top_k, top_p, gum)
+        zero = jnp.zeros((), slot.dtype)
+        start = (slot, zero, zero, zero)
+        new_k = tuple(jax.lax.dynamic_update_slice(K, rows, start)
+                      for K, rows in zip(ks, nk))
+        new_v = tuple(jax.lax.dynamic_update_slice(V, rows, start)
+                      for V, rows in zip(vs, nv))
+        return tok, new_k, new_v
+
+    def _decode_pure(self, state_arrays, ks, vs, last_tokens, cur_lens,
+                     keys, gen_idx, temps, top_ks, top_ps):
+        """One decode iteration for EVERY slot at fixed [B, 1] shape: feed
+        each slot's last token at its own position, write its KV row in
+        place, sample its next token. Inactive lanes compute garbage that
+        the host discards — batch membership is data, not shape."""
+        ids = last_tokens[:, None]
+        positions = jnp.minimum(cur_lens, self.max_seq_len - 1)[:, None]
+        hidden, nk, nv = self._forward_slot(
+            state_arrays, ids, positions, ks, vs,
+            positions[:, 0], cur_lens + 1)
+        w = state_arrays[self._emb_idx]
+        logits = (hidden[:, 0].astype(jnp.float32)
+                  @ w.T.astype(jnp.float32))
+        gum = _sampling.gumbel_rows(keys, gen_idx, logits.shape[-1])
+        toks = _sampling.sample_tokens(logits, temps, top_ks, top_ps, gum)
+        return toks, nk, nv
+
+    # ----------------------------------------------------- compile radar --
+    def _note_signature(self, phase, args, detail):
+        """Mirror jax.jit's aval cache: a first-seen (shape, dtype)
+        signature IS a trace+compile. Counted and pushed into the explainer
+        ring so decode retraces are loud."""
+        leaves = jax.tree_util.tree_leaves(args)
+        sig = (phase,) + tuple(
+            (tuple(a.shape), str(a.dtype)) for a in leaves)
+        if sig in self._seen_sigs:
+            return
+        self._seen_sigs.add(sig)
+        _counters[f"{phase}_compiles"] += 1
+        _explain.record(
+            f"serving_{phase}_compile", op=f"serving.{phase}",
+            why=f"first {phase} trace for this signature ({detail}); "
+                "recurring events of this kind after warmup are a retrace "
+                "storm — check for shape or dtype drift in engine inputs",
+            **{"detail": detail})
+
+    # ------------------------------------------------------------ prefill --
+    def prefill(self, slot, prompt_ids, temperature=0.0, top_k=0,
+                top_p=1.0, seed=None):
+        """Admit a prompt into `slot`: pad it to its bucket, run the
+        compiled prefill, install the slot state. Returns the first
+        generated token (so TTFT == prefill latency)."""
+        if self._active[slot]:
+            raise RuntimeError(f"slot {slot} is still active")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        L = self.bucket_for(len(prompt))
+        if len(prompt) >= self.max_seq_len:
+            raise ValueError(
+                f"prompt length {len(prompt)} leaves no room to generate "
+                f"(max_seq_len={self.max_seq_len})")
+        ids = np.zeros((1, L), np.int32)
+        ids[0, :len(prompt)] = prompt
+        if seed is None:
+            seed = next(self._seed_counter)
+        key = np.asarray(_sampling.request_key(self._base_key, seed),
+                         np.uint32)
+        args = (self._state_arrays(), tuple(self._k), tuple(self._v),
+                jnp.asarray(ids), jnp.asarray([len(prompt)], np.int32),
+                jnp.asarray(slot, np.int32), jnp.asarray(key),
+                jnp.asarray([temperature], np.float32),
+                jnp.asarray([top_k], np.int32),
+                jnp.asarray([top_p], np.float32))
+        self._note_signature(
+            "prefill", args,
+            f"bucket_len={L}, max_batch={self.max_batch_size}")
+        with RecordEvent("serving_prefill"), \
+                _registry.time_block("prefill", scope="serving"):
+            tok, nk, nv = self._prefill_jit(*args)
+            tok = int(np.asarray(tok)[0])
+        self._k, self._v = list(nk), list(nv)
+        self._active[slot] = True
+        self._cur_lens[slot] = len(prompt)
+        self._last_tokens[slot] = tok
+        self._gen_idx[slot] = 1
+        self._temps[slot] = temperature
+        self._top_ks[slot] = top_k
+        self._top_ps[slot] = top_p
+        self._keys[slot] = key
+        _counters["prefills"] += 1
+        _counters["tokens_generated"] += 1
+        return tok
+
+    # ------------------------------------------------------------- decode --
+    def decode_step(self):
+        """One continuous-batching iteration over all slots; returns the
+        np.int32[B] token block (junk on inactive lanes). Advances every
+        active slot's cursor and per-request RNG index."""
+        active = self._active.copy()
+        n_active = int(active.sum())
+        if n_active == 0:
+            raise RuntimeError("decode_step with no active slots")
+        args = (self._state_arrays(), tuple(self._k), tuple(self._v),
+                jnp.asarray(self._last_tokens), jnp.asarray(self._cur_lens),
+                jnp.asarray(self._keys), jnp.asarray(self._gen_idx),
+                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps))
+        self._note_signature(
+            "decode", args,
+            f"max_batch={self.max_batch_size}, "
+            f"max_seq_len={self.max_seq_len}")
+        with RecordEvent("serving_decode_step"), \
+                _registry.time_block("decode_step", scope="serving"):
+            toks, nk, nv = self._decode_jit(*args)
+            toks = np.asarray(toks)
+        self._k, self._v = list(nk), list(nv)
+        self._cur_lens[active] += 1
+        self._gen_idx[active] += 1
+        self._last_tokens[active] = toks[active]
+        _counters["decode_steps"] += 1
+        _counters["active_slot_steps"] += n_active
+        _counters["tokens_generated"] += n_active
+        _registry.gauge_set("serving.batch_occupancy",
+                            n_active / self.max_batch_size)
+        return toks
+
+    # -------------------------------------------------------------- stats --
+    def mean_occupancy(self):
+        steps = _counters["decode_steps"]
+        if not steps:
+            return 0.0
+        return _counters["active_slot_steps"] / (
+            steps * self.max_batch_size)
+
+    def stats(self):
+        return {**_registry.counters("serving"),
+                "mean_occupancy": self.mean_occupancy()}

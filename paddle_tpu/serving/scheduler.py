@@ -1,0 +1,295 @@
+"""paddle_tpu.serving.scheduler — iteration-level continuous batching.
+
+Orca-style (Yu et al., OSDI'22) scheduling: the unit of work is one engine
+ITERATION. Each ``step()`` (1) fails queued requests whose deadline passed,
+(2) admits queued requests into free slots — one compiled prefill each,
+which also yields the request's first token, so TTFT is prefill latency
+plus queue wait — then (3) runs one compiled decode iteration over every
+active slot and applies per-request stop conditions (EOS, max tokens,
+cache capacity, deadline). A finished request's slot frees THIS iteration
+and can be refilled the next — no other slot notices.
+
+Admission is a bounded deque: ``submit()`` on a full queue raises
+``QueueFullError`` immediately (fast-fail backpressure — the caller sheds
+load or retries; nothing blocks the decode loop). All request-visible
+transitions set a ``threading.Event`` so a frontend can block on
+``request.result()`` from another thread, but ``step()`` itself must be
+driven from a single thread (``serving.GenerationServer`` owns that loop).
+
+Telemetry: ``serving.requests_*`` counters, ``serving.queue_wait`` /
+``serving.ttft`` timings, and a running ``serving.tokens_per_sec`` gauge.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+
+from ..profiler import registry as _registry
+
+_counters = _registry.scoped_counters("serving", {
+    "requests_submitted": 0, "requests_completed": 0,
+    "requests_rejected": 0, "requests_timeout": 0, "requests_failed": 0})
+
+
+class QueueFullError(RuntimeError):
+    """Admission queue at capacity — backpressure, retry later."""
+
+
+class RequestStatus:
+    QUEUED = "queued"
+    RUNNING = "running"
+    DONE = "done"
+    TIMEOUT = "timeout"
+    ERROR = "error"
+
+
+class GenerationRequest:
+    """One generation job: prompt in, token ids out.
+
+    ``timeout_s`` is a wall-clock deadline measured from submission; it
+    covers queue wait AND generation, so an expired request fails fast in
+    the queue or finishes early mid-flight with whatever tokens it has
+    (``status == "timeout"``, partial ``tokens`` kept).
+    ``seed`` pins the request's sampling stream regardless of which slot
+    or batch composition serves it; None draws a deterministic per-engine
+    sequence number, so a whole workload is reproducible under
+    ``paddle_tpu.seed``.
+    """
+
+    def __init__(self, prompt_ids, max_new_tokens=32, eos_id=None,
+                 temperature=0.0, top_k=0, top_p=1.0, seed=None,
+                 timeout_s=None):
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        if not self.prompt_ids:
+            raise ValueError("prompt_ids must not be empty")
+        self.max_new_tokens = int(max_new_tokens)
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.eos_id = None if eos_id is None else int(eos_id)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.top_p = float(top_p)
+        self.seed = seed
+        self.timeout_s = timeout_s
+
+        self.rid = None
+        self.slot = None
+        self.tokens: list = []
+        self.status = RequestStatus.QUEUED
+        self.stop_reason = None
+        self.error = None
+        self.finished = threading.Event()
+        self.submit_ts = None
+        self.deadline = None
+        self.ttft_s = None
+
+    @property
+    def done(self):
+        return self.finished.is_set()
+
+    def result(self, timeout=None):
+        """Block until the request reaches a terminal state; returns self.
+        Raises TimeoutError if the WAIT times out (the request itself keeps
+        running — this is the caller giving up, not the deadline)."""
+        if not self.finished.wait(timeout):
+            raise TimeoutError(
+                f"request {self.rid} still {self.status} after waiting "
+                f"{timeout}s")
+        return self
+
+    def __repr__(self):
+        return (f"GenerationRequest(rid={self.rid}, status={self.status}, "
+                f"tokens={len(self.tokens)}, stop={self.stop_reason})")
+
+
+class ContinuousBatchScheduler:
+    """Bounded admission queue feeding an engine's free slots each step."""
+
+    def __init__(self, engine, max_queue_size=16):
+        self.engine = engine
+        self.max_queue_size = int(max_queue_size)
+        self._queue: collections.deque = collections.deque()
+        self._active: dict = {}  # slot -> request
+        self._lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._closed = False
+        self._t0 = None
+        self._tok_base = _counters["tokens_generated"] \
+            if "tokens_generated" in _counters else 0
+
+    # ---------------------------------------------------------- frontend --
+    def submit(self, request):
+        """Enqueue; O(1), thread-safe, fast-fails on backpressure."""
+        with self._lock:
+            if self._closed:
+                raise RuntimeError(
+                    "scheduler is draining/closed; not accepting requests")
+            if len(self._queue) >= self.max_queue_size:
+                _counters["requests_rejected"] += 1
+                raise QueueFullError(
+                    f"admission queue full ({self.max_queue_size} "
+                    "requests); retry later")
+            request.rid = next(self._rid)
+            request.submit_ts = time.monotonic()
+            if request.timeout_s is not None:
+                request.deadline = request.submit_ts + request.timeout_s
+            request.status = RequestStatus.QUEUED
+            self._queue.append(request)
+            _counters["requests_submitted"] += 1
+        return request
+
+    def has_work(self):
+        return bool(self._queue or self._active)
+
+    def queued(self):
+        return len(self._queue)
+
+    def active(self):
+        return len(self._active)
+
+    def close(self):
+        """Stop accepting; already-queued and in-flight requests drain.
+
+        Deliberately lock-free: the server's SIGTERM handler calls this
+        on whatever thread the signal lands on, possibly one already
+        inside submit() holding _lock — taking the non-reentrant lock
+        here would deadlock the drain. A plain bool store is atomic in
+        CPython and submit() reads it under _lock, so at worst one
+        concurrent submit wins the race and drains normally."""
+        self._closed = True
+
+    def cancel_pending(self, reason="server shutdown"):
+        """Hard shutdown path: fail everything that hasn't finished."""
+        self.close()
+        with self._lock:
+            pending = list(self._queue)
+            self._queue.clear()
+        for req in pending:
+            self._finish(req, RequestStatus.ERROR, error=reason)
+        for slot, req in list(self._active.items()):
+            self._finish(req, RequestStatus.ERROR, error=reason)
+
+    def fail_all(self, exc):
+        """Engine fault escape hatch: fail in-flight work loudly instead of
+        wedging callers blocked on result()."""
+        for slot, req in list(self._active.items()):
+            self._finish(req, RequestStatus.ERROR, error=repr(exc))
+
+    # ---------------------------------------------------------- the loop --
+    def step(self):
+        """One continuous-batching iteration; returns True while any work
+        remains. Single-threaded with respect to itself and the engine."""
+        now = time.monotonic()
+        if self._t0 is None:
+            self._t0 = now
+
+        # (1) deadline-expired while queued: fail fast, never occupy a slot
+        with self._lock:
+            queued = list(self._queue)
+        for req in queued:
+            if req.deadline is not None and now > req.deadline:
+                with self._lock:
+                    try:
+                        self._queue.remove(req)
+                    except ValueError:
+                        continue
+                self._finish(req, RequestStatus.TIMEOUT)
+
+        # (2) admission: fill free slots from the queue, one prefill each
+        while True:
+            free = self.engine.free_slots()
+            if not free:
+                break
+            with self._lock:
+                req = self._queue.popleft() if self._queue else None
+            if req is None:
+                break
+            self._admit(req, free[0])
+
+        # (3) one decode iteration over every active slot
+        if self._active:
+            toks = self.engine.decode_step()
+            for slot, req in list(self._active.items()):
+                self._append_token(req, int(toks[slot]),
+                                   time.monotonic())
+
+        self._update_throughput()
+        return self.has_work()
+
+    def drain(self, timeout=None):
+        """Run step() until idle (graceful drain); True if fully drained."""
+        self.close()
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while self.has_work():
+            if deadline is not None and time.monotonic() > deadline:
+                return False
+            self.step()
+        return True
+
+    # ----------------------------------------------------------- helpers --
+    def _admit(self, req, slot):
+        t_start = time.monotonic()
+        try:
+            first = self.engine.prefill(
+                slot, req.prompt_ids, temperature=req.temperature,
+                top_k=req.top_k, top_p=req.top_p, seed=req.seed)
+        except Exception as e:
+            # the request left the queue but never reached _active, so
+            # fail it HERE — nothing else (fail_all iterates _active) can
+            # ever set its finished event. Bad-request errors stop there;
+            # anything else (compile failure, OOM) is an engine fault and
+            # re-raises so the server loop fails the in-flight batch too.
+            self._finish(req, RequestStatus.ERROR, error=str(e))
+            if not isinstance(e, (ValueError, TypeError)):
+                raise
+            return
+        req.slot = slot
+        req.status = RequestStatus.RUNNING
+        self._active[slot] = req
+        _registry.timing("queue_wait", t_start - req.submit_ts,
+                         scope="serving")
+        now = time.monotonic()
+        req.ttft_s = now - req.submit_ts
+        _registry.timing("ttft", req.ttft_s, scope="serving")
+        self._append_token(req, first, now)
+
+    def _append_token(self, req, token, now):
+        req.tokens.append(token)
+        if req.eos_id is not None and token == req.eos_id:
+            self._finish(req, RequestStatus.DONE, stop_reason="eos")
+        elif len(req.tokens) >= req.max_new_tokens:
+            self._finish(req, RequestStatus.DONE, stop_reason="max_tokens")
+        elif req.slot is not None and \
+                self.engine.slot_len(req.slot) >= self.engine.max_seq_len:
+            self._finish(req, RequestStatus.DONE, stop_reason="length")
+        elif req.deadline is not None and now > req.deadline:
+            self._finish(req, RequestStatus.TIMEOUT)
+
+    def _finish(self, req, status, stop_reason=None, error=None):
+        if req.slot is not None:
+            self.engine.release(req.slot)
+            self._active.pop(req.slot, None)
+            req.slot = None
+        req.status = status
+        req.stop_reason = stop_reason
+        req.error = error
+        if status == RequestStatus.DONE:
+            _counters["requests_completed"] += 1
+        elif status == RequestStatus.TIMEOUT:
+            req.stop_reason = "deadline"
+            _counters["requests_timeout"] += 1
+        else:
+            _counters["requests_failed"] += 1
+        req.finished.set()
+
+    def _update_throughput(self):
+        if self._t0 is None:
+            return
+        dt = time.monotonic() - self._t0
+        if dt <= 0:
+            return
+        _registry.gauge_set(
+            "serving.tokens_per_sec",
+            (_counters["tokens_generated"] - self._tok_base) / dt)

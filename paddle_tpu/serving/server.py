@@ -1,0 +1,156 @@
+"""paddle_tpu.serving.server — threaded frontend over the batch scheduler.
+
+``GenerationServer`` owns the single thread that drives
+``ContinuousBatchScheduler.step()`` (the engine is not thread-safe; the
+server is the one consumer). Frontends interact only through thread-safe
+surfaces:
+
+* ``submit()`` — enqueue and return a ``GenerationRequest`` handle
+  immediately; raises ``QueueFullError`` the instant the admission queue
+  is at capacity (fast-fail backpressure, nothing blocks the decode loop);
+* ``result(req)`` / ``req.result()`` — block until the request is
+  terminal;
+* ``generate()`` — submit + wait, returning the token ids;
+* per-request ``timeout_s`` deadlines cover queue wait AND generation.
+
+Shutdown follows the fault-tolerance stack's SIGTERM convention
+(incubate/checkpoint.py): a signal handler only sets a flag; the worker
+loop observes it at the next iteration boundary and drains — stops
+admitting, finishes every queued and in-flight request, then exits. The
+same drain runs on ``shutdown()`` (graceful default) so a preempted
+serving task hands back complete responses instead of torn ones;
+``shutdown(drain=False)`` fails pending work fast instead.
+"""
+from __future__ import annotations
+
+import signal
+import threading
+
+from .engine import GenerationEngine
+from .scheduler import (ContinuousBatchScheduler, GenerationRequest,
+                        QueueFullError, RequestStatus)
+
+
+class GenerationServer:
+    def __init__(self, model=None, engine=None, max_batch_size=4,
+                 buckets=None, max_seq_len=None, max_queue_size=16,
+                 idle_wait_s=0.005):
+        if engine is None:
+            if model is None:
+                raise ValueError("GenerationServer needs a model or an "
+                                 "engine")
+            engine = GenerationEngine(model, max_batch_size=max_batch_size,
+                                      buckets=buckets,
+                                      max_seq_len=max_seq_len)
+        self.engine = engine
+        self.scheduler = ContinuousBatchScheduler(
+            engine, max_queue_size=max_queue_size)
+        self._idle_wait_s = float(idle_wait_s)
+        self._work = threading.Condition()
+        self._stop = threading.Event()      # hard stop at next boundary
+        self._draining = threading.Event()  # graceful: finish, then stop
+        self._thread = None
+        self._old_sigterm = None
+
+    # ----------------------------------------------------------- control --
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        if self._stop.is_set() or self._draining.is_set():
+            raise RuntimeError("server was shut down; build a new one")
+        self._thread = threading.Thread(
+            target=self._loop, name="paddle-tpu-serving", daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            if self.scheduler.has_work():
+                try:
+                    self.scheduler.step()
+                except Exception as e:  # fail loudly, don't wedge callers
+                    self.scheduler.fail_all(e)
+                continue
+            if self._draining.is_set():
+                break
+            with self._work:
+                self._work.wait(self._idle_wait_s)
+
+    def request_drain(self):
+        """Signal-safe graceful-drain trigger: sets flags only (the
+        CheckpointHook SIGTERM convention); the worker loop notices at its
+        next iteration boundary, finishes all queued + in-flight requests,
+        and exits."""
+        self.scheduler.close()
+        self._draining.set()
+
+    def install_sigterm_handler(self):
+        """Route SIGTERM (TPU preemption grace) to request_drain(). Call
+        from the main thread; restored by shutdown()."""
+        self._old_sigterm = signal.signal(
+            signal.SIGTERM, lambda signum, frame: self.request_drain())
+        return self
+
+    def shutdown(self, drain=True, timeout=None):
+        """Stop the server. drain=True (default) finishes every queued and
+        in-flight request first; drain=False fails them fast with
+        status="error". Returns True if the worker exited in time."""
+        if drain:
+            self.request_drain()
+        else:
+            self._stop.set()
+            self.scheduler.close()
+        with self._work:
+            self._work.notify_all()
+        ok = True
+        if self._thread is not None:
+            self._thread.join(timeout)
+            ok = not self._thread.is_alive()
+        self._stop.set()
+        if not drain:
+            # only after the worker has exited: cancel_pending _finish()es
+            # active requests and releases engine slots, which must not
+            # race a decode_step still in flight (single-thread engine
+            # contract). If the join timed out the worker is wedged
+            # mid-step; unwedging callers blocked on result() beats
+            # strict isolation from a thread that will never return.
+            self.scheduler.cancel_pending()
+        if self._old_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._old_sigterm)
+            self._old_sigterm = None
+        return ok
+
+    # ---------------------------------------------------------- frontend --
+    def submit(self, prompt_ids, **options):
+        """Enqueue a generation job; returns its GenerationRequest handle.
+        Raises QueueFullError immediately under backpressure and
+        RuntimeError once shutdown/drain has begun."""
+        if self._draining.is_set() or self._stop.is_set():
+            raise RuntimeError("server is shutting down; not accepting "
+                               "requests")
+        if self._thread is None:
+            self.start()
+        req = GenerationRequest(prompt_ids, **options)
+        self.scheduler.submit(req)
+        with self._work:
+            self._work.notify()
+        return req
+
+    def result(self, request, timeout=None):
+        return request.result(timeout)
+
+    def generate(self, prompt_ids, result_timeout=None, **options):
+        """Blocking convenience: submit + wait; returns the generated token
+        ids. Raises TimeoutError when the request's own deadline expired
+        (partial tokens are on the exception's .tokens) and RuntimeError on
+        failure."""
+        req = self.submit(prompt_ids, **options).result(result_timeout)
+        if req.status == RequestStatus.DONE:
+            return list(req.tokens)
+        if req.status == RequestStatus.TIMEOUT:
+            err = TimeoutError(
+                f"request {req.rid} hit its deadline after "
+                f"{len(req.tokens)} tokens")
+            err.tokens = list(req.tokens)
+            raise err
+        raise RuntimeError(f"request {req.rid} failed: {req.error}")

@@ -110,3 +110,65 @@ def test_rpc_two_processes(tmp_path):
     finally:
         if peer.poll() is None:
             peer.kill()
+
+
+class TestShutdownBarrierErrors:
+    """shutdown()'s stop-barrier except clause is NARROW (ADVICE round 5):
+    a dead store — connection refused/reset, or the ctypes binding's
+    transport-failure RuntimeError after its retries — means the host rank
+    already passed the barrier, so proceeding is safe. Anything else from
+    the store is a genuine failure and must propagate, not read as a
+    completed barrier — but the agent is stopped on EVERY path (_state is
+    already cleared, so a leaked listener would be unstoppable)."""
+
+    def _prime(self, monkeypatch, exc):
+        import paddle_tpu.distributed.rpc as rpc
+
+        class _Agent:
+            world_size = 2
+            stopped = False
+
+            def stop(self):
+                self.stopped = True
+
+        agent = _Agent()
+        monkeypatch.setattr(rpc, "_state",
+                            {"agent": agent, "store": object()})
+
+        def barrier_raises(store, tag, count):
+            raise exc
+
+        monkeypatch.setattr(rpc, "_store_barrier", barrier_raises)
+        return rpc, agent
+
+    def test_connection_refused_swallowed(self, monkeypatch):
+        rpc, agent = self._prime(
+            monkeypatch, ConnectionRefusedError("connection refused"))
+        rpc.shutdown()
+        assert agent.stopped
+
+    def test_connection_reset_swallowed(self, monkeypatch):
+        rpc, agent = self._prime(
+            monkeypatch, ConnectionResetError("peer closed"))
+        rpc.shutdown()
+        assert agent.stopped
+
+    def test_transport_runtime_error_swallowed(self, monkeypatch):
+        rpc, agent = self._prime(
+            monkeypatch, RuntimeError("TCPStore.add transport failure"))
+        rpc.shutdown()
+        assert agent.stopped
+
+    def test_genuine_runtime_error_propagates(self, monkeypatch):
+        rpc, agent = self._prime(
+            monkeypatch, RuntimeError("barrier key holds garbage"))
+        with pytest.raises(RuntimeError, match="garbage"):
+            rpc.shutdown()
+        assert agent.stopped  # error surfaced AND no leaked listener
+
+    def test_other_oserror_propagates(self, monkeypatch):
+        rpc, agent = self._prime(
+            monkeypatch, OSError(28, "No space left on device"))
+        with pytest.raises(OSError):
+            rpc.shutdown()
+        assert agent.stopped  # error surfaced AND no leaked listener

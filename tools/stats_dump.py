@@ -53,11 +53,27 @@ def _print_timings(timings, indent="  "):
 
 
 _FT_PREFIXES = ("checkpoint.", "fault.")
+_SERVING_PREFIXES = ("serving.",)
 
 
 def _print_snapshot(snap):
     counters = dict(snap.get("counters") or {})
     timings = dict(snap.get("timings") or {})
+    gauges = dict(snap.get("gauges") or {})
+    # serving telemetry (ISSUE 5) first: TTFT / tokens-per-sec / occupancy
+    # are the operator's serving health triple, pulled out of the general
+    # tables (counters, timings AND the throughput/occupancy gauges)
+    sv_counters = {k: counters.pop(k) for k in list(counters)
+                   if k.startswith(_SERVING_PREFIXES)}
+    sv_timings = {k: timings.pop(k) for k in list(timings)
+                  if k.startswith(_SERVING_PREFIXES)}
+    sv_gauges = {k: gauges.pop(k) for k in list(gauges)
+                 if k.startswith(_SERVING_PREFIXES)}
+    if sv_counters or sv_timings or sv_gauges:
+        print("serving:")
+        _print_counters(sv_counters)
+        _print_counters(sv_gauges)
+        _print_timings(sv_timings)
     # fault-tolerance telemetry (ISSUE 4) gets its own section: recovery
     # counters and checkpoint save/restore timings are the first thing an
     # operator wants after a preemption, not buried in the general table
@@ -72,9 +88,9 @@ def _print_snapshot(snap):
     if counters:
         print("counters:")
         _print_counters(counters)
-    if snap.get("gauges"):
+    if gauges:
         print("gauges:")
-        _print_counters(snap["gauges"])
+        _print_counters(gauges)
     if timings:
         print("timings:")
         _print_timings(timings)
