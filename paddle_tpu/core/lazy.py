@@ -49,13 +49,15 @@ import weakref
 import numpy as np
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
 from ..profiler import timeline as _timeline
 
 __all__ = ["LazyArray", "enabled", "lazy_guard", "build", "force",
-           "stats", "capture_guard", "donate_guard", "drop_plans"]
+           "stats", "capture_guard", "donate_guard", "drop_plans",
+           "set_spmd_mesh", "spmd_mesh", "describe_plans"]
 
 _state = threading.local()
 
@@ -75,6 +77,48 @@ _counters = _registry.scoped_counters("lazy", {
     "replay_ops": 0, "captured_steps": 0, "capture_promotions": 0,
     "capture_fallbacks": 0, "donated_steps": 0,
     "capture_invalidations": 0})
+
+# ---- SPMD lowering state (ISSUE 6) ----------------------------------------
+# Set by distributed.spmd.enable() — core must not import distributed, so
+# the mesh is pushed in. When a mesh is installed, _build_plan compiles the
+# captured whole-step executable with explicit NamedSharding in/out specs
+# (derived from the live buffers' placements) and exec_donate adds
+# donate_argnums for the loop-carried param/optimizer-slot classes: dp/mp
+# parallelism becomes sharding specs on ONE jit and GSPMD inserts the
+# collectives, instead of N Python-dispatched shard_map calls per step.
+_spmd_state: dict = {"mesh": None}
+# shared scope with distributed.spmd / distributed.collective:
+# python_collectives is bumped by every eager shard_map dispatch;
+# python_collectives_per_step is re-derived at each captured-step exec.
+_spmd_counters = _registry.scoped_counters("spmd", {
+    "step_compiles": 0, "python_collectives": 0,
+    "python_collectives_per_step": 0})
+_pycoll_mark = 0
+
+
+def spmd_mesh():
+    """The installed SPMD mesh, or None (read by creation ops: constants
+    must be replicated over the mesh, not committed to one device)."""
+    return _spmd_state["mesh"]
+
+
+def set_spmd_mesh(mesh):
+    """Install (or clear) the global SPMD mesh for captured-plan lowering.
+    ANY mesh change — install over None included — drops this thread's
+    captured plans: their executables were compiled against the old
+    placements (a pre-SPMD plan has no in_shardings, so its exec would
+    mix mesh-committed params with stale single-device layouts). Other
+    threads' plans fall back naturally through per-op verification."""
+    global _pycoll_mark
+    prev = _spmd_state["mesh"]
+    _spmd_state["mesh"] = mesh
+    if mesh is not prev:
+        drop_plans("spmd mesh changed")
+        # re-baseline the per-step collective delta: manual-path
+        # collectives dispatched BEFORE the mesh existed must not be
+        # charged to the first captured SPMD step
+        _pycoll_mark = _spmd_counters["python_collectives"]
+
 
 # Step-capture knobs. _CAPTURE_K = consecutive identical-signature
 # materializations before promotion (>= 2: one to build the signature,
@@ -779,7 +823,54 @@ class _CapturePlan:
     __slots__ = ("key", "first_sig", "ops", "n_leaves", "classes",
                  "class_of", "multi_classes", "keep_rec", "unkept_rec",
                  "inner", "exec_plain", "exec_donate", "donate_classes",
-                 "carry", "carry_confirmed", "last_out", "misses")
+                 "carry", "carry_confirmed", "last_out", "misses",
+                 "mesh", "in_shardings", "out_shardings",
+                 "flagged_classes")
+
+
+def _mesh_sharding_of(a, mesh, mesh_devs):
+    """Explicit input sharding for one unique leaf under SPMD lowering.
+    Mesh-placed arrays keep their live sharding; numpy values and
+    uncommitted arrays are pinned replicated (jit places them);
+    single-device committed arrays are pinned replicated too and
+    resharded at exec time (_execute's fixup — explicit in_shardings
+    reject mismatched committed args instead of auto-resharding).
+    Returns None for a foreign multi-device commitment: the plan then
+    compiles without explicit specs (inference-only GSPMD)."""
+    sh = getattr(a, "sharding", None)
+    if sh is None:
+        return NamedSharding(mesh, P())  # numpy / python scalar
+    try:
+        dset = sh.device_set
+    except Exception:
+        return None
+    if dset == mesh_devs:
+        return sh
+    if not getattr(a, "committed", True) or len(dset) == 1:
+        return NamedSharding(mesh, P())
+    return None
+
+
+def _derive_spmd_shardings(plan, leaves, outs, mesh):
+    """(in_shardings, out_shardings) for a captured plan, or None when
+    any buffer lives on devices outside the mesh."""
+    mesh_devs = set(mesh.devices.flat)
+    ins = []
+    for cls in plan.classes:
+        s = _mesh_sharding_of(leaves[cls[0]], mesh, mesh_devs)
+        if s is None:
+            return None
+        ins.append(s)
+    outs_sh = []
+    for tup in outs:
+        row = []
+        for a in tup:
+            s = _mesh_sharding_of(a, mesh, mesh_devs)
+            if s is None:
+                return None
+            row.append(s)
+        outs_sh.append(tuple(row))
+    return tuple(ins), tuple(outs_sh)
 
 
 def _build_plan(key, topo, keep, leaves, outs):
@@ -836,7 +927,33 @@ def _build_plan(key, topo, keep, leaves, outs):
     plan.keep_rec = tuple(i for i in range(len(topo)) if keep[i])
     plan.unkept_rec = tuple(i for i in range(len(topo)) if not keep[i])
     plan.inner = _build_replay(topo, keep)
-    plan.exec_plain = jax.jit(_make_expander(plan.inner, plan.class_of))
+    # SPMD lowering: with a global mesh installed, pin the executable's
+    # in/out layouts to the live buffers' shardings — the step compiles
+    # ONCE with NamedSharding specs and GSPMD owns every dp/mp collective
+    plan.mesh = None
+    plan.in_shardings = None
+    plan.out_shardings = None
+    plan.flagged_classes = ()
+    mesh = _spmd_state["mesh"]
+    if mesh is not None:
+        derived = _derive_spmd_shardings(plan, leaves, outs, mesh)
+        if derived is not None:
+            plan.mesh = mesh
+            plan.in_shardings, plan.out_shardings = derived
+    if plan.in_shardings is not None:
+        plan.exec_plain = jax.jit(_make_expander(plan.inner, plan.class_of),
+                                  in_shardings=plan.in_shardings,
+                                  out_shardings=plan.out_shardings)
+        _spmd_counters["step_compiles"] += 1
+        _explain.record(
+            "spmd_step_lowered", op=plan.ops[0][2],
+            why=("captured step compiled under the SPMD mesh with "
+                 "explicit NamedSharding in/out specs"),
+            n_ops=len(plan.ops), n_leaves=plan.n_leaves,
+            mesh_axes=dict(zip(mesh.axis_names,
+                               (int(s) for s in mesh.devices.shape))))
+    else:
+        plan.exec_plain = jax.jit(_make_expander(plan.inner, plan.class_of))
     plan.exec_donate = None
     plan.donate_classes = ()
     plan.carry = None
@@ -844,6 +961,61 @@ def _build_plan(key, topo, keep, leaves, outs):
     plan.last_out = [a for tup in outs for a in tup]
     plan.misses = 0
     return plan
+
+
+def _spec_repr(sharding):
+    """JSON-able partition spec of a sharding: a list with one entry per
+    dim (axis name, list of names, or None), or "opaque" for shardings
+    without a NamedSharding spec (GSPMD-inferred)."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return "opaque"
+    return [list(s) if isinstance(s, tuple) else s for s in spec]
+
+
+def describe_plans():
+    """JSON-able description of THIS thread's captured plans — in/out
+    specs, per-leaf donation state — consumed by tools/sharding_lint.py
+    (via distributed.spmd.describe_plans, which adds the mesh). Leaves
+    are reported per UNIQUE buffer class (the executable's real argument
+    list); `slot_flagged` marks optimizer-managed buffers
+    (Tensor._donatable), `carried` the confirmed loop-carried ones,
+    `donated` those the donating executable actually consumes."""
+    plans = getattr(_state, "plans", None) or {}
+    out = []
+    for plan in plans.values():
+        rec = {"n_ops": len(plan.ops), "n_leaves": plan.n_leaves,
+               "first_op": plan.ops[0][2], "spmd": plan.mesh is not None,
+               "donate_confirmed": plan.carry_confirmed}
+        # leaf avals by position, recovered from the ops' leaf refs
+        by_pos = {}
+        for _, _, _, refs, _, _ in plan.ops:
+            for ref in refs:
+                if ref[0] == "l":
+                    by_pos[ref[1]] = (ref[2], ref[3])
+        donated = {c for c, _ in plan.donate_classes}
+        carried = set(plan.carry or ())
+        leaves = []
+        for c, cls in enumerate(plan.classes):
+            shp, dt = by_pos.get(cls[0], ((), None))
+            size = 1
+            for d in shp:
+                size *= int(d)
+            nbytes = size * (np.dtype(dt).itemsize if dt is not None else 0)
+            leaves.append({
+                "class": c, "positions": list(cls),
+                "shape": [int(d) for d in shp], "dtype": str(dt),
+                "bytes": int(nbytes),
+                "spec": (_spec_repr(plan.in_shardings[c])
+                         if plan.in_shardings is not None else None),
+                "slot_flagged": c in plan.flagged_classes,
+                "carried": c in carried, "donated": c in donated})
+        rec["leaves"] = leaves
+        if plan.out_shardings is not None:
+            rec["out_specs"] = [[_spec_repr(s) for s in tup]
+                                for tup in plan.out_shardings]
+        out.append(rec)
+    return out
 
 
 def drop_plans(why="external state change"):
@@ -1156,6 +1328,17 @@ class _Session:
                     return
         classes = plan.classes
         uvals = [vals[cls[0]] for cls in classes]
+        if plan.in_shardings is not None:
+            # explicit in_shardings reject committed args with a different
+            # layout instead of auto-resharding — reshard stragglers here
+            # (cold path: steady-state leaves are prior outputs pinned by
+            # out_shardings, so they already match; a mismatch means the
+            # caller re-placed a buffer, e.g. an unsharded fresh batch)
+            for c, v in enumerate(uvals):
+                sh = getattr(v, "sharding", None)
+                if sh is not None and getattr(v, "committed", False) \
+                        and sh != plan.in_shardings[c]:
+                    uvals[c] = jax.device_put(v, plan.in_shardings[c])
         donate = plan.exec_donate is not None and _donate_enabled()
         if donate:
             for c, j in plan.donate_classes:
@@ -1195,6 +1378,19 @@ class _Session:
             _counters["cache_hits"] += 1
             _counters["captured_steps"] += 1
         plan.misses = 0
+        if _spmd_state["mesh"] is not None:
+            # collectives dispatched from Python since the previous
+            # captured step — the ISSUE-6 acceptance gate reads 0 here
+            # in steady state (GSPMD owns all comm inside the step).
+            # cur < mark means the registry was reset mid-window: the
+            # mark is stale, count from zero
+            global _pycoll_mark
+            cur = _spmd_counters["python_collectives"]
+            if cur < _pycoll_mark:
+                _pycoll_mark = 0
+            _spmd_counters["python_collectives_per_step"] = \
+                cur - _pycoll_mark
+            _pycoll_mark = cur
         new_flat = [a for tup in outs for a in tup]
         if donate:
             _counters["donated_steps"] += 1
@@ -1223,16 +1419,20 @@ class _Session:
         plan = self.plan
         prev = plan.last_out
         cand = {}
+        flagged = []
         for c, cls in enumerate(plan.classes):
             o = store[cls[0]]
             if not (type(o) is LazyArray
-                    and (o.node.donate_mask >> o.idx) & 1
-                    and not o.has_current()):
+                    and (o.node.donate_mask >> o.idx) & 1):
+                continue
+            flagged.append(c)  # optimizer-managed buffer (lint target)
+            if o.has_current():
                 continue
             v = uvals[c]
             js = [j for j, a in enumerate(prev) if a is v]
             if len(js) == 1:
                 cand[c] = js[0]
+        plan.flagged_classes = tuple(flagged)
         if not plan.carry:
             # first NON-EMPTY proposal is the baseline: the transition
             # exec right after promotion sees pre-capture buffers that
@@ -1244,9 +1444,18 @@ class _Session:
         if stable and not plan.carry_confirmed:
             plan.carry_confirmed = True
             plan.donate_classes = tuple(sorted(stable.items()))
+            kw = {}
+            if plan.in_shardings is not None:
+                # donated aliasing needs matching in/out layouts: the
+                # carry map guarantees it (the donated input IS the
+                # previous step's pinned output)
+                kw = dict(in_shardings=plan.in_shardings,
+                          out_shardings=plan.out_shardings)
+                _spmd_counters["step_compiles"] += 1
             plan.exec_donate = jax.jit(
                 _make_expander(plan.inner, plan.class_of),
-                donate_argnums=tuple(c for c, _ in plan.donate_classes))
+                donate_argnums=tuple(c for c, _ in plan.donate_classes),
+                **kw)
 
 
 def _never():
@@ -1286,6 +1495,22 @@ def _materialize(root):
     key, leaves = _signature(topo)
     if key is not None:
         key = (key, keep)
+    mesh = _spmd_state["mesh"]
+    if mesh is not None:
+        # record-mode segments mix mesh-placed params with buffers still
+        # committed to a single device (to_tensor batches, foreign
+        # constants): one jit refuses mixed commitments, so replicate
+        # the stragglers over the mesh. Captured replay has its own
+        # in_shardings fixup in _Session._execute.
+        mesh_devs = set(mesh.devices.flat)
+        leaves = [
+            jax.device_put(a, NamedSharding(mesh, P()))
+            if (getattr(a, "sharding", None) is not None
+                and getattr(a, "committed", False)
+                and len(a.sharding.device_set) == 1
+                and a.sharding.device_set != mesh_devs)
+            else a
+            for a in leaves]
     with _lock:
         _counters["materializations"] += 1
         compiled = _exec_cache.get(key) if key is not None else None

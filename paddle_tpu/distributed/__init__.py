@@ -15,6 +15,7 @@ from .parallel import DataParallel  # noqa: F401
 from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from . import collective  # noqa: F401
+from . import spmd  # noqa: F401
 from . import fleet  # noqa: F401
 from . import meta_parallel  # noqa: F401
 from . import rpc  # noqa: F401

@@ -197,17 +197,20 @@ def axis_index(axis):
 def _shard_map_call(group, fn, *arrays, in_specs, out_specs):
     from jax.sharding import NamedSharding
 
+    from .spmd import per_arg_specs
+
+    # every eager collective funnels through here: one Python-dispatched
+    # shard_map executable per call. The spmd counter is what the
+    # one-compilation gate asserts stays FLAT in steady state (GSPMD owns
+    # all comm inside the captured step).
+    _registry.inc("python_collectives", scope="spmd")
     # concrete arrays committed to a single device (the default for
     # to_tensor outputs) are incompatible with a multi-device shard_map —
     # spread them over the group mesh first; tracers (executor replay under
-    # jit) already compose and must not be device_put
-    # NOTE: PartitionSpec itself subclasses tuple on jax <= 0.4.37, so a
-    # bare isinstance(tuple) check would unpack a single spec into its
-    # axis entries and device_put with a raw string
-    from jax.sharding import PartitionSpec as _P
-
-    specs = in_specs if isinstance(in_specs, tuple) \
-        and not isinstance(in_specs, _P) else (in_specs,) * len(arrays)
+    # jit) already compose and must not be device_put. per_arg_specs
+    # carries the PartitionSpec-is-a-tuple guard (jax <= 0.4.37 subclasses
+    # tuple, so a bare isinstance check would unpack a single spec).
+    specs = per_arg_specs(in_specs, len(arrays))
     placed = []
     for a, spec in zip(arrays, specs):
         if not isinstance(a, jax.core.Tracer):

@@ -45,6 +45,33 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
          hc.get("sharding_degree", 1), hc.get("mp_degree", 1)])
     hcg = HybridCommunicateGroup(topo)
     _fleet_state.update(initialized=True, hcg=hcg, strategy=strategy)
+    # One-compilation SPMD path (ISSUE 6): hybrid_configs['use_spmd']
+    # (or env PADDLE_TPU_SPMD=1) installs the folded ('dp','mp') mesh —
+    # distributed_model then returns the model sharded for the lazy
+    # capture loop instead of wrapping it, and captured steps compile
+    # ONCE with NamedSharding in/out specs. Re-init without the flag
+    # always clears the global mesh: a stale mesh from a previous init
+    # must not hijack later manual-path layouts.
+    import os as _os
+
+    from .. import spmd
+
+    use_spmd = hc.get("use_spmd")
+    if use_spmd is None:
+        use_spmd = _os.environ.get(
+            "PADDLE_TPU_SPMD", "0").lower() in ("1", "true", "on")
+    mesh = hcg.spmd_mesh() if use_spmd else None
+    if use_spmd and mesh is None:
+        import warnings
+
+        warnings.warn(
+            "use_spmd requested but pp_degree > 1: pipeline parallelism "
+            "stays on the HybridParallelEngine path; SPMD lowering "
+            "disabled", stacklevel=2)
+    if mesh is not None:
+        spmd.enable(mesh)
+    else:
+        spmd.disable()
     return
 
 
@@ -103,10 +130,23 @@ def distributed_model(model, criterion=None, optimizer=None):
     dp-only mode returns the model wrapped in DataParallel semantics (a
     no-op under SPMD: gradient sync is compiled into the step); hybrid mode
     returns a HybridParallelEngine when an optimizer is supplied via
-    `distributed_optimizer` first, else the model annotated for GSPMD."""
+    `distributed_optimizer` first, else the model annotated for GSPMD.
+
+    With the one-compilation SPMD path enabled (fleet.init use_spmd /
+    PADDLE_TPU_SPMD=1), the model is sharded onto the global ('dp','mp')
+    mesh per its mp_layers/ZeRO annotations and returned UNWRAPPED: the
+    eager (lazy-capture) train loop is the engine — the captured step
+    compiles once under the mesh and GSPMD inserts the dp grad
+    all-reduce and mp collectives. The hapi Model train loop selects the
+    same path automatically; fallback-by-prefix-re-record on divergence
+    is preserved (core/lazy.py)."""
+    from .. import spmd
+
     hcg = _fleet_state["hcg"]
     if hcg is None:
         raise RuntimeError("call fleet.init() first")
+    if spmd.enabled():
+        return spmd.shard_model(model)
     mode = hcg.get_parallel_mode()
     if mode in ("single", "data_parallel"):
         from ..parallel import DataParallel

@@ -80,12 +80,13 @@ def _run_1f1b_schedule(carry, fwd_part, bwd_part, pp, M):
     return carry
 
 
-def _spec_of(param, mesh_axes):
-    """PartitionSpec from a param's sharding_spec annotation."""
-    spec = getattr(param, "sharding_spec", None)
-    if spec is None:
-        return P()
-    return P(*[(s if s in mesh_axes else None) for s in spec])
+def _spec_of(param, mesh):
+    """PartitionSpec from a param's sharding_spec annotation (shared
+    derivation with the one-compilation path: spmd.param_pspec — on this
+    4-axis mesh 'sharding' is a real axis, so no dp folding applies)."""
+    from .. import spmd
+
+    return spmd.param_pspec(getattr(param, "sharding_spec", None), mesh)
 
 
 def _find_block_stack(model: Layer):
@@ -140,7 +141,6 @@ class HybridParallelEngine:
     def _build(self):
         from ..meta_parallel.pp_layers import PipelineLayer
 
-        mesh_axes = set(self.mesh.axis_names)
         self._pre_seq = self._post_seq = None
         if self._stage_layers is not None:
             blocks = self._build_het()
@@ -220,10 +220,10 @@ class HybridParallelEngine:
         blk0_state = self.block0.state_dict() \
             if self.block0 is not None else {}
         self.stack_specs = {
-            k: P("pp", *list(_spec_of(blk0_state[k], mesh_axes)))
+            k: P("pp", *list(_spec_of(blk0_state[k], self.mesh)))
             for k in block_keys}
         self.other_specs = [
-            _spec_of(t, mesh_axes) for t in self.other_tensors]
+            _spec_of(t, self.mesh) for t in self.other_tensors]
         self.batch_spec = P(("dp", "sharding"))
 
         # optimizer accumulators for all state (stacked + other)

@@ -105,6 +105,7 @@ class HybridCommunicateGroup:
             self._dp_degree, self._pp_degree, self._sharding_degree,
             self._mp_degree)
         self.mesh = Mesh(dev_array, ("dp", "pp", "sharding", "mp"))
+        self._spmd_mesh = None
         collective.set_global_mesh(self.mesh)
 
         self._dp_group = collective.split_group_mesh(self.mesh, "dp")
@@ -152,6 +153,18 @@ class HybridCommunicateGroup:
 
     def get_sharding_parallel_group(self):
         return self._sharding_group
+
+    def spmd_mesh(self):
+        """Folded 2-axis ('dp', 'mp') mesh for the one-compilation SPMD
+        path: 'sharding' folds into 'dp' (ZeRO param/slot specs shard
+        over the batch axis). None when pp > 1 — pipeline stays on the
+        HybridParallelEngine 1F1B path. Device order matches self.mesh
+        at pp=1, so shardings over either mesh may coexist."""
+        if self._spmd_mesh is None:
+            from .. import spmd
+
+            self._spmd_mesh = spmd.mesh_from_hcg(self)
+        return self._spmd_mesh
 
     def get_check_parallel_group(self, sharding=False):
         return collective.get_group(0)

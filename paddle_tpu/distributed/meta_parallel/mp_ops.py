@@ -84,27 +84,45 @@ def current_mp_mesh():
     return hcg.mesh
 
 
+def _layout_mesh():
+    """Mesh for GSPMD layout annotations: the global SPMD mesh when the
+    one-compilation path is enabled (distributed.spmd), else the fleet
+    hybrid mesh. Both carry an 'mp' axis, so the P specs below work on
+    either."""
+    from .. import spmd
+
+    m = spmd.current_mesh()
+    return m if m is not None else current_mp_mesh()
+
+
 def shard_parameter(param, spec=None):
-    """Place a parameter onto the fleet mesh per its `sharding_spec` — this
+    """Place a parameter onto the mesh per its `sharding_spec` — this
     is what makes the mpu layers REAL outside the engine: eager per-op jit
     partitions every op that touches a sharded weight, inserting the same
-    collectives the reference's mp_ops issue manually."""
-    mesh = current_mp_mesh()
+    collectives the reference's mp_ops issue manually. Under the SPMD
+    path the spec folds through spmd.param_pspec ('sharding' → 'dp')."""
+    mesh = _layout_mesh()
     if mesh is None:
         return param
     spec = spec or getattr(param, "sharding_spec", None)
     if spec is None:
         return param
-    pspec = P(*[(s if s in mesh.axis_names else None) for s in spec])
-    param._data = jax.device_put(param._data, NamedSharding(mesh, pspec))
+    from .. import spmd
+    from ...core import lazy as _lazy
+
+    arr = _lazy.force(param._data)
+    pspec = spmd.param_pspec(spec, mesh, tuple(arr.shape))
+    param._data = jax.device_put(arr, NamedSharding(mesh, pspec))
     return param
 
 
 def ensure_on_mesh(tensor):
-    """Replicate an off-mesh eager tensor onto the fleet mesh (layout-only,
+    """Replicate an off-mesh eager tensor onto the mesh (layout-only,
     value and autograd tape untouched) so per-op jit can combine it with
-    mesh-sharded weights — eager jax refuses mixed commitments otherwise."""
-    mesh = current_mp_mesh()
+    mesh-sharded weights — eager jax refuses mixed commitments otherwise.
+    Pending LazyArrays pass through: they are not committed anywhere yet
+    and materialize inside the (mesh-aware) segment executable."""
+    mesh = _layout_mesh()
     if mesh is None or not hasattr(tensor, "_data"):
         return tensor
     arr = tensor._data
@@ -115,13 +133,35 @@ def ensure_on_mesh(tensor):
     return tensor
 
 
+def _wsc(x, sharding=None):
+    """with_sharding_constraint as a recordable op kernel (module-level:
+    stable fn_key; the NamedSharding rides in attrs, which hash)."""
+    return jax.lax.with_sharding_constraint(x, sharding)
+
+
 def _constrain(x, pspec):
     """Annotation-form layout constraint, skipped inside manual regions
-    (where GSPMD specs would clash with the enclosing shard_map)."""
-    mesh = current_mp_mesh()
+    (where GSPMD specs would clash with the enclosing shard_map).
+
+    A pending LazyArray is RECORDED (one `sharding_constraint` op in the
+    accumulated segment) instead of forced: under the lazy train loop a
+    mid-forward force would split the step into multiple executables and
+    permanently diverge the capture cursor (observed: 2 materializations
+    + a fallback per step for gather_output ColumnParallelLinear). The
+    recorded op lowers to with_sharding_constraint inside the captured
+    whole-step jit, where it is GSPMD's layout hint — the ISSUE-6
+    one-compilation contract."""
+    mesh = _layout_mesh()
     if mesh is None or axis_in_scope(MP_AXIS):
         return x
-    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, pspec))
+    ns = NamedSharding(mesh, pspec)
+    from ...core import lazy as _lazy
+
+    if isinstance(x, _lazy.LazyArray):
+        return _lazy.build(_wsc, "sharding_constraint", [x],
+                           {"sharding": ns}, _lazy.fn_key(_wsc),
+                           _lazy.attrs_key({"sharding": ns}))
+    return jax.lax.with_sharding_constraint(x, ns)
 
 
 # ------------------------- in-region (manual) forms --------------------------

@@ -116,6 +116,15 @@ def group_sharded_parallel(model, optimizer, level="os_g", scaler=None,
                     break
     optimizer._sharding_level = level
     optimizer._sharding_offload = bool(offload)
+    # One-compilation SPMD path: re-place the (possibly newly annotated)
+    # params onto the folded mesh — 'sharding' entries land on 'dp'
+    # (spmd.param_pspec), so ZeRO param sharding is a layout on the same
+    # jit instead of a runtime protocol. Engine path reads the
+    # annotations at _build as before.
+    from . import spmd
+
+    if spmd.enabled():
+        spmd.shard_model(model)
     return _GroupShardedModel(model, level), optimizer, scaler
 
 

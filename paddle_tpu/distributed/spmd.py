@@ -1,0 +1,245 @@
+"""One-compilation SPMD lowering — mesh + axis rules for captured steps.
+
+The distributed stack has two execution styles:
+
+1. **Manual** (`meta_parallel/mp_ops.py` shard_map forms, eager
+   `collective.*` calls): N Python-dispatched executables per step. This
+   is the reference-shaped oracle path and stays fully supported.
+2. **One-compilation SPMD** (this module + `core/lazy.py` step capture):
+   the whole train step — fwd, bwd, optimizer update, every dp/mp
+   collective — is ONE `jax.jit` executable with explicit
+   `NamedSharding` in/out specs and buffer donation for params and
+   optimizer slots. GSPMD inserts the dp gradient all-reduce and the mp
+   collectives the reference issues by hand (SNIPPETS [1]-[3], the
+   pjit + donation_vector pattern; t5x-style axis rules in [2]).
+
+Mesh mapping (Fleet `HybridCommunicateGroup` topology → named mesh):
+
+    fleet axis   degree          spmd mesh axis
+    ----------   -------------   -------------------------------------
+    data         dp_degree       'dp'
+    sharding     sharding_deg    'dp'   (folded: ZeRO param/slot specs
+                                         shard over the same axis the
+                                         batch is split on)
+    model        mp_degree       'mp'
+    pipe         pp_degree       (unsupported — pp>1 keeps the
+                                  HybridParallelEngine 1F1B path)
+
+Spec derivation (per-leaf PartitionSpec from `mp_layers` annotations,
+carried on `param.sharding_spec`):
+
+    ColumnParallelLinear weight   (None, 'mp')      → P(None, 'mp')
+    RowParallelLinear weight      ('mp', None)      → P('mp', None)
+    VocabParallelEmbedding table  ('mp', None)      → P('mp', None)
+    ZeRO ('sharding' entries)     ('sharding', ...) → P('dp', ...)
+    everything else               —                 → P() (replicated)
+
+Axes absent from the mesh, degree-1 axes, and non-divisible dims fall
+back to None (replicated) — annotation never hard-fails placement.
+
+Enabling (`enable(mesh)` / `fleet.init` with
+`hybrid_configs['use_spmd']=True` or env `PADDLE_TPU_SPMD=1`) installs
+the mesh into the lazy capture engine: the next captured plan compiles
+with `in_shardings`/`out_shardings`/`donate_argnums` (core/lazy.py
+`_build_plan`). Fallback-by-prefix-re-record on divergence is untouched
+— SPMD lowering changes layouts and compilation, never the replay state
+machine.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..core import lazy as _lazy
+from ..profiler import registry as _registry
+
+__all__ = ["enable", "disable", "enabled", "current_mesh", "spmd_guard",
+           "mesh_from_hcg", "param_pspec", "per_arg_specs",
+           "is_single_spec", "shard_model", "shard_batch",
+           "describe_plans"]
+
+# shared scope with core/lazy.py (step_compiles / python_collectives /
+# python_collectives_per_step are bumped there and in collective.py)
+_counters = _registry.scoped_counters("spmd", {
+    "step_compiles": 0, "python_collectives": 0,
+    "python_collectives_per_step": 0, "params_sharded": 0,
+    "params_replicated": 0})
+
+
+
+# ---------------------------- shared spec helpers ----------------------------
+
+def is_single_spec(obj):
+    """True when `obj` is ONE PartitionSpec rather than a tuple of specs.
+
+    PartitionSpec itself subclasses tuple on jax <= 0.4.37, so a bare
+    `isinstance(obj, tuple)` check unpacks a single spec into its axis
+    entries — the guard every in_specs consumer needs (shared by
+    collective._shard_map_call and the spec-derivation code here)."""
+    return isinstance(obj, PartitionSpec) or not isinstance(obj, tuple)
+
+
+def per_arg_specs(specs, n):
+    """Broadcast `specs` to exactly one spec per argument, honoring the
+    PartitionSpec-is-a-tuple guard above."""
+    if is_single_spec(specs):
+        return (specs,) * n
+    return tuple(specs)
+
+
+def param_pspec(spec, mesh, shape=None):
+    """PartitionSpec for a parameter from its `sharding_spec` annotation.
+
+    Folds 'sharding' onto 'dp' when the mesh has no 'sharding' axis (the
+    2-axis spmd mesh); drops axes the mesh lacks, degree-1 axes, and
+    entries whose dim the axis degree does not divide. Works for both
+    the folded spmd mesh and the engine's 4-axis hybrid mesh."""
+    if spec is None:
+        return PartitionSpec()
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    parts = []
+    for d, s in enumerate(spec):
+        if s == "sharding" and "sharding" not in axes and "dp" in axes:
+            s = "dp"
+        if s is None or s not in axes or axes[s] <= 1:
+            parts.append(None)
+            continue
+        if shape is not None and d < len(shape) and shape[d] % axes[s] != 0:
+            parts.append(None)
+            continue
+        parts.append(s)
+    return PartitionSpec(*parts)
+
+
+# ------------------------------- mesh lifecycle ------------------------------
+
+def mesh_from_hcg(hcg):
+    """Folded 2-axis ('dp', 'mp') mesh from a HybridCommunicateGroup, or
+    None when the topology needs the engine path (pp > 1)."""
+    if hcg.get_pipe_parallel_world_size() > 1:
+        return None
+    dp = (hcg.get_data_parallel_world_size()
+          * hcg.get_sharding_parallel_world_size())
+    mp = hcg.get_model_parallel_world_size()
+    # same flat device order as hcg.mesh at pp=1: (d, s, m) flattens to
+    # (d*sh + s)*mp + m either way, so the two meshes may coexist
+    devs = np.array(jax.devices()[: dp * mp]).reshape(dp, mp)
+    return Mesh(devs, ("dp", "mp"))
+
+
+def enable(mesh: Mesh):
+    """Install `mesh` as the global SPMD mesh: captured plans lower with
+    explicit shardings from here on (stale plans of this thread are
+    dropped by the capture engine when the mesh changes). The capture
+    engine holds the ONLY copy of the mesh (core cannot import
+    distributed, so it is pushed in) — current_mesh/enabled read it
+    back, so direct lazy.set_spmd_mesh callers cannot desync us."""
+    _lazy.set_spmd_mesh(mesh)
+    return mesh
+
+
+def disable():
+    _lazy.set_spmd_mesh(None)
+
+
+def current_mesh():
+    return _lazy.spmd_mesh()
+
+
+def enabled():
+    return _lazy.spmd_mesh() is not None
+
+
+class spmd_guard:
+    """Context manager scoping `enable(mesh)` (tests, benches)."""
+
+    def __init__(self, mesh):
+        self._mesh = mesh
+
+    def __enter__(self):
+        self._prev = current_mesh()
+        enable(self._mesh)
+        return self._mesh
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            disable()
+        else:
+            enable(self._prev)
+        return False
+
+
+# ------------------------------- placement -----------------------------------
+
+def shard_model(model, mesh=None):
+    """Place every parameter of `model` onto the mesh per its
+    `sharding_spec` annotation (mp_layers set these at construction;
+    group_sharded_parallel adds ZeRO 'sharding' entries). Unannotated
+    params are replicated — required so one jit can combine them with
+    sharded weights (mixed single-device commitments are rejected)."""
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise RuntimeError("shard_model: no SPMD mesh set (call "
+                           "spmd.enable(mesh) or fleet.init with "
+                           "use_spmd first)")
+    sharded = replicated = 0
+    for p in model.parameters():
+        arr = _lazy.force(p._data)
+        pspec = param_pspec(getattr(p, "sharding_spec", None), mesh,
+                            tuple(arr.shape))
+        target = NamedSharding(mesh, pspec)
+        if getattr(arr, "sharding", None) != target:
+            p._data = jax.device_put(arr, target)
+        if any(s is not None for s in pspec):
+            sharded += 1
+        else:
+            replicated += 1
+    # placement-state tally, ASSIGNED not incremented: mp_layers place
+    # weights at construction and the ZeRO path calls shard_model twice
+    # (distributed_model, then group_sharded_parallel after annotating)
+    # — incrementing would double-count, counting only re-placements
+    # would report 0 for pre-placed models
+    _counters["params_sharded"] = sharded
+    _counters["params_replicated"] = replicated
+    return model
+
+
+def shard_batch(data, mesh=None, batch_axis=0):
+    """Place one batch tensor/array onto the mesh, split over 'dp' on
+    `batch_axis` (replicated when the dim does not divide). Returns a
+    Tensor. The explicit put matters twice over: to_tensor commits to a
+    single device (incompatible with mesh-committed params inside one
+    jit), and the captured executable pins its in_shardings — a batch
+    arriving with a different layout forces a per-step reshard."""
+    from ..core.tensor import Tensor
+
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise RuntimeError("shard_batch: no SPMD mesh set")
+    t = data if isinstance(data, Tensor) else Tensor(jax.numpy.asarray(
+        np.asarray(data)))
+    arr = _lazy.force(t._data)
+    dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+    parts = [None] * arr.ndim
+    if dp > 1 and arr.ndim > batch_axis and arr.shape[batch_axis] % dp == 0:
+        parts[batch_axis] = "dp"
+    t._data = jax.device_put(arr, NamedSharding(mesh,
+                                                PartitionSpec(*parts)))
+    return t
+
+
+# ------------------------------ introspection --------------------------------
+
+def describe_plans():
+    """JSON-able description of this thread's captured plans' in/out
+    specs and donation state — the input contract of
+    tools/sharding_lint.py (stdlib-only: it consumes this dict, never
+    jax objects). See core/lazy.py describe_plans for the per-leaf
+    fields."""
+    mesh = current_mesh()
+    desc = {"mesh": None, "plans": _lazy.describe_plans()}
+    if mesh is not None:
+        desc["mesh"] = {"axes": {n: int(s) for n, s in
+                                 zip(mesh.axis_names, mesh.devices.shape)}}
+    return desc

@@ -112,7 +112,19 @@ class Model:
 
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
+        from ..distributed import spmd as _spmd
+
+        # the compiled step is path- AND mesh-specific: a fleet re-init
+        # that installs/clears/changes the global mesh after the first
+        # train_batch must rebuild it (the cached lazy-SPMD step would
+        # shard_batch against a gone mesh; the cached TrainStep would
+        # silently ignore a newly installed one)
+        if (self._train_step is not None
+                and getattr(self, "_train_step_mesh", None)
+                is not _spmd.current_mesh()):
+            self._train_step = None
         if self._train_step is None:
+            self._train_step_mesh = _spmd.current_mesh()
             from .. import jit
 
             def step(*args):
@@ -143,16 +155,35 @@ class Model:
 
             inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
             self._n_inputs = len(inputs_l)
-            self._train_step = jit.TrainStep(step, self.network,
-                                             self._optimizer)
+            if _spmd.enabled():
+                # One-compilation SPMD path (fleet.init use_spmd): the
+                # eager step body runs under lazy capture — after K
+                # identical steps it replays ONE mesh-compiled
+                # executable with NamedSharding in/out specs and
+                # donated param/slot buffers; GSPMD owns the dp/mp
+                # collectives. Batches are placed dp-sharded up front:
+                # the captured executable pins its input layouts.
+                from .. import incubate
+
+                def lazy_spmd_step(*args):
+                    args = [_spmd.shard_batch(a) for a in args]
+                    with incubate.lazy_eval():
+                        return step(*args)
+
+                self._train_step = lazy_spmd_step
+            else:
+                self._train_step = jit.TrainStep(step, self.network,
+                                                 self._optimizer)
         inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
         labels_l = labels if isinstance(labels, (list, tuple)) else (
             [labels] if labels is not None else [])
         with RecordEvent("train_step"):
             loss = self._train_step(*inputs_l, *labels_l)
-        step = getattr(self, "_global_step", 0)
-        self._global_step = step + 1
-        if _faults.ACTIVE and _faults.fire("nan_loss", step=step):
+        # NOTE: must not be named `step` — lazy_spmd_step above closes
+        # over the step() FUNCTION through this frame's local
+        gstep = getattr(self, "_global_step", 0)
+        self._global_step = gstep + 1
+        if _faults.ACTIVE and _faults.fire("nan_loss", step=gstep):
             return [float("nan")]
         return [float(loss)]
 

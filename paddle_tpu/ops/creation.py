@@ -39,7 +39,19 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True):
 
 
 def _device_const(arr):
+    # with an SPMD mesh installed, constants must be replicated over the
+    # mesh, not committed to one device: a single jit refuses to combine
+    # single-device-committed args with mesh-sharded params (e.g. GPT's
+    # arange position ids inside the one-compilation captured step)
+    from ..core import lazy as _lazy
+
+    mesh = _lazy.spmd_mesh()
     try:
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            return jax.device_put(arr, NamedSharding(mesh,
+                                                     PartitionSpec()))
         return jax.device_put(arr, jax_device())
     except Exception:
         return arr

@@ -55,6 +55,10 @@ def _free_port():
     return p
 
 
+@pytest.mark.skipif(
+    __import__("proc_utils").jaxlib_version() < (0, 4, 37),
+    reason="cross-host device_put (multi-process CPU world) is "
+           "unimplemented in jaxlib <= 0.4.36; passes on jaxlib >= 0.4.37")
 def test_two_node_world_allreduce(tmp_path):
     from proc_utils import proc_timeout, shed_parent_memory
 

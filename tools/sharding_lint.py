@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Lint a captured SPMD plan's in/out specs and donation state.
+
+Input: the JSON produced by `paddle_tpu.distributed.spmd.describe_plans()`
+(a dict with "mesh" and "plans"; each plan lists its unique leaf classes
+with shape/bytes/spec/slot_flagged/carried/donated — see
+core/lazy.py describe_plans for the field contract).
+
+Checks:
+  * unsharded-but-shardable param/slot: an optimizer-managed buffer
+    (slot_flagged) big enough to matter whose spec is fully replicated
+    while some mesh axis (> 1 devices) divides one of its dims — HBM and
+    bandwidth left on the table;
+  * missing donation: a confirmed loop-carried optimizer slot the
+    donating executable does not consume — the step allocates a fresh
+    buffer for an in-place update.
+
+Pure stdlib on purpose — no paddle_tpu / jax import, so it lints a
+dumped JSON anywhere (CI box, laptop). bench.py --spmd calls `lint()`
+in-process on the live description and reports problems as warnings;
+the CLI exits 1 when problems are found.
+
+Usage:
+    python tools/sharding_lint.py plan.json
+    python -c "import json, paddle_tpu.distributed.spmd as s; \\
+               print(json.dumps(s.describe_plans()))" | \\
+        python tools/sharding_lint.py -
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# below this, replicating a buffer is cheaper than the resharding traffic
+MIN_SHARDABLE_BYTES = 1 << 16
+
+
+def _mesh_axes(desc):
+    mesh = desc.get("mesh") or {}
+    return {k: int(v) for k, v in (mesh.get("axes") or {}).items()
+            if int(v) > 1}
+
+
+def _is_replicated(spec):
+    return spec is None or spec == [] or (
+        isinstance(spec, list) and all(s in (None, []) for s in spec))
+
+
+def _shardable(leaf, axes):
+    """Some mesh axis with >1 devices divides some dim of the leaf."""
+    for d in leaf.get("shape", ()):
+        for deg in axes.values():
+            if d and d % deg == 0:
+                return True
+    return False
+
+
+def lint_plan(plan, axes, min_bytes=MIN_SHARDABLE_BYTES):
+    """Problem strings for one plan description (empty list = clean)."""
+    problems = []
+    if not plan.get("spmd"):
+        return problems  # not lowered: nothing to check specs against
+    for leaf in plan.get("leaves", ()):
+        tag = (f"leaf class {leaf.get('class')} "
+               f"{leaf.get('shape')}/{leaf.get('dtype')}")
+        spec = leaf.get("spec")
+        if spec == "opaque":
+            continue  # GSPMD-inferred layout: can't judge from the spec
+        if leaf.get("slot_flagged") and axes and _is_replicated(spec) \
+                and leaf.get("bytes", 0) >= min_bytes \
+                and _shardable(leaf, axes):
+            problems.append(
+                f"{tag}: param/optimizer slot is replicated but a mesh "
+                f"axis divides it — add a sharding_spec (or ZeRO "
+                f"'sharding' annotation) so GSPMD shards it")
+        if leaf.get("carried") and plan.get("donate_confirmed") \
+                and not leaf.get("donated"):
+            problems.append(
+                f"{tag}: loop-carried optimizer slot is not donated — "
+                f"the captured step allocates a fresh buffer every "
+                f"iteration (check for a live Tensor holding the old "
+                f"payload)")
+    return problems
+
+
+def lint(desc, min_bytes=MIN_SHARDABLE_BYTES):
+    """All problem strings for a describe_plans() dict."""
+    axes = _mesh_axes(desc)
+    problems = []
+    for i, plan in enumerate(desc.get("plans", ())):
+        for p in lint_plan(plan, axes, min_bytes):
+            problems.append(f"plan {i} ({plan.get('first_op', '?')}): {p}")
+    return problems
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="describe_plans() JSON file, or - for "
+                                 "stdin")
+    ap.add_argument("--min-bytes", type=int, default=MIN_SHARDABLE_BYTES,
+                    help="ignore replicated buffers smaller than this")
+    args = ap.parse_args(argv)
+    try:
+        if args.path == "-":
+            desc = json.load(sys.stdin)
+        else:
+            with open(args.path) as f:
+                desc = json.load(f)
+    except ValueError as e:
+        print(f"{args.path}: not a JSON document: {e}", file=sys.stderr)
+        return 2
+    problems = lint(desc, args.min_bytes)
+    n_plans = len(desc.get("plans", ()))
+    n_lowered = sum(1 for p in desc.get("plans", ()) if p.get("spmd"))
+    print(f"{n_plans} plan(s), {n_lowered} SPMD-lowered, "
+          f"{len(problems)} problem(s)")
+    for p in problems:
+        print(f"  WARN {p}")
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -54,12 +54,22 @@ def _print_timings(timings, indent="  "):
 
 _FT_PREFIXES = ("checkpoint.", "fault.")
 _SERVING_PREFIXES = ("serving.",)
+_SPMD_PREFIXES = ("spmd.",)
 
 
 def _print_snapshot(snap):
     counters = dict(snap.get("counters") or {})
     timings = dict(snap.get("timings") or {})
     gauges = dict(snap.get("gauges") or {})
+    # sharding / SPMD lowering (ISSUE 6) first among the specialist
+    # sections: step_compiles and python_collectives_per_step ARE the
+    # one-compilation health check (1-2 compiles total, 0 per-step
+    # Python collectives in steady state)
+    sp_counters = {k: counters.pop(k) for k in list(counters)
+                   if k.startswith(_SPMD_PREFIXES)}
+    if sp_counters:
+        print("sharding (spmd):")
+        _print_counters(sp_counters)
     # serving telemetry (ISSUE 5) first: TTFT / tokens-per-sec / occupancy
     # are the operator's serving health triple, pulled out of the general
     # tables (counters, timings AND the throughput/occupancy gauges)
