@@ -505,6 +505,13 @@ def _run_serve_child():
     for pl in (8, 20):
         server.generate(list(rng.integers(1, 128, pl)), max_new_tokens=4)
 
+    # second weight set for the mid-flight hot-swap (ISSUE 7): same
+    # architecture, different init — the swap is real but aval-identical,
+    # so the gate can insist on 0 new decode compiles across it
+    paddle.seed(1)
+    swap_state = GPTForPretraining(GPTModel(cfg)).state_dict()
+    paddle.seed(0)
+
     c0 = dict(_reg.counters("serving"))
     reqs = []
     t0 = _t.perf_counter()
@@ -515,12 +522,17 @@ def _run_serve_child():
             max_new_tokens=int(rng.integers(8, 24)),
             temperature=0.8 if i % 3 == 0 else 0.0, seed=i))
         _t.sleep(0.01)  # staggered arrivals: admissions land mid-flight
+        if i == 6:  # hot-swap lands while earlier requests still decode
+            server.swap_weights(swap_state, source="bench --serve")
     for r in reqs:
         r.result(timeout=300)
     dt = _t.perf_counter() - t0
     c1 = dict(_reg.counters("serving"))
+    swap_count = server.scheduler.swap_count
+    swap_err = server.scheduler.last_swap_error
     server.shutdown()
 
+    failed = len([r for r in reqs if r.status != "done"])
     tokens = sum(len(r.tokens) for r in reqs)
     steps = c1["decode_steps"] - c0["decode_steps"]
     occ = ((c1["active_slot_steps"] - c0["active_slot_steps"])
@@ -535,6 +547,14 @@ def _run_serve_child():
         "requests": len(reqs),
         "tokens": tokens,
         "ttft_ms_mean": round(ttft.get("mean_ms", 0.0), 2),
+        # train→serve loop gates (ISSUE 7): the mid-flight hot-swap must
+        # land (swap_count >= 1) with ZERO failed requests and zero new
+        # decode compiles (same-aval swap replays the compiled step).
+        # The status scan covers error AND timeout terminals for exactly
+        # this run's requests (the counter delta would double-count).
+        "swap_count": swap_count,
+        "failed_requests": failed,
+        "swap_error": repr(swap_err) if swap_err is not None else None,
         "decode_compiles": c1["decode_compiles"],
         "decode_compiles_after_warmup":
             c1["decode_compiles"] - c0["decode_compiles"],

@@ -30,12 +30,44 @@ import time
 from ...profiler import explainer as _explain
 from ...profiler import registry as _registry
 
-__all__ = ["ElasticManager", "ElasticStatus"]
+__all__ = ["ElasticManager", "ElasticStatus", "publish_generation"]
 
 # recoveries are observable (ISSUE 4): every trainer restart / world
 # resize lands in the fault.* telemetry scope + explainer ring
 _counters = _registry.scoped_counters("fault", {
-    "elastic.restarts": 0, "elastic.resizes": 0})
+    "elastic.restarts": 0, "elastic.resizes": 0,
+    "elastic.generation_bumps": 0})
+
+
+def publish_generation(store, world, log=None):
+    """Publish a new elastic generation through a rendezvous store so
+    watchers re-rendezvous with a restarted member. Shared by the launch
+    ``Pod`` (trainer restarts) and the serving ``ReplicaSupervisor``
+    (replica restarts) — one protocol, one implementation.
+
+    Mirrors ``ElasticManager._publish`` exactly: exclusive claim via
+    ``add()==1`` (a racing publisher must not double-bump), members
+    written FIRST (a bump without members wedges every watcher), then
+    the gen pointer. Membership is the full 0..world-1 range — an
+    in-place restart replaces a member, it does not shrink the job.
+    Best-effort: store errors are logged and swallowed (the restart
+    itself must proceed). Returns True when this call owned the bump."""
+    if store is None:
+        return False
+    try:
+        gen = int(store.add("elastic/gen", 0))
+        if int(store.add(f"elastic/claim/{gen + 1}", 1)) != 1:
+            return False  # another publisher owns generation gen+1
+        members = ",".join(str(r) for r in range(int(world)))
+        store.set(f"elastic/members/{gen + 1}", members)
+        if int(store.add("elastic/gen", 0)) == gen:
+            store.add("elastic/gen", 1)
+        _counters["elastic.generation_bumps"] += 1
+        return True
+    except Exception as e:  # rendezvous best-effort: restart anyway
+        if log is not None:
+            log(f"elastic generation bump failed: {e}")
+        return False
 
 
 class ElasticStatus:

@@ -129,27 +129,24 @@ class Pod:
     def _bump_generation(self):
         """Publish a new elastic generation through the rendezvous store
         so surviving ranks re-rendezvous with the restarted trainer.
-        Mirrors fleet/elastic.py _publish exactly: exclusive claim via
-        add()==1 (a racing launcher/survivor must not double-bump),
-        members written FIRST (a bump without members wedges every
-        watcher), then the gen pointer. Membership is the unchanged
-        GLOBAL world — an in-place restart replaces a rank, it does not
-        shrink the job (local proc indices would evict every remote
-        rank)."""
+        Membership is the unchanged GLOBAL world — an in-place restart
+        replaces a rank, it does not shrink the job (local proc indices
+        would evict every remote rank). The claim/members/pointer
+        protocol itself lives in fleet.elastic.publish_generation,
+        shared with the serving ReplicaSupervisor."""
         if self.store is None:
             return
+        from ..fleet.elastic import publish_generation
+
         try:
             env = self.specs[0][1] or {}
             world = int(env.get("PADDLE_TRAINERS_NUM", len(self.procs)))
-            gen = int(self.store.add("elastic/gen", 0))
-            if int(self.store.add(f"elastic/claim/{gen + 1}", 1)) != 1:
-                return  # another publisher owns generation gen+1
-            members = ",".join(str(r) for r in range(world))
-            self.store.set(f"elastic/members/{gen + 1}", members)
-            if int(self.store.add("elastic/gen", 0)) == gen:
-                self.store.add("elastic/gen", 1)
-        except Exception as e:  # rendezvous best-effort: restart anyway
+        except (LookupError, TypeError, ValueError) as e:
+            # best-effort like the store ops: a malformed env must not
+            # kill the pod supervisor mid-restart
             self._log(f"elastic generation bump failed: {e}")
+            return
+        publish_generation(self.store, world, log=self._log)
 
     def watch(self):
         """Supervise until every rank exits 0 (return 0), a rank exhausts

@@ -26,8 +26,76 @@ def _to_saveable(obj):
     return obj
 
 
+def _shard_saveable(obj, rank, world_size):
+    """Slice every tensor leaf of a saveable nest into `rank`'s contiguous
+    flat chunk (ceil-divided, so the LAST shards may be uneven or empty —
+    a [2]-element bias over 6 ranks yields chunks [1,1,0,0,0,0]). Chunking
+    is pure numpy slicing on the flattened array: merging the shards back
+    (`_merge_saveable`) is bitwise-exact by construction, which is what
+    lets an N-rank checkpoint resume at world-size M with parity
+    (incubate/checkpoint.load_resharded). Non-tensor leaves (step counters,
+    RNG blobs, scalars) are replicated into every shard verbatim; merge
+    takes rank 0's copy."""
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            data = np.asarray(obj["data"])
+            flat = data.reshape(-1)
+            cs = -(-flat.size // world_size) if flat.size else 0
+            chunk = flat[rank * cs:(rank + 1) * cs] if cs else flat[:0]
+            return {"__tensor_shard__": True, "shape": list(data.shape),
+                    "rank": int(rank), "world_size": int(world_size),
+                    "data": np.ascontiguousarray(chunk),
+                    "stop_gradient": obj.get("stop_gradient", True),
+                    "param": obj.get("param", False)}
+        return {k: _shard_saveable(v, rank, world_size)
+                for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = [_shard_saveable(v, rank, world_size) for v in obj]
+        return t if isinstance(obj, list) else tuple(t)
+    return obj
+
+
+def _merge_saveable(shards):
+    """Inverse of `_shard_saveable`: per-rank saveable nests (in rank
+    order) → one full nest with plain ``__tensor__`` leaves. Concatenation
+    of the flat chunks in rank order then a reshape — no arithmetic, so
+    the result is bitwise-identical to the pre-shard array."""
+    first = shards[0]
+    if isinstance(first, dict):
+        if first.get("__tensor_shard__"):
+            parts = [np.asarray(s["data"]).reshape(-1) for s in shards]
+            flat = parts[0] if len(parts) == 1 else np.concatenate(parts)
+            shape = tuple(first.get("shape") or ())
+            want = 1
+            for d in shape:
+                want *= int(d)
+            if flat.size != want:
+                raise RuntimeError(
+                    f"sharded tensor reassembles to {flat.size} elements "
+                    f"but its recorded shape {shape} needs {want} — "
+                    f"shard set is incomplete or from mixed checkpoints")
+            return {"__tensor__": True, "data": flat.reshape(shape),
+                    "stop_gradient": first.get("stop_gradient", True),
+                    "param": first.get("param", False)}
+        if first.get("__tensor__"):
+            return first  # unsharded (replicated) leaf: rank 0's copy
+        return {k: _merge_saveable([s[k] for s in shards]) for k in first}
+    if isinstance(first, (list, tuple)):
+        t = [_merge_saveable([s[i] for s in shards])
+             for i in range(len(first))]
+        return t if isinstance(first, list) else tuple(t)
+    return first
+
+
 def _from_saveable(obj):
     if isinstance(obj, dict):
+        if obj.get("__tensor_shard__"):
+            raise RuntimeError(
+                f"this payload is one shard of a world-size-"
+                f"{obj.get('world_size')} sharded checkpoint (rank "
+                f"{obj.get('rank')}), not a complete state. Use "
+                "paddle_tpu.incubate.checkpoint.load_resharded(dir, "
+                "rank, world_size) to merge the per-rank shards.")
         if obj.get("__tensor__"):
             cls = Parameter if obj.get("param") else Tensor
             t = cls(obj["data"])

@@ -56,21 +56,50 @@ import zlib
 import numpy as np
 
 from ..core.tensor import Tensor
-from ..framework import _from_saveable, _to_saveable, atomic_write_bytes
+from ..framework import (_from_saveable, _merge_saveable, _shard_saveable,
+                         _to_saveable, atomic_write_bytes)
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
 from ..testing import faults as _faults
 
 __all__ = ["CheckpointManager", "CheckpointHook", "load_latest",
-           "save_checkpoint", "latest_step", "capture_training_state",
-           "restore_training_state"]
+           "load_resharded", "save_checkpoint", "latest_step",
+           "capture_training_state", "restore_training_state",
+           "WorldSizeMismatchError"]
 
 SCHEMA = 1
 _CKPT_RE = re.compile(r"^ckpt-(\d{8})$")
 
 _counters = _registry.scoped_counters("checkpoint", {
     "saves": 0, "async_saves": 0, "restores": 0, "skipped_corrupt": 0,
-    "pruned": 0, "emergency_saves": 0})
+    "pruned": 0, "emergency_saves": 0, "sharded_saves": 0,
+    "reshard_loads": 0})
+
+
+class WorldSizeMismatchError(RuntimeError):
+    """A checkpoint written at world-size N was opened by a world-size-M
+    job without requesting resharding. Loading a per-rank shard (or a
+    wrong-world replica) raw would surface as a shape error deep inside
+    ``set_value`` — this error carries both sizes and names the reshard
+    entrypoint instead."""
+
+    def __init__(self, saved_world_size, world_size, step=None, dir=None,
+                 sharded=False):
+        self.saved_world_size = int(saved_world_size)
+        self.world_size = int(world_size)
+        self.step = step
+        self.dir = dir
+        self.sharded = bool(sharded)
+        where = f" (step {step})" if step is not None else ""
+        what = ("a sharded checkpoint" if sharded else "a checkpoint")
+        super().__init__(
+            f"{what}{where} saved at world_size="
+            f"{self.saved_world_size} cannot load raw into a job with "
+            f"world_size={self.world_size}. Pass reshard=True "
+            f"(CheckpointManager.load_latest / CheckpointHook) or call "
+            f"paddle_tpu.incubate.checkpoint.load_resharded"
+            f"({dir!r}, rank, world_size) to merge/re-slice the "
+            f"per-rank payloads through the manifest.")
 
 
 def _ckpt_dir(base, step):
@@ -149,11 +178,16 @@ class CheckpointManager:
     """
 
     def __init__(self, dir, max_to_keep=3, async_save=True, rank=0,
-                 world_size=1):
+                 world_size=1, shard=False):
         self.dir = str(dir)
         self.max_to_keep = max(1, int(max_to_keep)) if max_to_keep else None
         self.rank = int(rank)
         self.world_size = int(world_size)
+        # sharded saves: each rank persists only its 1/world_size flat
+        # chunk of every tensor leaf (framework._shard_saveable), cutting
+        # per-rank write volume for replicated state; restore goes through
+        # load_resharded, which merges ALL shards — at any target world
+        self.shard = bool(shard) and self.world_size > 1
         self._async = bool(async_save)
         self._q: queue.Queue = queue.Queue(maxsize=2)
         self._writer = None
@@ -171,6 +205,12 @@ class CheckpointManager:
         self._reraise()
         with _registry.time_block("save.snapshot", scope="checkpoint"):
             payload = _to_saveable(state)
+            if self.shard:
+                # numpy views onto the snapshot — the writer thread
+                # pickles only this rank's chunks
+                payload = _shard_saveable(payload, self.rank,
+                                          self.world_size)
+                _counters["sharded_saves"] += 1
             rng = _rng_snapshot()
         job = {"step": int(step), "epoch": epoch, "payload": payload,
                "rng": rng, "user": user_meta}
@@ -223,7 +263,7 @@ class CheckpointManager:
         manifest = {
             "schema": SCHEMA, "step": step, "epoch": job["epoch"],
             "time": time.time(), "rank": self.rank,
-            "world_size": self.world_size,
+            "world_size": self.world_size, "sharded": self.shard,
             "files": {_payload_name(self.rank):
                       {"crc32": zlib.crc32(blob), "bytes": len(blob)}},
             "rng": job["rng"], "user": job["user"],
@@ -239,6 +279,17 @@ class CheckpointManager:
                         step=step, dir=d, bytes=len(blob))
         if self.rank == 0 and self.max_to_keep:
             self._prune()
+
+    # -- load ---------------------------------------------------------------
+    def load_latest(self, reshard=False):
+        """Newest valid checkpoint as (state, manifest) — (None, None) on
+        a fresh directory. The saved world size is checked against this
+        manager's: a mismatch (N→M resume) or a sharded checkpoint raises
+        :class:`WorldSizeMismatchError` unless ``reshard=True``, which
+        merges every saved rank's payload into the full state
+        (:func:`load_resharded`)."""
+        return load_latest(self.dir, rank=self.rank,
+                           world_size=self.world_size, reshard=reshard)
 
     def _prune(self):
         steps = list_steps(self.dir)
@@ -271,8 +322,13 @@ def _read_manifest(d, rank):
     return m
 
 
-def _load_one(base, step, rank):
-    """One checkpoint dir → (state, manifest) or (None, reason)."""
+def _load_one(base, step, rank, raw=False):
+    """One checkpoint dir → (state, manifest) or (None, reason).
+
+    ``raw=True`` returns the verified pickled nest WITHOUT materializing
+    Tensors — the reshard path merges raw shard nests from every rank
+    before a single `_from_saveable` pass, and integrity probes
+    (`latest_step`) never need live Tensors at all."""
     d = _ckpt_dir(base, step)
     commit = _read_manifest(d, 0)
     if commit is None:
@@ -293,19 +349,39 @@ def _load_one(base, step, rank):
         return None, (f"payload checksum mismatch (got {len(blob)} bytes, "
                       f"manifest says {rec.get('bytes')})")
     try:
-        state = _from_saveable(pickle.loads(blob))
+        state = pickle.loads(blob)
+        if not raw:
+            state = _from_saveable(state)
     except Exception as e:
         return None, f"payload unpicklable ({type(e).__name__}: {e})"
     return state, commit
 
 
-def load_latest(base, rank=0):
+def load_latest(base, rank=0, world_size=None, reshard=False):
     """Newest VALID checkpoint under `base` → (state, manifest), or
     (None, None) when none exists. Corrupt/partial checkpoints (torn
     payload, missing manifest, bad checksum) are skipped with a
-    `checkpoint_skip` explainer event — never a crash."""
+    `checkpoint_skip` explainer event — never a crash.
+
+    `world_size` (when given) is validated against the manifest: a
+    mismatch — or any SHARDED checkpoint, whose per-rank payload is a
+    slice rather than a full state — raises :class:`WorldSizeMismatchError`
+    up front instead of a shape error deep in ``set_value``, unless
+    ``reshard=True`` routes through :func:`load_resharded`."""
+    if reshard:
+        return load_resharded(base, rank=rank,
+                              world_size=world_size or 1)
     t0 = time.perf_counter()
     for step in reversed(list_steps(base)):
+        commit = _read_manifest(_ckpt_dir(base, step), 0)
+        if commit is not None:
+            saved_w = int(commit.get("world_size", 1))
+            if commit.get("sharded") or (
+                    world_size is not None and saved_w != int(world_size)):
+                raise WorldSizeMismatchError(
+                    saved_w, world_size if world_size is not None else 1,
+                    step=step, dir=base,
+                    sharded=bool(commit.get("sharded")))
         state, man = _load_one(base, step, rank)
         if state is not None:
             _registry.timing("restore", time.perf_counter() - t0,
@@ -323,19 +399,73 @@ def load_latest(base, rank=0):
     return None, None
 
 
+def load_resharded(base, rank=0, world_size=1, step=None):
+    """Load the newest valid checkpoint REGARDLESS of the world size it
+    was saved at: verify + read every saved rank's payload through its
+    checksummed manifest, merge the per-leaf flat chunks back into full
+    tensors (bitwise — pure concatenation/reshape), and return
+    ``(full_state, commit_manifest)``.
+
+    This is the N→M entrypoint: M ranks each call it and get the same
+    full state (N→1 and 1→M are the degenerate cases); a job that wants
+    per-rank slices again simply re-saves with ``shard=True`` at its own
+    world size — re-slicing happens on the next save, merging on load.
+    Unsharded checkpoints (replicated full state per rank) merge
+    trivially by taking rank 0's payload. A checkpoint with ANY
+    unreadable shard is skipped whole — partial merges would silently
+    lose parameters. RNG state rides the returned commit manifest, same
+    as `load_latest`."""
+    t0 = time.perf_counter()
+    steps = [step] if step is not None else list(reversed(list_steps(base)))
+    for s in steps:
+        commit = _read_manifest(_ckpt_dir(base, s), 0)
+        if commit is None:
+            reason = "no commit marker (MANIFEST.json missing/invalid)"
+        else:
+            saved_w = int(commit.get("world_size", 1))
+            shards, reason = [], None
+            for r in range(saved_w):
+                raw, why = _load_one(base, s, r, raw=True)
+                if raw is None:
+                    reason = f"shard {r}/{saved_w}: {why}"
+                    break
+                shards.append(raw)
+            if reason is None:
+                state = _from_saveable(_merge_saveable(shards))
+                _registry.timing("restore", time.perf_counter() - t0,
+                                 scope="checkpoint")
+                _counters["reshard_loads"] += 1
+                _counters["restores"] += 1
+                _explain.record(
+                    "checkpoint_reshard", op="load_resharded",
+                    why=f"step {commit['step']}: merged {saved_w} shard(s)"
+                        f" -> world_size {world_size} (rank {rank})",
+                    step=commit["step"], saved_world_size=saved_w,
+                    world_size=int(world_size), rank=rank)
+                return state, commit
+        _counters["skipped_corrupt"] += 1
+        _explain.record("checkpoint_skip", op="load_resharded",
+                        why=f"skipping ckpt-{s:08d}: {reason}",
+                        step=s, rank=rank)
+    return None, None
+
+
 def latest_step(base, rank=0):
-    """Step of the newest valid checkpoint, or None."""
+    """Step of the newest valid checkpoint, or None. Validity here is
+    integrity (manifest + checksum + unpickle), not world-size fit —
+    sharded and foreign-world checkpoints count (the serving checkpoint
+    watcher polls this against live training output)."""
     for step in reversed(list_steps(base)):
-        if _load_one(base, step, rank)[0] is not None:
+        if _load_one(base, step, rank, raw=True)[0] is not None:
             return step
     return None
 
 
 def save_checkpoint(base, state, step, epoch=None, user_meta=None,
-                    max_to_keep=None, rank=0, world_size=1):
+                    max_to_keep=None, rank=0, world_size=1, shard=False):
     """One-shot synchronous checkpoint commit (atomic, checksummed)."""
     mgr = CheckpointManager(base, max_to_keep=max_to_keep, async_save=False,
-                            rank=rank, world_size=world_size)
+                            rank=rank, world_size=world_size, shard=shard)
     return mgr.save(state, step, epoch=epoch, user_meta=user_meta)
 
 
@@ -415,10 +545,14 @@ class CheckpointHook:
 
     def __init__(self, dir, network, optimizer=None, save_interval=100,
                  max_to_keep=3, async_save=True, rank=0, world_size=1,
-                 install_sigterm=True):
+                 shard=False, reshard=False, install_sigterm=True):
         self.manager = CheckpointManager(dir, max_to_keep=max_to_keep,
                                          async_save=async_save, rank=rank,
-                                         world_size=world_size)
+                                         world_size=world_size, shard=shard)
+        # reshard=True lets restore() resume from a checkpoint written at
+        # a DIFFERENT world size (preemption resize): shards are merged
+        # through the manifest, then re-sliced on this job's next save
+        self.reshard = bool(reshard)
         self._net = network
         self._opt = optimizer
         self.save_interval = max(1, int(save_interval))
@@ -458,8 +592,11 @@ class CheckpointHook:
     def restore(self):
         """Resume from the newest valid checkpoint: restores params,
         optimizer slots, and RNG in place; returns the step to run next
-        (0 on a fresh start)."""
-        state, man = load_latest(self.manager.dir, rank=self.manager.rank)
+        (0 on a fresh start). With ``reshard=True`` a checkpoint written
+        at any world size resumes here (merged via the manifests);
+        otherwise a world-size mismatch raises
+        :class:`WorldSizeMismatchError`."""
+        state, man = self.manager.load_latest(reshard=self.reshard)
         if state is None:
             return 0
         restore_training_state(self._net, self._opt, state)

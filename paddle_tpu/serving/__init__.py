@@ -17,23 +17,35 @@ Layers (one file each):
   * ``server``    — threaded submit()/result()/generate() frontend with
                     backpressure, deadlines, and SIGTERM-style drain
 
+Resilience (ISSUE 7 — the train→serve loop): ``server.swap_weights`` /
+``server.watch_checkpoints`` hot-swap weights between decode steps without
+dropping a request (serving follows training's checkpoint directory
+automatically, merging N-rank shards via ``incubate.checkpoint``);
+``ReplicaSupervisor`` (``supervisor``) restarts crashed replicas with
+backoff, replays their requests bitwise by seed, and autoscales the fleet
+off queue-depth/occupancy telemetry.
+
 Quickstart::
 
     from paddle_tpu.serving import GenerationServer
     server = GenerationServer(model, max_batch_size=8,
                               buckets=(64, 256), max_queue_size=64).start()
+    server.watch_checkpoints("/ckpts/run0")   # follow training (optional)
     req = server.submit(prompt_ids, max_new_tokens=64, temperature=0.8)
     print(server.result(req).tokens)      # or: server.generate(prompt_ids)
     server.shutdown()                     # graceful drain
 """
-from .engine import GenerationEngine  # noqa: F401
+from .engine import (  # noqa: F401
+    FatalEngineError, GenerationEngine, WeightSwapError)
 from .scheduler import (  # noqa: F401
     ContinuousBatchScheduler, GenerationRequest, QueueFullError,
     RequestStatus)
 from .server import GenerationServer  # noqa: F401
+from .supervisor import ReplicaSupervisor  # noqa: F401
 from . import sampling  # noqa: F401
 
 __all__ = [
     "GenerationEngine", "ContinuousBatchScheduler", "GenerationRequest",
-    "QueueFullError", "RequestStatus", "GenerationServer", "sampling",
+    "QueueFullError", "RequestStatus", "GenerationServer",
+    "ReplicaSupervisor", "WeightSwapError", "FatalEngineError", "sampling",
 ]

@@ -53,12 +53,27 @@ from ..core.tensor import Tensor
 from ..profiler import RecordEvent
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
+from ..testing import faults as _faults
 from . import sampling as _sampling
 
 _counters = _registry.scoped_counters("serving", {
     "prefills": 0, "decode_steps": 0, "tokens_generated": 0,
     "active_slot_steps": 0, "prefill_compiles": 0, "decode_compiles": 0,
-    "bucket_promotions": 0})
+    "bucket_promotions": 0, "weight_swaps": 0, "reprimes": 0})
+
+
+class WeightSwapError(RuntimeError):
+    """A proposed weight swap does not fit the running engine (missing or
+    extra names, shape mismatch, incompatible device placement). Raised
+    BEFORE any weight is replaced — the engine keeps serving the old
+    weights, and the KV cache is never touched."""
+
+
+class FatalEngineError(RuntimeError):
+    """Non-transient engine death (device lost, injected replica kill).
+    The scheduler's transient-retry path does NOT swallow this: it
+    propagates to the server loop, which marks the replica dead so a
+    supervisor can restart it and re-queue its requests."""
 
 
 def _default_buckets(max_seq_len):
@@ -83,7 +98,7 @@ class GenerationEngine:
     """
 
     def __init__(self, model, max_batch_size=4, buckets=None,
-                 max_seq_len=None):
+                 max_seq_len=None, rng_seed=None):
         gpt = getattr(model, "gpt", model)
         if not hasattr(gpt, "blocks") or not hasattr(gpt, "embeddings"):
             raise TypeError(
@@ -143,8 +158,16 @@ class GenerationEngine:
         self._keys = np.zeros((B, 2), np.uint32)
 
         # seed-determinism root: one split of the global generator, so
-        # paddle_tpu.seed(s) pins every sampled token this engine produces
-        self._base_key = _random.split_key()
+        # paddle_tpu.seed(s) pins every sampled token this engine produces.
+        # An explicit rng_seed pins the base key independently of global
+        # generator history — two engines built with the same rng_seed
+        # sample identically, which is what lets a supervisor's restarted
+        # replica REPLAY a dead replica's requests bitwise (idempotent by
+        # request seed)
+        if rng_seed is None:
+            self._base_key = _random.split_key()
+        else:
+            self._base_key = jax.random.PRNGKey(int(rng_seed))
         self._seed_counter = itertools.count()
 
         # donate the KV buffers (args 1, 2) so the per-step cache update
@@ -153,11 +176,11 @@ class GenerationEngine:
         # only: XLA-CPU intermittently SIGABRTs with many donated
         # executables co-resident in one process (hybrid_engine._compile
         # has the same gate for the same reason).
-        donate = (1, 2) if jax.devices()[0].platform != "cpu" else ()
+        self._donate = (1, 2) if jax.devices()[0].platform != "cpu" else ()
         self._prefill_jit = jax.jit(self._prefill_pure,
-                                    donate_argnums=donate)
+                                    donate_argnums=self._donate)
         self._decode_jit = jax.jit(self._decode_pure,
-                                   donate_argnums=donate)
+                                   donate_argnums=self._donate)
         self._seen_sigs: set = set()
 
     # ------------------------------------------------------------- slots --
@@ -275,6 +298,112 @@ class GenerationEngine:
         toks = _sampling.sample_tokens(logits, temps, top_ks, top_ps, gum)
         return toks, nk, nv
 
+    # ------------------------------------------------------- weight swap --
+    def _resolve_swap_state(self, state):
+        """Map an incoming state nest onto this engine's bound weight
+        names. Accepts the decoder's own state_dict, a wrapper model's
+        (uniform name prefix, e.g. ``gpt.``), or a full checkpoint nest
+        (``{"model": ..., "optimizer": ...}`` from
+        capture_training_state — the optimizer part is ignored)."""
+        if not isinstance(state, dict):
+            raise WeightSwapError(
+                f"swap state must be a dict of name -> array, got "
+                f"{type(state).__name__}")
+        if "model" in state and isinstance(state["model"], dict) \
+                and "model" not in self._names:
+            state = state["model"]
+        if all(n in state for n in self._names):
+            return {n: state[n] for n in self._names}
+        # wrapper prefix: every engine name appears under one common
+        # prefix (GPTForPretraining saves "gpt.<name>" while the engine
+        # binds the inner GPTModel's names)
+        probe = self._names[0]
+        for key in state:
+            if key.endswith(probe) and key != probe:
+                pre = key[:-len(probe)]
+                if all(pre + n in state for n in self._names):
+                    return {n: state[pre + n] for n in self._names}
+        missing = [n for n in self._names if n not in state]
+        raise WeightSwapError(
+            f"swap state is missing {len(missing)}/{len(self._names)} "
+            f"weights (first: {missing[:3]}); a partial swap would serve "
+            "inconsistent weights, refusing")
+
+    def swap_weights(self, state, source=None):
+        """Atomically replace every bound weight. Must be called between
+        steps on the engine's driver thread (the scheduler applies staged
+        swaps at its step boundary — ``scheduler.request_swap`` /
+        ``server.swap_weights`` are the thread-safe frontends).
+
+        All-or-nothing: every array is validated and staged on host
+        BEFORE the first assignment, so any refusal (missing name, shape
+        mismatch, foreign device placement) — or a crash mid-swap — leaves
+        the engine serving the complete pre-swap weights. The KV cache is
+        untouched: in-flight requests keep their prefix state and simply
+        decode their next token under the new weights, and because the
+        new arrays have the same avals the compiled decode step replays
+        with ZERO recompiles."""
+        resolved = self._resolve_swap_state(state)
+        staged = []
+        for n in self._names:
+            cur = self._state[n]._data
+            v = resolved[n]
+            if isinstance(v, Tensor):
+                v = v._data
+            if isinstance(v, jax.Array):
+                if v.shape != cur.shape:
+                    raise WeightSwapError(
+                        f"aval mismatch for {n!r}: engine holds "
+                        f"{tuple(cur.shape)}, swap offers "
+                        f"{tuple(v.shape)} — this is a different model")
+                try:
+                    placed = (len(v.devices()) > 1 or
+                              len(cur.devices()) > 1)
+                    mesh_mismatch = placed and v.sharding != cur.sharding
+                except Exception:
+                    mesh_mismatch = False
+                if mesh_mismatch:
+                    raise WeightSwapError(
+                        f"sharding mismatch for {n!r}: engine weight is "
+                        f"placed as {cur.sharding}, swap offers "
+                        f"{v.sharding} — re-place the arrays on the "
+                        "serving mesh before swapping")
+                arr = v if v.dtype == cur.dtype else v.astype(cur.dtype)
+            else:
+                a = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+                if tuple(a.shape) != tuple(cur.shape):
+                    raise WeightSwapError(
+                        f"aval mismatch for {n!r}: engine holds "
+                        f"{tuple(cur.shape)}, swap offers "
+                        f"{tuple(a.shape)} — this is a different model")
+                arr = jnp.asarray(a, cur.dtype)
+            staged.append(arr)
+        if _faults.ACTIVE:
+            _faults.fire("kill_during_swap")
+        for n, arr in zip(self._names, staged):
+            self._state[n]._data = arr
+        _counters["weight_swaps"] += 1
+        _explain.record(
+            "serving_weight_swap", op="swap_weights",
+            why=f"swapped {len(staged)} weights"
+                + (f" from {source}" if source else "")
+                + "; in-flight requests keep their KV cache and decode "
+                  "the next token on the new weights",
+            weights=len(staged), source=source)
+
+    def reprime(self):
+        """Rebuild the compiled decode step (drops the executable and its
+        cache). Transient-fault recovery: the scheduler re-primes then
+        retries one decode after a step error before failing the batch.
+        The compile radar mirrors jax.jit's aval cache, so the decode
+        signatures are forgotten with it — the retry's recompile must
+        count in ``decode_compiles``, not hide behind a stale entry."""
+        self._decode_jit = jax.jit(self._decode_pure,
+                                   donate_argnums=self._donate)
+        self._seen_sigs = {s for s in self._seen_sigs
+                           if s[0] != "decode"}
+        _counters["reprimes"] += 1
+
     # ----------------------------------------------------- compile radar --
     def _note_signature(self, phase, args, detail):
         """Mirror jax.jit's aval cache: a first-seen (shape, dtype)
@@ -349,6 +478,10 @@ class GenerationEngine:
         n_active = int(active.sum())
         if n_active == 0:
             raise RuntimeError("decode_step with no active slots")
+        if _faults.ACTIVE:
+            _faults.fire("slow_decode")
+            _faults.fire("replica_kill")
+            _faults.fire("decode_error")
         args = (self._state_arrays(), tuple(self._k), tuple(self._v),
                 jnp.asarray(self._last_tokens), jnp.asarray(self._cur_lens),
                 jnp.asarray(self._keys), jnp.asarray(self._gen_idx),

@@ -26,11 +26,14 @@ import itertools
 import threading
 import time
 
+from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
+from .engine import FatalEngineError
 
 _counters = _registry.scoped_counters("serving", {
     "requests_submitted": 0, "requests_completed": 0,
-    "requests_rejected": 0, "requests_timeout": 0, "requests_failed": 0})
+    "requests_rejected": 0, "requests_timeout": 0, "requests_failed": 0,
+    "step_retries": 0, "swap_failures": 0, "requeued_requests": 0})
 
 
 class QueueFullError(RuntimeError):
@@ -118,6 +121,9 @@ class ContinuousBatchScheduler:
         self._t0 = None
         self._tok_base = _counters["tokens_generated"] \
             if "tokens_generated" in _counters else 0
+        self._pending_swap = None  # (state, source), newest staged wins
+        self.swap_count = 0
+        self.last_swap_error = None
 
     # ---------------------------------------------------------- frontend --
     def submit(self, request):
@@ -177,6 +183,71 @@ class ContinuousBatchScheduler:
         for slot, req in list(self._active.items()):
             self._finish(req, RequestStatus.ERROR, error=repr(exc))
 
+    def takeover_requests(self):
+        """Replica-death path (supervisor): hand back every queued AND
+        in-flight request UN-finished — events stay unset so callers
+        blocked on result() keep waiting for the replay, token prefixes
+        are cleared so the replay regenerates them. Because sampling
+        depends only on (engine base key, request seed, token index), a
+        restarted replica built with the same ``rng_seed`` reproduces
+        each request's tokens bitwise — resubmission is idempotent by
+        request seed. Call only after the driving worker has stopped
+        (the dead replica's engine is not touched beyond slot releases)."""
+        self.close()
+        with self._lock:
+            queued = list(self._queue)
+            self._queue.clear()
+        inflight = list(self._active.values())
+        self._active.clear()
+        try:
+            self.engine.reset()
+        except Exception:
+            pass  # dead engines don't need their slots back
+        out = []
+        for req in inflight + queued:
+            if req.done:
+                continue
+            req.slot = None
+            req.tokens = []
+            req.status = RequestStatus.QUEUED
+            req.stop_reason = None
+            req.error = None
+            out.append(req)
+        _counters["requeued_requests"] += len(out)
+        return out
+
+    # ----------------------------------------------------- weight swaps --
+    def request_swap(self, state, source=None):
+        """Stage a weight swap; thread-safe, O(1). The swap is applied by
+        the driving thread at the NEXT step boundary — between decode
+        steps, so no request ever observes a half-swapped model. Staging
+        twice before a step replaces the earlier stage (newest weights
+        win)."""
+        with self._lock:
+            self._pending_swap = (state, source)
+
+    def _apply_pending_swap(self):
+        with self._lock:
+            pending, self._pending_swap = self._pending_swap, None
+        if pending is None:
+            return
+        state, source = pending
+        try:
+            self.engine.swap_weights(state, source=source)
+            self.swap_count += 1
+            self.last_swap_error = None
+        except Exception as e:
+            # refused or died mid-validation: the engine guarantees no
+            # partial assignment, so the pre-swap weights keep serving
+            _counters["swap_failures"] += 1
+            self.last_swap_error = e
+            _explain.record(
+                "serving_swap_failed", op="swap_weights",
+                why=f"weight swap{f' from {source}' if source else ''} "
+                    f"failed ({type(e).__name__}: {e}); serving continues "
+                    "on the pre-swap weights",
+                source=source, error=str(e))
+
     # ---------------------------------------------------------- the loop --
     def step(self):
         """One continuous-batching iteration; returns True while any work
@@ -184,6 +255,11 @@ class ContinuousBatchScheduler:
         now = time.monotonic()
         if self._t0 is None:
             self._t0 = now
+
+        # (0) staged weight swap lands HERE — between decode steps, so
+        # every token of every request is computed on one consistent set
+        # of weights (old until this boundary, new after)
+        self._apply_pending_swap()
 
         # (1) deadline-expired while queued: fail fast, never occupy a slot
         with self._lock:
@@ -210,13 +286,36 @@ class ContinuousBatchScheduler:
 
         # (3) one decode iteration over every active slot
         if self._active:
-            toks = self.engine.decode_step()
+            toks = self._decode_with_retry()
             for slot, req in list(self._active.items()):
                 self._append_token(req, int(toks[slot]),
                                    time.monotonic())
 
         self._update_throughput()
         return self.has_work()
+
+    def _decode_with_retry(self):
+        """One decode iteration with single-retry fault tolerance: a
+        transient engine exception re-primes the decode executable and
+        retries once; only the SECOND consecutive error propagates (the
+        server loop then fails the batch). Fatal errors (replica death)
+        are never retried — they must reach the supervisor."""
+        try:
+            return self.engine.decode_step()
+        except FatalEngineError:
+            raise
+        except Exception as e:
+            _counters["step_retries"] += 1
+            _explain.record(
+                "serving_step_retry", op="decode_step",
+                why=f"transient decode failure ({type(e).__name__}: {e}); "
+                    "re-priming the decode executable and retrying once "
+                    "before failing the batch",
+                error=str(e))
+            reprime = getattr(self.engine, "reprime", None)
+            if reprime is not None:
+                reprime()
+            return self.engine.decode_step()
 
     def drain(self, timeout=None):
         """Run step() until idle (graceful drain); True if fully drained."""

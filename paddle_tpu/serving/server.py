@@ -23,10 +23,13 @@ serving task hands back complete responses instead of torn ones;
 """
 from __future__ import annotations
 
+import os
 import signal
 import threading
+import time
 
-from .engine import GenerationEngine
+from ..profiler import explainer as _explain
+from .engine import FatalEngineError, GenerationEngine
 from .scheduler import (ContinuousBatchScheduler, GenerationRequest,
                         QueueFullError, RequestStatus)
 
@@ -34,7 +37,7 @@ from .scheduler import (ContinuousBatchScheduler, GenerationRequest,
 class GenerationServer:
     def __init__(self, model=None, engine=None, max_batch_size=4,
                  buckets=None, max_seq_len=None, max_queue_size=16,
-                 idle_wait_s=0.005):
+                 idle_wait_s=0.005, fail_fast_on_fatal=True):
         if engine is None:
             if model is None:
                 raise ValueError("GenerationServer needs a model or an "
@@ -51,6 +54,16 @@ class GenerationServer:
         self._draining = threading.Event()  # graceful: finish, then stop
         self._thread = None
         self._old_sigterm = None
+        # FatalEngineError handling: standalone servers fail pending work
+        # fast (callers must not wedge); a ReplicaSupervisor sets
+        # fail_fast_on_fatal=False so it can take over the UN-finished
+        # requests and replay them on a restarted replica
+        self._fail_fast_on_fatal = bool(fail_fast_on_fatal)
+        self._fatal = None
+        # checkpoint watcher (train→serve loop)
+        self._watcher = None
+        self._watch_stop = None
+        self.last_swap_step = -1
 
     # ----------------------------------------------------------- control --
     def start(self):
@@ -68,13 +81,39 @@ class GenerationServer:
             if self.scheduler.has_work():
                 try:
                     self.scheduler.step()
+                except FatalEngineError as e:
+                    # replica death: stop driving the engine. Requests
+                    # stay UN-finished when a supervisor owns this server
+                    # (it takes them over and replays them); standalone,
+                    # fail them so result() callers don't wedge.
+                    self._fatal = e
+                    self.scheduler.close()
+                    _explain.record(
+                        "serving_replica_fatal", op="serve_loop",
+                        why=f"engine died fatally ({e}); worker loop "
+                            "exiting — supervisor restart / takeover "
+                            "required",
+                        error=str(e))
+                    if self._fail_fast_on_fatal:
+                        self.scheduler.cancel_pending(
+                            reason=f"fatal engine error: {e}")
+                    break
                 except Exception as e:  # fail loudly, don't wedge callers
                     self.scheduler.fail_all(e)
                 continue
             if self._draining.is_set():
                 break
+            # idle = no decode in flight: a staged swap applies here too,
+            # so following a checkpoint dir doesn't wait for traffic
+            self.scheduler._apply_pending_swap()
             with self._work:
                 self._work.wait(self._idle_wait_s)
+
+    @property
+    def fatal_error(self):
+        """The FatalEngineError that killed this server's worker, or
+        None while healthy. Supervisors poll this."""
+        return self._fatal
 
     def request_drain(self):
         """Signal-safe graceful-drain trigger: sets flags only (the
@@ -91,10 +130,108 @@ class GenerationServer:
             signal.SIGTERM, lambda signum, frame: self.request_drain())
         return self
 
+    # ------------------------------------------------- train→serve loop --
+    def swap_weights(self, state, source=None):
+        """Stage a drain-free weight hot-swap: thread-safe, returns
+        immediately. The scheduler applies it between decode steps —
+        in-flight requests keep their KV cache and finish on consistent
+        weights (old until the boundary, new after); an aval/placement
+        mismatch is refused loudly (``serving.swap_failures`` +
+        ``serving_swap_failed`` explainer event) and the old weights keep
+        serving. Zero requests fail or stall across a swap."""
+        self.scheduler.request_swap(state, source=source)
+        with self._work:
+            self._work.notify()
+
+    def watch_checkpoints(self, ckpt_dir, interval=0.5):
+        """Tail a training checkpoint directory: whenever a newer VALID
+        checkpoint commits, merge its per-rank shards (any world size —
+        incubate.checkpoint.load_resharded) and stage a weight swap, so
+        serving follows training automatically. Torn or partial
+        checkpoints are skipped by the checksummed-manifest loader — the
+        watcher never crashes the server, it just waits for the next
+        commit. Stops with shutdown()."""
+        from ..incubate import checkpoint as _ckpt
+
+        if self._watcher is not None and self._watcher.is_alive():
+            return self
+        ckpt_dir = str(ckpt_dir)
+        self._watch_stop = threading.Event()
+        # (step, file set) of the newest attempted checkpoint. A multi-rank
+        # checkpoint commits rank 0's manifest before the other shards may
+        # have landed, so a failed merge must NOT blacklist the step — we
+        # re-attempt whenever the step dir's file set changes (late-arriving
+        # shard) while a byte-torn payload (same files) stays skipped, which
+        # keeps the poll loop from re-unpickling a bad checkpoint every tick.
+        attempted = [(-1, ())]
+
+        def _tail():
+            while not self._watch_stop.is_set():
+                try:
+                    step = _ckpt.latest_step(ckpt_dir)
+                    if step is not None and step > self.last_swap_step:
+                        d = os.path.join(ckpt_dir, f"ckpt-{step:08d}")
+                        try:
+                            probe = (step, tuple(sorted(os.listdir(d))))
+                        except OSError:
+                            probe = (step, ())
+                        if probe == attempted[0]:
+                            self._watch_stop.wait(float(interval))
+                            continue
+                        attempted[0] = probe
+                        state, man = _ckpt.load_resharded(ckpt_dir,
+                                                          world_size=1)
+                        if state is not None and \
+                                int(man["step"]) > self.last_swap_step:
+                            model_state = state.get("model", state) \
+                                if isinstance(state, dict) else state
+                            got = int(man["step"])
+                            # last_swap_step advances only once the
+                            # scheduler APPLIES the swap — a refused one
+                            # (aval/name mismatch) must not report
+                            # success, and stays re-attemptable if the
+                            # checkpoint dir changes
+                            c0 = self.scheduler.swap_count
+                            e0 = self.scheduler.last_swap_error
+                            self.swap_weights(
+                                model_state,
+                                source=f"{ckpt_dir}/ckpt-{got:08d}")
+                            waited = 0.0
+                            while not self._watch_stop.is_set() \
+                                    and waited < 30.0:
+                                if self.scheduler.swap_count > c0:
+                                    self.last_swap_step = got
+                                    break
+                                err = self.scheduler.last_swap_error
+                                if err is not None and err is not e0:
+                                    break  # refused; explainer has why
+                                time.sleep(0.02)
+                                waited += 0.02
+                except Exception as e:
+                    _explain.record(
+                        "serving_watcher_error", op="watch_checkpoints",
+                        why=f"checkpoint watcher poll failed "
+                            f"({type(e).__name__}: {e}); retrying next "
+                            "interval", error=str(e))
+                self._watch_stop.wait(float(interval))
+
+        self._watcher = threading.Thread(target=_tail, daemon=True,
+                                         name="paddle-tpu-ckpt-watcher")
+        self._watcher.start()
+        return self
+
+    def stop_watcher(self):
+        if self._watch_stop is not None:
+            self._watch_stop.set()
+        if self._watcher is not None:
+            self._watcher.join(timeout=5)
+            self._watcher = None
+
     def shutdown(self, drain=True, timeout=None):
         """Stop the server. drain=True (default) finishes every queued and
         in-flight request first; drain=False fails them fast with
         status="error". Returns True if the worker exited in time."""
+        self.stop_watcher()
         if drain:
             self.request_drain()
         else:
@@ -125,16 +262,21 @@ class GenerationServer:
         """Enqueue a generation job; returns its GenerationRequest handle.
         Raises QueueFullError immediately under backpressure and
         RuntimeError once shutdown/drain has begun."""
+        return self.submit_request(GenerationRequest(prompt_ids, **options))
+
+    def submit_request(self, request):
+        """Enqueue an existing GenerationRequest handle (the supervisor's
+        replay path re-submits a dead replica's requests — same object,
+        same seed — to a healthy server)."""
         if self._draining.is_set() or self._stop.is_set():
             raise RuntimeError("server is shutting down; not accepting "
                                "requests")
         if self._thread is None:
             self.start()
-        req = GenerationRequest(prompt_ids, **options)
-        self.scheduler.submit(req)
+        self.scheduler.submit(request)
         with self._work:
             self._work.notify()
-        return req
+        return request
 
     def result(self, request, timeout=None):
         return request.result(timeout)

@@ -19,6 +19,10 @@ accepted by :func:`configure` directly::
     "store_flaky:fails=3"                first 3 store ops raise
     "store_flaky:fails=3,op=set"         ... only set()s
     "store_slow:delay=0.2"               every store op sleeps 0.2 s
+    "kill_during_swap"                   weight swap dies post-validation
+    "slow_decode:delay=0.05,steps=3"     first 3 decode steps sleep
+    "decode_error:fails=1"               first decode step(s) raise
+    "replica_kill:nth=5"                 5th decode step dies FATALLY
 
 Points (consumed by the named subsystems):
 
@@ -30,6 +34,10 @@ Points (consumed by the named subsystems):
     truncate_checkpoint incubate/checkpoint writer (post-commit) nth, bytes
     store_flaky         distributed/store.py TCPStore ops        fails, op
     store_slow          distributed/store.py TCPStore ops        delay, op
+    kill_during_swap    serving/engine.swap_weights (pre-commit) nth
+    slow_decode         serving/engine.decode_step               delay, steps
+    decode_error        serving/engine.decode_step (transient)   fails
+    replica_kill        serving/engine.decode_step (fatal)       nth
     ==================  =======================================  ============
 
 Each firing bumps `fault.injected.<point>` in the telemetry registry and
@@ -189,6 +197,47 @@ def fire(point, step=None, rank=None, path=None, op=None):
         raise ConnectionError(
             f"injected transient TCPStore.{op} failure "
             f"({ent['count']}/{int(p.get('fails', 1))})")
+
+    if point == "kill_during_swap":
+        # fires AFTER swap validation, BEFORE the first weight is
+        # assigned: proves swap_weights is transactional (the engine
+        # must keep serving the complete pre-swap weights)
+        ent["count"] += 1
+        if ent["count"] != int(p.get("nth", 1)):
+            return False
+        _record(point, "weight swap killed between validation and commit")
+        raise RuntimeError(
+            "injected failure during weight swap (kill_during_swap)")
+
+    if point == "slow_decode":
+        ent["count"] += 1
+        steps = p.get("steps")
+        if steps is not None and ent["count"] > int(steps):
+            return False
+        delay = float(p.get("delay", 0.05))
+        _record(point, f"decode step #{ent['count']} delayed {delay}s")
+        time.sleep(delay)
+        return True
+
+    if point == "decode_error":
+        if ent["count"] >= int(p.get("fails", 1)):
+            return False
+        ent["count"] += 1
+        _record(point, f"transient decode failure #{ent['count']}")
+        raise RuntimeError(
+            f"injected transient decode failure "
+            f"({ent['count']}/{int(p.get('fails', 1))})")
+
+    if point == "replica_kill":
+        ent["count"] += 1
+        if ent["count"] != int(p.get("nth", 1)):
+            return False
+        _record(point, f"replica killed at decode step {ent['count']}")
+        from ..serving.engine import FatalEngineError
+
+        raise FatalEngineError(
+            f"injected replica death at decode step {ent['count']} "
+            "(replica_kill)")
 
     if point == "store_slow":
         want_op = p.get("op")

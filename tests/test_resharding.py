@@ -1,0 +1,302 @@
+"""N→M checkpoint resharding (ISSUE 7 tentpole, layer 1).
+
+A checkpoint written at world-size N must resume at world-size M (N→M,
+N→1, 1→M, uneven/empty last shards) by merging the per-rank flat chunks
+through the checksummed manifests — BITWISE equal to the unresharded
+state, optimizer slots (positional p<i> keys) and RNG included. A
+world-size mismatch without reshard=True is a structured error naming
+the reshard entrypoint, not a shape error deep in set_value.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.incubate import checkpoint as ckpt
+from paddle_tpu.testing import faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.reset()
+
+
+def _mlp(seed=3, din=6, dhid=12, dout=2, dtype=None):
+    paddle.seed(seed)
+    net = nn.Sequential(nn.Linear(din, dhid), nn.Tanh(),
+                        nn.Linear(dhid, dout))
+    if dtype == "bfloat16":
+        net.to(dtype="bfloat16")
+    opt = optimizer.Adam(learning_rate=1e-2, parameters=net.parameters())
+    return net, opt
+
+
+def _batches(n, din=6, dout=2, seed=0):
+    rng = np.random.default_rng(seed)
+    return [(rng.normal(size=(8, din)).astype(np.float32),
+             rng.normal(size=(8, dout)).astype(np.float32))
+            for _ in range(n)]
+
+
+def _step(net, opt, xy, dtype=np.float32):
+    x = paddle.to_tensor(xy[0].astype(dtype))
+    y = paddle.to_tensor(xy[1].astype(dtype))
+    loss = ((net(x) - y) ** 2).mean()
+    loss.backward()
+    opt.step()
+    opt.clear_grad()
+
+
+def _leaves(state, prefix=""):
+    """Flatten a training-state nest to {path: numpy-or-scalar}."""
+    out = {}
+    if hasattr(state, "numpy"):
+        out[prefix] = np.asarray(state.numpy())
+    elif isinstance(state, dict):
+        for k, v in state.items():
+            out.update(_leaves(v, f"{prefix}/{k}"))
+    elif isinstance(state, (list, tuple)):
+        for i, v in enumerate(state):
+            out.update(_leaves(v, f"{prefix}/{i}"))
+    else:
+        out[prefix] = state
+    return out
+
+
+def _assert_state_equal(a, b):
+    """a/b: training-state nests OR pre-flattened _leaves() dicts (the
+    latter for expectations snapshotted before further training mutates
+    the aliased capture_training_state nest)."""
+    la = a if isinstance(a, dict) and all(
+        not hasattr(v, "numpy") and not isinstance(v, dict)
+        for v in a.values()) else _leaves(a)
+    lb = b if isinstance(b, dict) and all(
+        not hasattr(v, "numpy") and not isinstance(v, dict)
+        for v in b.values()) else _leaves(b)
+    assert sorted(la) == sorted(lb)
+    for k in la:
+        va, vb = la[k], lb[k]
+        if isinstance(va, np.ndarray):
+            assert va.dtype == vb.dtype, k
+            np.testing.assert_array_equal(va, vb, err_msg=k)
+        else:
+            assert va == vb, k
+
+
+def _save_sharded(dir, state, step, world):
+    """Simulate a world-`world` job committing one checkpoint: each rank
+    writes its own shard + manifest into the same step directory."""
+    for r in range(world):
+        ckpt.save_checkpoint(str(dir), state, step=step, rank=r,
+                             world_size=world, shard=True)
+
+
+# ------------------------------------------------------------ merge parity --
+
+def test_reshard_4_to_1_bitwise(tmp_path):
+    net, opt = _mlp()
+    for xy in _batches(3):
+        _step(net, opt, xy)
+    state = ckpt.capture_training_state(net, opt)
+    _save_sharded(tmp_path, state, step=3, world=4)
+    merged, man = ckpt.load_resharded(str(tmp_path), world_size=1)
+    assert man["step"] == 3 and man["world_size"] == 4 and man["sharded"]
+    _assert_state_equal(state, merged)
+
+
+def test_reshard_1_to_4_full_state_everywhere(tmp_path):
+    """1→M: an unsharded world-1 checkpoint loads into every target rank
+    as the same full state (replicated-merge degenerate case)."""
+    net, opt = _mlp(seed=9)
+    for xy in _batches(2):
+        _step(net, opt, xy)
+    state = ckpt.capture_training_state(net, opt)
+    ckpt.save_checkpoint(str(tmp_path), state, step=2)
+    for r in range(4):
+        merged, man = ckpt.load_resharded(str(tmp_path), rank=r,
+                                          world_size=4)
+        assert man["step"] == 2
+        _assert_state_equal(state, merged)
+
+
+def test_reshard_4_to_6_nondivisible_and_empty_chunks(tmp_path):
+    """4→6 with params whose element counts don't divide by either world:
+    the [2]-element bias flattens to chunks [1,1,0,0] at world 4 and
+    [1,1,0,0,0,0] at world 6 — uneven AND empty last shards — and the
+    double merge/re-slice round trip stays bitwise."""
+    net, opt = _mlp()  # Linear(12,2) bias has 2 elements < both worlds
+    for xy in _batches(2):
+        _step(net, opt, xy)
+    state = ckpt.capture_training_state(net, opt)
+    _save_sharded(tmp_path / "w4", state, step=5, world=4)
+    merged4, man4 = ckpt.load_resharded(str(tmp_path / "w4"), world_size=6)
+    assert man4["world_size"] == 4
+    _assert_state_equal(state, merged4)
+    # the resized job re-slices on ITS next save: world 6, then merge back
+    _save_sharded(tmp_path / "w6", merged4, step=6, world=6)
+    merged6, man6 = ckpt.load_resharded(str(tmp_path / "w6"), world_size=1)
+    assert man6["world_size"] == 6
+    _assert_state_equal(state, merged6)
+
+
+def test_reshard_bf16_slots_roundtrip(tmp_path):
+    net, opt = _mlp(dtype="bfloat16")
+    for xy in _batches(3):
+        _step(net, opt, xy, dtype=np.asarray(
+            list(net.state_dict().values())[0].numpy()).dtype)
+    state = ckpt.capture_training_state(net, opt)
+    _save_sharded(tmp_path, state, step=3, world=3)
+    merged, _ = ckpt.load_resharded(str(tmp_path), world_size=1)
+    _assert_state_equal(state, merged)
+    net2, opt2 = _mlp(seed=77, dtype="bfloat16")
+    ckpt.restore_training_state(net2, opt2, merged)
+    for (k, a), (k2, b) in zip(net.state_dict().items(),
+                               net2.state_dict().items()):
+        assert k == k2
+        a, b = np.asarray(a.numpy()), np.asarray(b.numpy())
+        assert a.dtype == b.dtype and str(a.dtype) == "bfloat16"
+        np.testing.assert_array_equal(a, b)
+
+
+def test_reshard_skips_checkpoint_with_torn_shard(tmp_path):
+    """A checkpoint with ANY unreadable shard is skipped WHOLE — a
+    partial merge would silently lose parameters — and the previous
+    fully-valid one is used."""
+    net, opt = _mlp()
+    state = ckpt.capture_training_state(net, opt)
+    _save_sharded(tmp_path, state, step=1, world=2)
+    # capture_training_state ALIASES the live tensors: snapshot the
+    # expected step-1 values before training mutates them
+    expected = _leaves(state)
+    for xy in _batches(1):
+        _step(net, opt, xy)
+    state2 = ckpt.capture_training_state(net, opt)
+    ckpt.save_checkpoint(str(tmp_path), state2, step=2, rank=0,
+                         world_size=2, shard=True)
+    faults.configure("truncate_checkpoint:nth=1,bytes=9")
+    ckpt.save_checkpoint(str(tmp_path), state2, step=2, rank=1,
+                         world_size=2, shard=True)
+    faults.reset()
+    merged, man = ckpt.load_resharded(str(tmp_path), world_size=1)
+    assert man["step"] == 1, "checkpoint with torn shard was not skipped"
+    _assert_state_equal(expected, merged)
+
+
+# ------------------------------------------------------- structured refusal --
+
+def test_world_size_mismatch_is_structured_error(tmp_path):
+    net, opt = _mlp()
+    state = ckpt.capture_training_state(net, opt)
+    _save_sharded(tmp_path, state, step=1, world=4)
+    with pytest.raises(ckpt.WorldSizeMismatchError) as ei:
+        ckpt.load_latest(str(tmp_path))
+    err = ei.value
+    assert err.saved_world_size == 4 and err.world_size == 1
+    assert "load_resharded" in str(err) and "reshard=True" in str(err)
+    # manager + hook surfaces raise the same structured error
+    mgr = ckpt.CheckpointManager(str(tmp_path), world_size=1)
+    with pytest.raises(ckpt.WorldSizeMismatchError):
+        mgr.load_latest()
+    hook = ckpt.CheckpointHook(str(tmp_path), net, opt,
+                               install_sigterm=False)
+    with pytest.raises(ckpt.WorldSizeMismatchError):
+        hook.restore()
+    # ... and reshard=True on the same surfaces succeeds
+    merged, man = mgr.load_latest(reshard=True)
+    assert man["step"] == 1
+    _assert_state_equal(state, merged)
+
+
+def test_unsharded_world_mismatch_refused_when_checked(tmp_path):
+    net, opt = _mlp()
+    state = ckpt.capture_training_state(net, opt)
+    ckpt.save_checkpoint(str(tmp_path), state, step=1, rank=0,
+                         world_size=2)  # replicated save from a 2-rank job
+    with pytest.raises(ckpt.WorldSizeMismatchError) as ei:
+        ckpt.load_latest(str(tmp_path), world_size=4)
+    assert ei.value.saved_world_size == 2 and ei.value.world_size == 4
+    # an UN-checked module-level load keeps the historical behavior
+    state2, man = ckpt.load_latest(str(tmp_path))
+    assert man["step"] == 1
+
+
+def test_raw_shard_load_names_reshard_entrypoint(tmp_path):
+    """Even bypassing the manifest check (paddle.load straight on a shard
+    payload), the failure names load_resharded instead of a shape error."""
+    net, opt = _mlp()
+    _save_sharded(tmp_path, ckpt.capture_training_state(net, opt),
+                  step=1, world=2)
+    with pytest.raises(RuntimeError) as ei:
+        paddle.load(str(tmp_path / "ckpt-00000001" / "data-rank00000.pkl"))
+    assert "load_resharded" in str(ei.value)
+    assert "world-size-2" in str(ei.value)
+
+
+# ------------------------------------------------------------ resume parity --
+
+def test_reshard_resume_bitwise_vs_uninterrupted(tmp_path):
+    """The acceptance gate: train N steps, checkpoint sharded at world 4,
+    resume a FRESH differently-initialized job at world 1 via resharding,
+    finish the schedule — params AND slots bitwise-equal to the
+    uninterrupted run."""
+    batches = _batches(10)
+    net_a, opt_a = _mlp(seed=5)
+    for xy in batches:
+        _step(net_a, opt_a, xy)
+
+    net_b, opt_b = _mlp(seed=5)
+    for xy in batches[:6]:
+        _step(net_b, opt_b, xy)
+    _save_sharded(tmp_path, ckpt.capture_training_state(net_b, opt_b),
+                  step=5, world=4)
+
+    net_c, opt_c = _mlp(seed=77)  # different init: restore must win
+    hook = ckpt.CheckpointHook(str(tmp_path), net_c, opt_c, reshard=True,
+                               install_sigterm=False)
+    assert hook.restore() == 6
+    for xy in batches[6:]:
+        _step(net_c, opt_c, xy)
+
+    _assert_state_equal(ckpt.capture_training_state(net_a, opt_a),
+                        ckpt.capture_training_state(net_c, opt_c))
+
+
+def test_resume_after_reshard_keeps_captured_plans(tmp_path):
+    """Reshard-restore with matching avals is IN PLACE: the captured
+    whole-step executable keeps replaying — 0 new fallbacks."""
+    from paddle_tpu.core import lazy
+
+    net, opt = _mlp(seed=5)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.normal(size=(8, 6)).astype(np.float32))
+    y = paddle.to_tensor(rng.normal(size=(8, 2)).astype(np.float32))
+
+    def step():
+        with paddle.incubate.lazy_eval():
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return float(loss)  # forces the segment each step
+
+    for _ in range(12):
+        step()
+    s0 = lazy.stats()
+    assert s0["capture_promotions"] >= 1
+    _save_sharded(tmp_path, ckpt.capture_training_state(net, opt),
+                  step=12, world=4)
+    snap = {k: np.asarray(v.numpy()).copy()
+            for k, v in net.state_dict().items()}
+    for _ in range(3):
+        step()
+    state, _ = ckpt.load_resharded(str(tmp_path), world_size=1)
+    changed = ckpt.restore_training_state(net, opt, state)
+    assert changed == []
+    for k, v in net.state_dict().items():
+        np.testing.assert_array_equal(np.asarray(v.numpy()), snap[k])
+    for _ in range(5):
+        step()
+    s1 = lazy.stats()
+    assert s1["capture_fallbacks"] == s0["capture_fallbacks"]
+    assert s1["captured_steps"] > s0["captured_steps"]

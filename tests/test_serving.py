@@ -368,3 +368,280 @@ class TestLegacyCachePath:
                       caches=caches)
             assert not [w for w in rec2
                         if "serving.GenerationEngine" in str(w.message)]
+
+
+# =========================================================================
+# Train→serve resilience loop (ISSUE 7): drain-free weight hot-swap,
+# transient-step retry, checkpoint watcher, elastic replica supervision.
+# =========================================================================
+
+def _greedy_straightline(model, prompt, n):
+    """Ground-truth greedy continuation via the full forward path."""
+    ids = list(prompt)
+    out = []
+    with paddle.no_grad():
+        for _ in range(n):
+            logits = model(paddle.to_tensor(np.asarray([ids], np.int64)))
+            t = int(np.asarray(logits.numpy())[0, -1].argmax())
+            out.append(t)
+            ids.append(t)
+    return out
+
+
+def _np_state(model):
+    """gpt-level state dict as plain numpy (a frozen weight snapshot —
+    engines alias live tensors, so tests swap from copies)."""
+    return {k: np.asarray(v.numpy()).copy()
+            for k, v in model.gpt.state_dict().items()}
+
+
+class TestWeightHotSwap:
+    @pytest.fixture(autouse=True)
+    def _disarm(self):
+        yield
+        from paddle_tpu.testing import faults
+        faults.reset()
+
+    @pytest.fixture(scope="class")
+    def swap_rig(self):
+        m_a = _build_model(seed=21)
+        m_b = _build_model(seed=22)  # same arch, different weights
+        a_sd, b_sd = _np_state(m_a), _np_state(m_b)
+        srv = GenerationServer(m_a, max_batch_size=3, buckets=(8, 16),
+                               max_queue_size=32)
+        srv.start()
+        prompt = list(np.random.default_rng(7).integers(1, VOCAB, 5))
+        exp_a = _greedy_straightline(m_a, prompt, 6)
+        exp_b = _greedy_straightline(m_b, prompt, 6)
+        assert exp_a != exp_b  # the swap must be observable
+        yield srv, prompt, a_sd, b_sd, exp_a, exp_b
+        srv.shutdown(timeout=30)
+
+    def _install(self, srv, sd):
+        """Put the rig in a known weight state through the swap path."""
+        srv.swap_weights(sd, source="test-install")
+        srv.generate([1, 2, 3], max_new_tokens=1)  # drives a step boundary
+
+    def test_mid_flight_swap_zero_failed_zero_recompiles(self, swap_rig):
+        srv, prompt, a_sd, b_sd, exp_a, exp_b = swap_rig
+        self._install(srv, a_sd)
+        assert srv.generate(prompt, max_new_tokens=6) == exp_a
+        c0 = dict(registry.counters("serving"))
+        reqs = [srv.submit(list(np.random.default_rng(i).integers(
+                    1, VOCAB, 5)), max_new_tokens=20) for i in range(4)]
+        time.sleep(0.03)  # requests are mid-decode now
+        # swap from the WRAPPER model's prefixed state dict ("gpt.<name>")
+        srv.swap_weights({f"gpt.{k}": v for k, v in b_sd.items()},
+                         source="unit-test")
+        for r in reqs:
+            assert r.result(120).status == RequestStatus.DONE
+        c1 = dict(registry.counters("serving"))
+        assert c1["weight_swaps"] == c0["weight_swaps"] + 1
+        assert c1["swap_failures"] == c0["swap_failures"]
+        assert c1["requests_failed"] == c0["requests_failed"]
+        assert c1["decode_compiles"] == c0["decode_compiles"]
+        # the new weights actually serve: post-swap greedy == model-B truth
+        assert srv.generate(prompt, max_new_tokens=6) == exp_b
+        c2 = registry.counters("serving")
+        assert c2["decode_compiles"] == c0["decode_compiles"]
+        assert c2["prefill_compiles"] == c0["prefill_compiles"]
+
+    def test_swap_refuses_aval_and_name_mismatch(self, swap_rig):
+        srv, prompt, a_sd, b_sd, exp_a, exp_b = swap_rig
+        from paddle_tpu.serving import WeightSwapError
+
+        self._install(srv, a_sd)
+        eng = srv.engine
+        with pytest.raises(WeightSwapError, match="missing"):
+            eng.swap_weights({k: b_sd[k] for k in list(b_sd)[:3]})
+        bad = dict(b_sd)
+        name = next(k for k in bad if bad[k].ndim == 2)
+        bad[name] = bad[name][:-1]  # truncated: a different model
+        with pytest.raises(WeightSwapError, match="aval mismatch"):
+            eng.swap_weights(bad)
+        # staged through the server: refusal is counted, old weights serve
+        c0 = dict(registry.counters("serving"))
+        srv.swap_weights(bad, source="bad-swap")
+        assert srv.generate(prompt, max_new_tokens=6) == exp_a
+        c1 = dict(registry.counters("serving"))
+        assert c1["swap_failures"] == c0["swap_failures"] + 1
+        assert c1["weight_swaps"] == c0["weight_swaps"]
+        assert isinstance(srv.scheduler.last_swap_error, WeightSwapError)
+
+    def test_kill_during_swap_leaves_server_healthy(self, swap_rig):
+        srv, prompt, a_sd, b_sd, exp_a, exp_b = swap_rig
+        from paddle_tpu.testing import faults
+
+        self._install(srv, a_sd)
+        c0 = dict(registry.counters("serving"))
+        faults.configure("kill_during_swap")
+        srv.swap_weights(b_sd, source="doomed-swap")
+        # the swap dies between validation and commit; requests keep
+        # flowing on the COMPLETE pre-swap weights
+        assert srv.generate(prompt, max_new_tokens=6) == exp_a
+        faults.reset()
+        c1 = dict(registry.counters("serving"))
+        assert c1["swap_failures"] == c0["swap_failures"] + 1
+        assert c1["weight_swaps"] == c0["weight_swaps"]
+        assert c1["requests_failed"] == c0["requests_failed"]
+        assert registry.counters("fault").get(
+            "injected.kill_during_swap", 0) >= 1
+
+    def test_watcher_follows_checkpoints_skips_torn_merges_shards(
+            self, swap_rig, tmp_path):
+        srv, prompt, a_sd, b_sd, exp_a, exp_b = swap_rig
+        from paddle_tpu.incubate import checkpoint as ckpt
+        from paddle_tpu.testing import faults
+
+        self._install(srv, a_sd)
+        srv.last_swap_step = -1
+        srv.watch_checkpoints(str(tmp_path), interval=0.05)
+        try:
+            # (1) a fresh training checkpoint lands -> serving follows
+            ckpt.save_checkpoint(str(tmp_path), {"model": b_sd}, step=1)
+            deadline = time.monotonic() + 20
+            while srv.last_swap_step < 1 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert srv.last_swap_step == 1
+            assert srv.generate(prompt, max_new_tokens=6) == exp_b
+            # (2) torn checkpoint under the watcher: skipped, no crash,
+            # no swap, server keeps serving
+            faults.configure("truncate_checkpoint:nth=1,bytes=7")
+            ckpt.save_checkpoint(str(tmp_path), {"model": a_sd}, step=2)
+            faults.reset()
+            time.sleep(0.3)
+            assert srv.last_swap_step == 1
+            assert srv.generate(prompt, max_new_tokens=6) == exp_b
+            # (3) a SHARDED world-2 checkpoint merges through the manifest
+            for r in range(2):
+                ckpt.save_checkpoint(str(tmp_path), {"model": a_sd},
+                                     step=3, rank=r, world_size=2,
+                                     shard=True)
+            deadline = time.monotonic() + 20
+            while srv.last_swap_step < 3 and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert srv.last_swap_step == 3
+            assert srv.generate(prompt, max_new_tokens=6) == exp_a
+        finally:
+            srv.stop_watcher()
+
+
+class TestStepRetry:
+    @pytest.fixture(autouse=True)
+    def _disarm(self):
+        yield
+        from paddle_tpu.testing import faults
+        faults.reset()
+
+    def test_transient_decode_error_retries_once(self, server):
+        from paddle_tpu.testing import faults
+
+        prompt = [3, 5, 7]
+        want = server.generate(prompt, max_new_tokens=4)  # pre-fault truth
+        c0 = dict(registry.counters("serving"))
+        faults.configure("decode_error:fails=1")
+        got = server.generate(prompt, max_new_tokens=4)
+        faults.reset()
+        assert got == want  # retried step produced the same tokens
+        c1 = dict(registry.counters("serving"))
+        assert c1["step_retries"] == c0["step_retries"] + 1
+        assert c1["reprimes"] == c0["reprimes"] + 1
+        assert c1["requests_failed"] == c0["requests_failed"]
+        assert len(explainer.events(kind="serving_step_retry")) >= 1
+
+    def test_second_consecutive_error_fails_batch_then_recovers(
+            self, server):
+        from paddle_tpu.testing import faults
+
+        c0 = dict(registry.counters("serving"))
+        faults.configure("decode_error:fails=2")
+        req = server.submit([2, 4, 6], max_new_tokens=4)
+        req.result(60)
+        assert req.status == RequestStatus.ERROR
+        assert "decode failure" in req.error
+        c1 = dict(registry.counters("serving"))
+        assert c1["step_retries"] == c0["step_retries"] + 1
+        assert c1["requests_failed"] == c0["requests_failed"] + 1
+        # the injected budget is exhausted: the server recovered and the
+        # next request sails through
+        got = server.generate([2, 4, 6], max_new_tokens=4)
+        faults.reset()
+        assert len(got) == 4
+
+
+class _SlowFakeEngine(_FakeEngine):
+    """Fake engine whose decode is slow enough to pile up a queue (drives
+    the supervisor's scale-up) and which honors reset()."""
+
+    def decode_step(self):
+        time.sleep(0.03)
+        return super().decode_step()
+
+    def reset(self):
+        for i in range(self.max_batch_size):
+            self.release(i)
+
+
+class TestReplicaSupervision:
+    @pytest.fixture(autouse=True)
+    def _disarm(self):
+        yield
+        from paddle_tpu.testing import faults
+        faults.reset()
+
+    def test_replica_kill_restarts_and_replays_bitwise(self):
+        from paddle_tpu.serving import GenerationEngine, ReplicaSupervisor
+        from paddle_tpu.testing import faults
+
+        model = _build_model(seed=31)
+        factory = lambda: GenerationEngine(  # noqa: E731
+            model, max_batch_size=2, buckets=(8,), rng_seed=7)
+        rng = np.random.default_rng(11)
+        prompts = [list(rng.integers(1, VOCAB, 5)) for _ in range(3)]
+        opts = dict(max_new_tokens=6, temperature=0.8)
+
+        sup = ReplicaSupervisor(factory, replicas=1, restart_backoff=0.05,
+                                monitor_interval=0.02)
+        expected = [sup.submit(p, **opts) for p in prompts]
+        expected = [list(r.result(120).tokens) for r in expected]
+        sup.shutdown()
+
+        c0 = dict(registry.counters("serving"))
+        faults.configure("replica_kill:nth=4")
+        sup2 = ReplicaSupervisor(factory, replicas=1, restart_backoff=0.05,
+                                 monitor_interval=0.02)
+        reqs = [sup2.submit(p, **opts) for p in prompts]
+        got = [list(r.result(180).tokens) for r in reqs]
+        faults.reset()
+        c1 = dict(registry.counters("serving"))
+        sup2.shutdown()
+        # the replica died mid-flight, was restarted, and REPLAYED its
+        # requests: same seeds + same engine rng_seed -> bitwise tokens
+        assert got == expected
+        assert all(r.status == RequestStatus.DONE for r in reqs)
+        assert c1["replica_restarts"] == c0["replica_restarts"] + 1
+        assert c1["requeued_requests"] > c0["requeued_requests"]
+
+    def test_autoscale_up_on_queue_depth_then_down_when_idle(self):
+        from paddle_tpu.serving import ReplicaSupervisor
+
+        sup = ReplicaSupervisor(
+            lambda: _SlowFakeEngine(max_batch_size=1), replicas=1,
+            max_replicas=3, min_replicas=1, scale_up_queue_depth=2,
+            scale_interval=0.05, monitor_interval=0.02, max_queue_size=64)
+        c0 = dict(registry.counters("serving"))
+        reqs = [sup.submit([1, 2], max_new_tokens=3) for _ in range(10)]
+        deadline = time.monotonic() + 10
+        while sup.replicas() < 2 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sup.replicas() >= 2, "queue depth never triggered scale-up"
+        for r in reqs:
+            assert r.result(60).status == RequestStatus.DONE
+        deadline = time.monotonic() + 10
+        while sup.replicas() > 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert sup.replicas() == 1, "idle fleet never scaled back down"
+        c1 = dict(registry.counters("serving"))
+        assert c1["scale_ups"] >= c0["scale_ups"] + 1
+        assert c1["scale_downs"] >= c0["scale_downs"] + 1
+        sup.shutdown()

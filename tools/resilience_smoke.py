@@ -1,0 +1,377 @@
+#!/usr/bin/env python
+"""Run the train→serve resilience fault-injection matrix end to end.
+
+One subprocess per scenario (a fault that kills a worker must not kill
+the runner), each arming `paddle_tpu.testing.faults` and asserting the
+recovery contract from ISSUE 7:
+
+    kill          training killed at a step (SIGKILL-style, rc 137)
+                  resumes from the newest checkpoint BITWISE equal to the
+                  uninterrupted run
+    torn          a torn checkpoint landing under the serving watcher is
+                  skipped — no crash, no swap, serving continues; the
+                  next valid checkpoint swaps in
+    swap          a crash between swap validation and commit leaves the
+                  server healthy on the complete PRE-swap weights; the
+                  retried swap lands
+    replica-kill  a fatally-dying serving replica restarts with backoff
+                  and REPLAYS its in-flight requests (idempotent by seed:
+                  same tokens), zero failed requests
+    slow-decode   decode-step latency injection: requests still complete,
+                  zero failed, zero retries burned
+    decode-error  one transient decode failure re-primes the executable
+                  and retries once — the request finishes with the same
+                  tokens, nothing fails
+
+The RUNNER is pure stdlib (no paddle_tpu/jax import in this process) so
+CI can invoke it anywhere; the scenarios import paddle_tpu in their child
+processes on JAX_PLATFORMS=cpu.
+
+Usage:
+    python tools/resilience_smoke.py              # full matrix
+    python tools/resilience_smoke.py --only swap,torn
+    python tools/resilience_smoke.py --list
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+# Every serving scenario builds this rig: a tiny GPT pair (same arch,
+# different weights, so a swap is observable in greedy tokens) plus the
+# ground-truth straight-line greedy decoder the engines must match.
+_SERVE_PRELUDE = r"""
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTForPretraining, GPTModel
+from paddle_tpu.profiler import registry
+from paddle_tpu.testing import faults
+
+VOCAB = 96
+
+def build(seed):
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=VOCAB, n_layer=2, n_head=2, d_model=48,
+                    seq_len=64, initializer_range=0.35)
+    return GPTForPretraining(GPTModel(cfg))
+
+def np_state(model):
+    return {k: np.asarray(v.numpy()).copy()
+            for k, v in model.gpt.state_dict().items()}
+
+def greedy(model, prompt, n):
+    ids, out = list(prompt), []
+    with paddle.no_grad():
+        for _ in range(n):
+            logits = model(paddle.to_tensor(np.asarray([ids], np.int64)))
+            t = int(np.asarray(logits.numpy())[0, -1].argmax())
+            out.append(t)
+            ids.append(t)
+    return out
+"""
+
+# The kill scenario shares one deterministic "training" program across its
+# three child runs (reference / killed / resumed): a fixed-seed numpy SGD
+# loop checkpointed through the real CheckpointManager, so resume parity
+# exercises the production save/restore path without a model build.
+_TRAIN_PRELUDE = r"""
+import os, sys
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.incubate import checkpoint as ckpt
+from paddle_tpu.testing import faults
+
+STEPS, SAVE_EVERY = 10, 2
+
+ckpt_dir = sys.argv[1]
+kill_at = None if sys.argv[2] == "None" else int(sys.argv[2])
+if kill_at is not None:
+    faults.configure("kill_at_step:step=" + str(kill_at))
+paddle.seed(5)
+w = paddle.to_tensor(np.linspace(-1.0, 1.0, 8, dtype=np.float32))
+mgr = ckpt.CheckpointManager(ckpt_dir, async_save=False)
+state, man = mgr.load_latest()
+start = 0
+if state is not None:
+    w.set_value(state["w"])
+    start = int(man["step"]) + 1
+for step in range(start, STEPS):
+    if faults.ACTIVE:
+        faults.fire("kill_at_step", step=step)
+    g = 0.1 * w + paddle.to_tensor(
+        np.full(8, 0.01 * (step + 1), np.float32))
+    w.set_value(w - paddle.to_tensor(np.float32(0.05)) * g)
+    if step % SAVE_EVERY == 0:
+        mgr.save({"w": w}, step=step)
+mgr.wait()
+print("FINAL", np.asarray(w.numpy()).tobytes().hex())
+"""
+
+SCENARIOS = {}
+
+
+def scenario(name, desc):
+    def deco(fn):
+        SCENARIOS[name] = (desc, fn)
+        return fn
+    return deco
+
+
+def _run_child(code, timeout, expect_rc=0, argv=()):
+    """One scenario subprocess → (ok, detail, stdout)."""
+    full_env = dict(os.environ)
+    full_env.setdefault("JAX_PLATFORMS", "cpu")
+    try:
+        proc = subprocess.run([sys.executable, "-c", code, *argv],
+                              capture_output=True, text=True,
+                              timeout=timeout, env=full_env)
+    except subprocess.TimeoutExpired:
+        return False, f"timeout after {timeout}s", ""
+    if proc.returncode != expect_rc:
+        tail = (proc.stderr or proc.stdout).strip().splitlines()[-8:]
+        return False, (f"rc {proc.returncode} (wanted {expect_rc}): "
+                       + " | ".join(tail)), proc.stdout
+    return True, "", proc.stdout
+
+
+@scenario("kill", "kill-at-step training resumes bitwise from checkpoint")
+def _kill(timeout):
+    with tempfile.TemporaryDirectory() as d:
+        ck, ref = os.path.join(d, "ck"), os.path.join(d, "ref")
+        ok, why, out = _run_child(_TRAIN_PRELUDE, timeout,
+                                  argv=(ref, "None"))
+        if not ok:
+            return False, f"reference run: {why}"
+        want = [ln for ln in out.splitlines() if ln.startswith("FINAL")]
+        # the killed run dies like a preempted worker: rc 137, no output
+        ok, why, _ = _run_child(_TRAIN_PRELUDE, timeout, expect_rc=137,
+                                argv=(ck, "7"))
+        if not ok:
+            return False, f"killed run: {why}"
+        ok, why, out = _run_child(_TRAIN_PRELUDE, timeout,
+                                  argv=(ck, "None"))
+        if not ok:
+            return False, f"resumed run: {why}"
+        got = [ln for ln in out.splitlines() if ln.startswith("FINAL")]
+        if not want or got != want:
+            return False, f"resume not bitwise: {got} != {want}"
+        return True, "resume bitwise-equal after rc-137 kill at step 7"
+
+
+@scenario("torn", "torn checkpoint under the watcher is skipped, "
+                  "next valid one swaps in")
+def _torn(timeout):
+    code = _SERVE_PRELUDE + r"""
+import tempfile, time
+from paddle_tpu.incubate import checkpoint as ckpt
+from paddle_tpu.serving import GenerationServer
+
+m_a, m_b = build(21), build(22)
+a_sd, b_sd = np_state(m_a), np_state(m_b)
+prompt = list(np.random.default_rng(7).integers(1, VOCAB, 5))
+exp_a, exp_b = greedy(m_a, prompt, 6), greedy(m_b, prompt, 6)
+assert exp_a != exp_b
+srv = GenerationServer(m_a, max_batch_size=2, buckets=(8,)).start()
+with tempfile.TemporaryDirectory() as d:
+    srv.watch_checkpoints(d, interval=0.05)
+    ckpt.save_checkpoint(d, {"model": b_sd}, step=1)
+    deadline = time.monotonic() + 60
+    while srv.last_swap_step < 1 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert srv.last_swap_step == 1, "valid checkpoint never swapped in"
+    assert srv.generate(prompt, max_new_tokens=6) == exp_b
+    faults.configure("truncate_checkpoint:nth=1,bytes=7")
+    ckpt.save_checkpoint(d, {"model": a_sd}, step=2)
+    faults.reset()
+    time.sleep(0.5)
+    assert srv.last_swap_step == 1, "torn checkpoint must not swap"
+    assert srv.generate(prompt, max_new_tokens=6) == exp_b, \
+        "server unhealthy after torn checkpoint"
+    ckpt.save_checkpoint(d, {"model": a_sd}, step=3)
+    deadline = time.monotonic() + 60
+    while srv.last_swap_step < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert srv.last_swap_step == 3, "post-torn valid ckpt never swapped"
+    assert srv.generate(prompt, max_new_tokens=6) == exp_a
+srv.shutdown(timeout=30)
+print("TORN-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "TORN-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or "torn ckpt skipped; serving followed the next valid one"
+
+
+@scenario("swap", "kill-during-swap leaves the server healthy on "
+                  "pre-swap weights")
+def _swap(timeout):
+    code = _SERVE_PRELUDE + r"""
+from paddle_tpu.serving import GenerationServer
+
+m_a, m_b = build(21), build(22)
+b_sd = np_state(m_b)
+prompt = list(np.random.default_rng(7).integers(1, VOCAB, 5))
+exp_a, exp_b = greedy(m_a, prompt, 6), greedy(m_b, prompt, 6)
+assert exp_a != exp_b
+srv = GenerationServer(m_a, max_batch_size=2, buckets=(8,)).start()
+faults.configure("kill_during_swap")
+reqs = [srv.submit(prompt, max_new_tokens=6) for _ in range(2)]
+srv.swap_weights(b_sd, source="smoke")
+for r in reqs:
+    r.result(120)
+faults.reset()
+assert all(r.status == "done" for r in reqs), \
+    [r.status for r in reqs]
+assert registry.counters("serving")["swap_failures"] >= 1
+assert srv.scheduler.last_swap_error is not None
+# healthy on the COMPLETE pre-swap weights
+assert srv.generate(prompt, max_new_tokens=6) == exp_a, \
+    "post-crash tokens drifted: partial swap leaked"
+# disarmed retry lands
+srv.swap_weights(b_sd, source="smoke-retry")
+assert srv.generate(prompt, max_new_tokens=6) == exp_b
+srv.shutdown(timeout=30)
+print("SWAP-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "SWAP-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or "crashed swap refused atomically; retry landed"
+
+
+@scenario("replica-kill", "dead replica restarts and replays its "
+                          "requests bitwise, zero failed")
+def _replica_kill(timeout):
+    code = _SERVE_PRELUDE + r"""
+from paddle_tpu.serving import GenerationEngine, ReplicaSupervisor
+
+model = build(31)
+factory = lambda: GenerationEngine(model, max_batch_size=2, buckets=(8,),
+                                   rng_seed=7)
+rng = np.random.default_rng(11)
+prompts = [list(rng.integers(1, VOCAB, 5)) for _ in range(3)]
+opts = dict(max_new_tokens=6, temperature=0.8)
+
+sup = ReplicaSupervisor(factory, replicas=1, restart_backoff=0.05,
+                        monitor_interval=0.02)
+want = [list(sup.submit(p, **opts).result(120).tokens) for p in prompts]
+sup.shutdown()
+
+faults.configure("replica_kill:nth=4")
+sup = ReplicaSupervisor(factory, replicas=1, restart_backoff=0.05,
+                        monitor_interval=0.02)
+reqs = [sup.submit(p, **opts) for p in prompts]
+got = [list(r.result(180).tokens) for r in reqs]
+faults.reset()
+sup.shutdown()
+assert all(r.status == "done" for r in reqs), [r.status for r in reqs]
+assert got == want, "replayed tokens not bitwise-identical"
+assert registry.counters("serving")["replica_restarts"] >= 1
+print("REPLICA-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "REPLICA-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or "restart + bitwise replay, zero failed requests"
+
+
+@scenario("slow-decode", "decode latency injection: requests complete, "
+                         "zero failed")
+def _slow_decode(timeout):
+    code = _SERVE_PRELUDE + r"""
+from paddle_tpu.serving import GenerationServer
+
+srv = GenerationServer(build(21), max_batch_size=2, buckets=(8,)).start()
+faults.configure("slow_decode:delay=0.02,steps=8")
+reqs = [srv.submit([3, 5, 7], max_new_tokens=6) for _ in range(3)]
+for r in reqs:
+    r.result(120)
+faults.reset()
+assert all(r.status == "done" for r in reqs), [r.status for r in reqs]
+c = registry.counters("serving")
+assert c["requests_failed"] == 0 and c["step_retries"] == 0
+srv.shutdown(timeout=30)
+print("SLOW-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "SLOW-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or "slow decode absorbed; zero failed, zero retries"
+
+
+@scenario("decode-error", "one transient decode error re-primes and "
+                          "retries; same tokens, nothing fails")
+def _decode_error(timeout):
+    code = _SERVE_PRELUDE + r"""
+from paddle_tpu.serving import GenerationServer
+
+srv = GenerationServer(build(21), max_batch_size=2, buckets=(8,)).start()
+want = srv.generate([3, 5, 7], max_new_tokens=4)
+faults.configure("decode_error:fails=1")
+got = srv.generate([3, 5, 7], max_new_tokens=4)
+faults.reset()
+assert got == want, "retried step changed the tokens"
+c = registry.counters("serving")
+assert c["step_retries"] == 1 and c["reprimes"] == 1
+assert c["requests_failed"] == 0
+srv.shutdown(timeout=30)
+print("RETRY-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "RETRY-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or "single retry recovered; tokens unchanged"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--only", help="comma-separated scenario subset")
+    ap.add_argument("--list", action="store_true",
+                    help="list scenarios and exit")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="per-child timeout in seconds (default 300)")
+    args = ap.parse_args(argv)
+    if args.list:
+        for name, (desc, _) in SCENARIOS.items():
+            print(f"{name:<14} {desc}")
+        return 0
+    names = list(SCENARIOS)
+    if args.only:
+        names = [n.strip() for n in args.only.split(",") if n.strip()]
+        unknown = [n for n in names if n not in SCENARIOS]
+        if unknown:
+            print(f"unknown scenario(s): {', '.join(unknown)} "
+                  f"(have: {', '.join(SCENARIOS)})", file=sys.stderr)
+            return 2
+    results = []
+    for name in names:
+        desc, fn = SCENARIOS[name]
+        t0 = time.monotonic()
+        print(f"[{name}] {desc} ...", flush=True)
+        try:
+            ok, detail = fn(args.timeout)
+        except Exception as e:  # a scenario driver bug is a failure
+            ok, detail = False, f"driver error: {type(e).__name__}: {e}"
+        results.append((name, ok, detail, time.monotonic() - t0))
+    width = max(max(len(n) for n, *_ in results), len("scenario"))
+    print()
+    print(f"{'scenario':<{width}}  {'result':<6}  {'secs':>6}  detail")
+    print("-" * (width + 70))
+    failed = 0
+    for name, ok, detail, dt in results:
+        failed += 0 if ok else 1
+        print(f"{name:<{width}}  {'PASS' if ok else 'FAIL':<6}  "
+              f"{dt:>6.1f}  {detail}")
+    print(f"\n{len(results) - failed}/{len(results)} scenarios passed")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

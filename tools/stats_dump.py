@@ -55,6 +55,18 @@ def _print_timings(timings, indent="  "):
 _FT_PREFIXES = ("checkpoint.", "fault.")
 _SERVING_PREFIXES = ("serving.",)
 _SPMD_PREFIXES = ("spmd.",)
+# the train→serve resilience loop (ISSUE 7) cuts across the serving,
+# checkpoint and fault scopes; its counters get one section so an operator
+# can read the whole loop's health (reshard → hot-swap → replica replay /
+# autoscale) at a glance instead of stitching three tables
+_TRAIN_SERVE_KEYS = frozenset((
+    "checkpoint.sharded_saves", "checkpoint.reshard_loads",
+    "serving.weight_swaps", "serving.swap_failures",
+    "serving.reprimes", "serving.step_retries",
+    "serving.requeued_requests", "serving.replica_restarts",
+    "serving.replicas_retired", "serving.scale_ups",
+    "serving.scale_downs", "serving.replicas",
+    "fault.elastic.generation_bumps"))
 
 
 def _print_snapshot(snap):
@@ -70,6 +82,17 @@ def _print_snapshot(snap):
     if sp_counters:
         print("sharding (spmd):")
         _print_counters(sp_counters)
+    # train→serve loop (ISSUE 7) before the per-subsystem sections: these
+    # keys are claimed here so serving/fault-tolerance below show pure
+    # steady-state health and this section shows pure resilience events
+    ts_counters = {k: counters.pop(k) for k in list(counters)
+                   if k in _TRAIN_SERVE_KEYS}
+    ts_gauges = {k: gauges.pop(k) for k in list(gauges)
+                 if k in _TRAIN_SERVE_KEYS}
+    if ts_counters or ts_gauges:
+        print("train->serve loop:")
+        _print_counters(ts_counters)
+        _print_counters(ts_gauges)
     # serving telemetry (ISSUE 5) first: TTFT / tokens-per-sec / occupancy
     # are the operator's serving health triple, pulled out of the general
     # tables (counters, timings AND the throughput/occupancy gauges)
