@@ -183,9 +183,9 @@ def run(preset, batch, seq_len, steps=8, warmup=3, dtype="bfloat16",
 
 
 def _run_ratio_child():
-    """--ratio mode: lazy-eager (step-capture) vs TrainStep on the CPU
-    MLP microbench (the TPU_VALIDATION.md shape: 3-layer MLP, bs64,
-    AdamW). Emits one JSON line:
+    """--ratio mode: lazy-eager (zero-dispatch replay) vs TrainStep on
+    the CPU MLP microbench (the TPU_VALIDATION.md shape: 3-layer MLP,
+    bs64, AdamW). Emits one JSON line:
       {"metric": "lazy/trainstep step-time ratio", ...}
     Methodology: the host this runs on is noisy (absolute ms drift 2-3x
     between runs), so the two loops are INTERLEAVED in small adjacent
@@ -194,9 +194,15 @@ def _run_ratio_child():
     window, so machine-wide drift cancels per pair, and the median
     rejects the rounds where a noise spike lands inside exactly one leg
     (a min-of-rounds estimator was observed swinging 1.3x-2.0x run to
-    run on identical code). Both loops read float(loss) every step (the
-    plain-eager-loop contract being benchmarked). vs_baseline is
-    2.0/ratio: the ISSUE-2 acceptance gate is ratio <= 2.0."""
+    run on identical code). Per-step host times additionally report
+    p50/p99 (ISSUE 9: jitter must not hide behind the gate average).
+    Both loops read float(loss) every step (the plain-eager-loop
+    contract being benchmarked). The lazy leg runs through
+    lazy.ReplayStep — the ISSUE-9 replay-by-signature fast path — and
+    the record carries its proof obligations:
+    fastpath_ops_dispatched_per_step == 0 and fastpath_hit_rate >= 0.9
+    over the measured window. vs_baseline is 1.3/ratio: the ISSUE-9
+    acceptance gate tightened the ISSUE-2 gate from 2.0 to 1.3."""
     os.environ["JAX_PLATFORMS"] = "cpu"
     import statistics
     import time as _t
@@ -204,6 +210,7 @@ def _run_ratio_child():
     import paddle_tpu as paddle
     from paddle_tpu import nn, optimizer
     from paddle_tpu.core import lazy
+    from paddle_tpu.profiler import registry as _reg
 
     def make(seed=7):
         paddle.seed(seed)
@@ -220,13 +227,18 @@ def _run_ratio_child():
 
     net, opt = make()
 
-    def lazy_step():
+    def lazy_body():
         with paddle.incubate.lazy_eval():
             loss = ((net(xt) - yt) ** 2).mean()
             loss.backward()
             opt.step()
             opt.clear_grad()
-            return float(loss)
+            return loss
+
+    replay = lazy.ReplayStep(lazy_body, optimizers=opt)
+
+    def lazy_step():
+        return float(replay())
 
     net2, opt2 = make()
 
@@ -271,39 +283,69 @@ def _run_ratio_child():
             manager.save(capture_training_state(network, optim),
                          step=ckpt_step[leg])
 
-    for _ in range(25):  # warmup: records, promotes, compiles, donates
-        lazy_step()
+    for _ in range(25):  # warmup: records, promotes, donates, ARMS the
+        lazy_step()      # zero-dispatch replay fast path
     for _ in range(5):
         float(train(xt, yt))
     s0 = lazy.stats()
+    f0 = dict(_reg.counters("fastpath"))
     lz, ts = [], []
+    lz_steps, ts_steps = [], []  # per-step host times (p50/p99 report)
     for _ in range(20):
         t0 = _t.perf_counter()
         for _ in range(10):
+            t1 = _t.perf_counter()
             lazy_step()
+            lz_steps.append(_t.perf_counter() - t1)
             maybe_ckpt(0, mgr, net, opt)
         lz.append((_t.perf_counter() - t0) / 10 * 1e3)
         t0 = _t.perf_counter()
         for _ in range(10):
+            t1 = _t.perf_counter()
             float(train(xt, yt))
+            ts_steps.append(_t.perf_counter() - t1)
             maybe_ckpt(1, mgr2, net2, opt2)
         ts.append((_t.perf_counter() - t0) / 10 * 1e3)
     s1 = lazy.stats()
+    f1 = dict(_reg.counters("fastpath"))
     if mgr is not None:
         mgr.wait()
         mgr2.wait()
         shutil.rmtree(ckpt_root, ignore_errors=True)
     ratio = statistics.median(a / b for a, b in zip(lz, ts))
+
+    def _pct(xs, q):
+        ys = sorted(xs)
+        return ys[min(len(ys) - 1, int(round(q * (len(ys) - 1))))] * 1e3
+
+    fp_calls = (f1["hits"] - f0["hits"]) + (f1["misses"] - f0["misses"])
+    fp_hit_rate = (f1["hits"] - f0["hits"]) / fp_calls if fp_calls else 0.0
     rec = {
         "metric": "lazy/trainstep step-time ratio (MLP microbench, CPU)",
         "value": round(ratio, 3),
         "unit": "x",
-        "vs_baseline": round(2.0 / ratio, 4),
+        "vs_baseline": round(1.3 / ratio, 4),
+        "gate": 1.3,
         "lazy_ms": round(min(lz), 3),
         "trainstep_ms": round(min(ts), 3),
         "ratio_of_mins": round(min(lz) / min(ts), 3),
+        # per-step host-time spread: jitter can't hide behind the mean
+        "lazy_step_p50_ms": round(_pct(lz_steps, 0.50), 3),
+        "lazy_step_p99_ms": round(_pct(lz_steps, 0.99), 3),
+        "trainstep_p50_ms": round(_pct(ts_steps, 0.50), 3),
+        "trainstep_p99_ms": round(_pct(ts_steps, 0.99), 3),
         "captured_steps": s1["captured_steps"] - s0["captured_steps"],
         "donated_steps": s1["donated_steps"] - s0["donated_steps"],
+        # ISSUE-9 proof obligations over the measured window: zero per-op
+        # Python on replayed steps, fast-path hit rate >= 0.9. The
+        # window SUM (replay_ops_dispatched delta) is the real proof —
+        # the per-step value is last-write-wins and a clean final step
+        # could mask a mid-window leak.
+        "fastpath_hit_rate": round(fp_hit_rate, 4),
+        "fastpath_ops_dispatched_per_step":
+            f1["replay_ops_dispatched"] - f0["replay_ops_dispatched"],
+        "fastpath_audit_runs": f1["audit_runs"] - f0["audit_runs"],
+        "fastpath_demotions": f1["demotions"] - f0["demotions"],
         "ckpt_interval": CKPT_EVERY if ckpt_on else 0,
         "platform": "cpu",
     }
@@ -513,6 +555,7 @@ def _run_serve_child():
     paddle.seed(0)
 
     c0 = dict(_reg.counters("serving"))
+    f0 = dict(_reg.counters("fastpath"))
     reqs = []
     t0 = _t.perf_counter()
     for i in range(12):
@@ -528,6 +571,7 @@ def _run_serve_child():
         r.result(timeout=300)
     dt = _t.perf_counter() - t0
     c1 = dict(_reg.counters("serving"))
+    f1 = dict(_reg.counters("fastpath"))
     swap_count = server.scheduler.swap_count
     swap_err = server.scheduler.last_swap_error
     server.shutdown()
@@ -559,6 +603,17 @@ def _run_serve_child():
         "decode_compiles_after_warmup":
             c1["decode_compiles"] - c0["decode_compiles"],
         "prefill_compiles": c1["prefill_compiles"],
+        # decode replay fast path (ISSUE 9): steady iterations run with
+        # prebuilt device-side args — rebuilds only at batch boundaries
+        # (admission/evict/swap), audited on the PADDLE_TPU_AUDIT_EVERY
+        # cadence, zero demotions expected
+        "decode_fast_steps":
+            f1["decode_fast_steps"] - f0["decode_fast_steps"],
+        "decode_rebuilds": f1["decode_rebuilds"] - f0["decode_rebuilds"],
+        "decode_audit_runs":
+            f1["decode_audit_runs"] - f0["decode_audit_runs"],
+        "decode_demotions":
+            f1["decode_demotions"] - f0["decode_demotions"],
         "platform": "cpu",
     }
     print(json.dumps(rec), flush=True)
@@ -666,10 +721,11 @@ def _attempt(cfg, env, watchdog):
 
 def _ratio_line(deadline):
     """Run the lazy-vs-TrainStep ratio microbench in a CPU subprocess and
-    print its JSON line. Tracks ISSUE-2's acceptance gate (ratio <= 2.0)
-    every bench run; never touches the accelerator, so a wedged tunnel
-    can't block it. Budget-bounded; failure is reported as a note, not a
-    run failure (the GPT ladder is the money metric)."""
+    print its JSON line. Tracks the replay-fast-path acceptance gate
+    (ISSUE 9: ratio <= 1.3, tightened from ISSUE 2's 2.0) every bench
+    run; never touches the accelerator, so a wedged tunnel can't block
+    it. Budget-bounded; failure is reported as a note, not a run failure
+    (the GPT ladder is the money metric)."""
     remaining = deadline - time.time()
     # the child runs the ratio measurement (<= ~240 s historically) PLUS
     # the spmd gate subprocess (<= 180 s) before printing its record —
@@ -724,15 +780,27 @@ def main():
     # so it banks even if the accelerator ladder eats the budget
     _ratio_line(deadline)
 
-    # Cheap pre-check, used ONLY to skip the big-model ladder when the
-    # default platform already resolves to CPU (no accelerator in the env).
-    # A timeout here does NOT pin anything — the first rung below is the
-    # real probe, under a far more generous watchdog (round-2 lesson: one
-    # failed 120 s probe must not decide the whole budget).
+    # Cheap pre-check that now GATES the big-model ladder (ISSUE 9
+    # satellite; BENCH_r05 burned a full 300 s watchdog per round on a
+    # dead accelerator before falling to CPU): if the quick probe says
+    # cpu OR fails entirely, one escalated retry covers a slow first
+    # init, and a second miss skips the gpt2-medium ladder outright —
+    # the per-rung in-ladder probes remain for mid-run tunnel death.
+    # (The round-2 "one failed probe must not decide the budget" lesson
+    # applied to a 120 s full-model probe; this one only asks
+    # jax.devices() for a platform name, so two misses in a row mean
+    # no accelerator, not a slow compile.)
     quick = _probe_platform(25.0)
+    if quick is None:
+        quick = _probe_platform(2 * PROBE_TIMEOUT)
     if quick == "cpu":
         accel_dead = True
         _note("default platform is cpu; running degraded CPU ladder")
+    elif quick is None:
+        accel_dead = True
+        _note("accelerator probe failed twice (incl. escalated retry); "
+              "skipping the accelerator ladder instead of burning its "
+              "watchdog")
 
     # ---- accelerator ladder: first rung doubles as the liveness probe ----
     for i, cfg in enumerate(TPU_CONFIGS):

@@ -35,6 +35,16 @@ from .tensor import Tensor
 _counters = _registry.scoped_counters("dispatch", {
     "ops_dispatched": 0, "jit_cache_hits": 0, "jit_cache_misses": 0})
 
+
+def ops_dispatched():
+    """Monotonic count of ops entering forward(). forward() is the ONLY
+    per-op entry point, so the replay fast path (core/lazy.ReplayStep)
+    snapshots this around each replayed step to prove zero per-op Python
+    (telemetry ``fastpath.ops_dispatched_per_step`` — the bench gate
+    reads 0 there in the steady window). Keep every dispatch route
+    bumping it, or the proof silently weakens."""
+    return _counters["ops_dispatched"]
+
 # Pluggable hooks -------------------------------------------------------------
 # static graph recorder: callable(fn, name, inputs, attrs) -> outputs or None
 static_recorder = None

@@ -57,7 +57,8 @@ from ..profiler import timeline as _timeline
 
 __all__ = ["LazyArray", "enabled", "lazy_guard", "build", "force",
            "stats", "capture_guard", "donate_guard", "drop_plans",
-           "set_spmd_mesh", "spmd_mesh", "describe_plans"]
+           "set_spmd_mesh", "spmd_mesh", "describe_plans", "ReplayStep",
+           "AUDIT_EVERY"]
 
 _state = threading.local()
 
@@ -124,6 +125,40 @@ def set_spmd_mesh(mesh):
 # materializations before promotion (>= 2: one to build the signature,
 # one to prove it steady).
 _CAPTURE_K = max(2, int(os.environ.get("PADDLE_TPU_CAPTURE_K", "3")))
+
+# Replay-by-signature audit cadence (ISSUE 9): a ReplayStep in the
+# zero-dispatch fast path runs the full recorded walk (per-op cursor
+# verification) every AUDIT_EVERY steps and cross-checks it against the
+# armed fingerprint; the serving decode loop audits its device-side slot
+# state on the same cadence. Lower = tighter divergence detection,
+# higher = less per-step Python amortized over the window.
+AUDIT_EVERY = max(1, int(os.environ.get("PADDLE_TPU_AUDIT_EVERY", "16")))
+
+# fastpath.* telemetry (shared with serving/engine.py's decode fast path):
+# bumped with plain dict stores, batched ONE merge per replayed step — a
+# fast-path step performs zero per-op registry/explainer calls
+# (tests/test_profiler.py asserts this).
+_fp_counters = _registry.scoped_counters("fastpath", {
+    "hits": 0, "misses": 0, "arms": 0, "audit_runs": 0, "demotions": 0,
+    "ops_dispatched_per_step": 0, "replay_ops_dispatched": 0})
+
+# External-mutation epoch: bumped by Tensor.set_value (the in-place
+# restore contract — checkpoint restore_training_state, optimizer
+# set_state_dict, Model.load all land there). An armed ReplayStep feeds
+# carried leaves from plan.last_out and REBINDS the holder Tensors each
+# replay, so a set_value between steps would otherwise be silently
+# clobbered by the next replay's rebind; the liveness check compares
+# this epoch and demotes to an audited slow step instead, which records
+# from the restored buffers.
+_mut_epoch = 0
+
+
+def note_external_mutation():
+    """Record that some live Tensor's payload was replaced in place
+    (set_value / restore). O(1); armed ReplayStep instances demote on
+    their next call and re-observe from the mutated state."""
+    global _mut_epoch
+    _mut_epoch += 1
 _capture_default = os.environ.get(
     "PADDLE_TPU_STEP_CAPTURE", "1").lower() not in ("0", "false", "off")
 _donate_default = os.environ.get(
@@ -1405,7 +1440,16 @@ class _Session:
                     nd.values = tuple(v)
         elif plan.exec_donate is None and _donate_enabled():
             self._update_carry(uvals, store)
+        prev_out = plan.last_out
         plan.last_out = new_flat
+        if getattr(_state, "stash_exec", False):
+            # replay-by-signature arming probe (ReplayStep): hand the
+            # wrapper this step's plan, placeholders and leaf buffers so
+            # it can fingerprint the input signature and take over the
+            # next steady iterations without re-dispatching any op
+            _state.last_exec = (plan, nodes, store, uvals, prev_out,
+                                new_flat, donate)
+            _state.stash_count = getattr(_state, "stash_count", 0) + 1
         # release per-step state: stored inputs must not pin buffers
         self.in_store = ()
         self.fns = ()
@@ -1554,3 +1598,571 @@ def _materialize(root):
             n.fn = None
             n.attrs = None
             n.inputs = ()
+
+
+# ================= replay-by-signature fast path (ISSUE 9) =================
+#
+# Captured-mode sessions still pay O(n_ops) Python per step: every op flows
+# through dispatch.forward -> _Session.record purely to verify the capture
+# cursor. ReplayStep removes even that. It wraps the WHOLE user step
+# function; once the captured plan's input signature proves stable it stops
+# calling the function at all — each steady step is one fingerprint check
+# (leaf avals, shardings, donation flag, scalar-input names, installed mesh
+# identity) plus one invocation of the cached executable, CUDA-graph-style.
+# Cursor verification is demoted to a periodic AUDIT (every AUDIT_EVERY
+# steps, and always on the first step after any plan/mesh/weight-swap
+# event, because those drop the plan or change the fingerprint): the audit
+# runs the full recorded walk and cross-checks the observed leaf sources
+# against the armed fingerprint. Any divergence demotes with a structured
+# explainer cause and falls back by prefix-re-record exactly as before —
+# the fast path is never load-bearing for correctness beyond one audit
+# window.
+
+_FP_MISS = object()
+
+
+def _fp_hit_rate():
+    """Recompute the fastpath.hit_rate gauge (cold paths only: arm,
+    demote, slow step — never on a replayed step)."""
+    calls = _fp_counters["hits"] + _fp_counters["misses"]
+    rate = _fp_counters["hits"] / calls if calls else 0.0
+    _registry.gauge_set("fastpath.hit_rate", rate)
+    return rate
+
+
+def _force_tree(x):
+    """Force every (possibly nested) returned Tensor payload — drives the
+    step's materialization when the body returns without reading."""
+    if x is None:
+        return
+    if isinstance(x, (tuple, list)):
+        for v in x:
+            _force_tree(v)
+        return
+    d = getattr(x, "_data", None)
+    if type(d) is LazyArray:
+        d._force()
+
+
+def _holders(la):
+    """Live current-holder Tensor weakrefs of a LazyArray."""
+    out = []
+    r = la._cur1
+    if r is not None:
+        out.append(r)
+    if la._curx:
+        out.extend(la._curx)
+    return out
+
+
+class _Snap:
+    """Armed replay state for one ReplayStep: the fingerprint (per-class
+    leaf sources, arg avals, mesh identity, donation flag) plus everything
+    needed to invoke the captured executable without the session path."""
+
+    __slots__ = ("plan", "exe", "donate", "mesh", "n_args", "sources",
+                 "template", "carry_items", "t_items", "lr_items",
+                 "arg_items", "rebind", "ret_spec", "tensor_cls",
+                 "tick_opts", "mut_epoch")
+
+
+class ReplayStep:
+    """Zero-dispatch replay wrapper for a lazy train step.
+
+    Wrap the whole step body (forward + backward + optimizer update +
+    clear_grad, run under ``incubate.lazy_eval``); call it once per
+    iteration. The wrapper runs the body normally until the capture
+    engine promotes the step AND its input signature proves stable for
+    two consecutive iterations, then replays the captured executable
+    directly: no per-op dispatch, no node recording, no cursor walk,
+    telemetry batched into one dict-merge per step.
+
+        step = lazy.ReplayStep(body, optimizers=opt)
+        for _ in range(n):
+            loss = step()            # or step(x, y) with fresh batches
+
+    Leaf sources the fingerprint understands:
+      * loop-carried buffers (params / optimizer slots): fed from the
+        previous step's outputs, donated when the plan donates;
+      * per-step optimizer scalars ('t' step count, uniform 'lr'):
+        recomputed from the optimizer each replay (``_fastpath_tick``
+        advances the step count so Adam bias correction and checkpoints
+        stay exact) — pass the step's optimizers or the step-count leaf
+        never stabilizes and the wrapper stays on the session path;
+      * call arguments (fresh batches): looked up by position, verified
+        by aval each replay — new values flow, new shapes demote;
+      * everything else is pinned by buffer identity and verified by the
+        periodic audit.
+
+    The body should RETURN the Tensor(s) the caller reads (the loss);
+    replayed returns are detached. Loop-carried state is refreshed in
+    place every replay; other non-returned step outputs refresh only on
+    audited steps. Donation caveat: a replayed donating step has no
+    placeholder graph left to poison, so a stale pre-arming Tensor alias
+    of a donated buffer raises JAX's deleted-array error on read instead
+    of the session path's structured _DONATED diagnostic — still loud,
+    just less specific.
+    """
+
+    def __init__(self, fn, optimizers=None, audit_every=None):
+        self._fn = fn
+        if optimizers is None:
+            optimizers = []
+        elif not isinstance(optimizers, (list, tuple)):
+            optimizers = [optimizers]
+        self._opts = list(optimizers)
+        self._audit_every = max(1, int(audit_every or AUDIT_EVERY))
+        self._snap = None
+        self._pending = None      # (plan id, donate, sources) awaiting
+        self._nobs = 0            # consecutive identical observations
+        self._since_audit = 0
+        self._arm_failed_plan = None  # plan with an unmappable return
+                                      # (object pinned: id-reuse-safe)
+        self._dispatch = None     # dispatch._counters (resolved lazily:
+        self._faults = None       # dispatch/testing import this module)
+
+    # ---------------------------------------------------------- entry --
+    def __call__(self, *args):
+        if self._snap is not None:
+            if self._since_audit + 1 >= self._audit_every:
+                return self._slow(args, audit=True)
+            out = self._replay(args)
+            if out is not _FP_MISS:
+                return out
+        return self._slow(args, audit=False)
+
+    @property
+    def armed(self):
+        return self._snap is not None
+
+    # ------------------------------------------------------- fast path --
+    def _replay(self, args):
+        snap = self._snap
+        plan = snap.plan
+        plans = getattr(_state, "plans", None)
+        if plans is None or plans.get(plan.first_sig) is not plan \
+                or not _capture_enabled() \
+                or _spmd_state["mesh"] is not snap.mesh \
+                or (snap.donate and not _donate_enabled()):
+            self._demote(
+                "plan_invalidated",
+                why="captured plan dropped (drop_plans / mesh change) or "
+                    "capture/donation toggled since arming; falling back "
+                    "to the full recorded walk")
+            return _FP_MISS
+        if len(args) != snap.n_args:
+            self._demote(
+                "arity_changed",
+                why=f"step called with {len(args)} args, armed with "
+                    f"{snap.n_args}")
+            return _FP_MISS
+        if snap.mut_epoch != _mut_epoch:
+            self._demote(
+                "external_mutation",
+                why="a live Tensor was set_value'd (in-place checkpoint "
+                    "restore / weight surgery) since arming; the next "
+                    "step records from the restored buffers")
+            return _FP_MISS
+        faults = self._faults
+        if faults is None:
+            from ..testing import faults as _f
+
+            faults = self._faults = _f
+        if faults.ACTIVE and faults.fire("mutate_signature"):
+            self._perturb(faults.spec().get("mutate_signature", {}))
+            snap = self._snap  # aval-mode perturbation rewrote items
+        disp = self._dispatch
+        if disp is None:
+            from . import dispatch as _d
+
+            disp = self._dispatch = _d._counters
+        d0 = disp["ops_dispatched"]
+        last = plan.last_out
+        uvals = list(snap.template)
+        for c, j in snap.carry_items:
+            uvals[c] = last[j]
+        # arg validation runs BEFORE the optimizer tick: a demotion from
+        # here falls back to _slow, whose opt.step() advances _opt_step —
+        # ticking first would double-advance the step count for that one
+        # logical step and skew Adam bias correction forever after
+        for c, i, shp, dt, sh in snap.arg_items:
+            a = args[i]
+            d = getattr(a, "_data", a)
+            if type(d) is LazyArray or getattr(d, "shape", None) is None \
+                    or tuple(d.shape) != shp or d.dtype != dt:
+                self._demote(
+                    "arg_aval",
+                    why=f"arg {i} aval changed: armed {shp}/{dt}, got "
+                        f"{tuple(getattr(d, 'shape', ()))}"
+                        f"/{getattr(d, 'dtype', None)}")
+                return _FP_MISS
+            if sh is not None and getattr(d, "sharding", None) is not None \
+                    and getattr(d, "committed", False) and d.sharding != sh:
+                # SPMD plans pin explicit in_shardings: re-place a
+                # straggler batch like _Session._execute does
+                d = jax.device_put(d, sh)
+            uvals[c] = d
+        for opt in snap.tick_opts:
+            opt._fastpath_tick()
+        for c, oi in snap.t_items:
+            uvals[c] = np.asarray(self._opts[oi]._opt_step, np.float32)
+        for c, oi in snap.lr_items:
+            uvals[c] = np.asarray(self._opts[oi].get_lr(), np.float32)
+        if _timeline.active():
+            _t0 = time.perf_counter()
+            outs = snap.exe(*uvals)
+            _timeline.add_span("fastpath_step", _t0, time.perf_counter())
+        else:
+            outs = snap.exe(*uvals)
+        flat = [a for tup in outs for a in tup]
+        plan.last_out = flat
+        for wr, j in snap.rebind:
+            t = wr()
+            if t is not None:
+                t._data = flat[j]
+        # telemetry: ONE batched dict-merge per replayed step — no per-op
+        # registry calls, no explainer traffic, no timing records. The
+        # lazy-scope bumps take the module lock like _Session._execute
+        # does for the same dict (threaded drivers must not lose counts
+        # the bench gates read); the fastpath scope is single-writer.
+        self._since_audit += 1
+        fc = _fp_counters
+        fc["hits"] += 1
+        d_ops = disp["ops_dispatched"] - d0
+        fc["ops_dispatched_per_step"] = d_ops
+        # window-proof accumulator: per_step is last-write-wins, so the
+        # bench gate sums THIS over its window — a single leaked dispatch
+        # anywhere in the window can't be overwritten back to zero
+        fc["replay_ops_dispatched"] += d_ops
+        with _lock:
+            lc = _counters
+            lc["materializations"] += 1
+            lc["cache_hits"] += 1
+            lc["captured_steps"] += 1
+            if snap.donate:
+                lc["donated_steps"] += 1
+        if snap.mesh is not None:
+            # keep the ISSUE-6 per-step collective gauge honest across
+            # the replay window (same bookkeeping as _Session._execute)
+            global _pycoll_mark
+            cur = _spmd_counters["python_collectives"]
+            if cur < _pycoll_mark:
+                _pycoll_mark = 0
+            _spmd_counters["python_collectives_per_step"] = \
+                cur - _pycoll_mark
+            _pycoll_mark = cur
+        return self._rebuild(snap.ret_spec, flat, snap.tensor_cls)
+
+    # ---------------------------------------- slow path: record + audit --
+    def _slow(self, args, audit):
+        fc = _fp_counters
+        fc["misses"] += 1
+        if audit:
+            fc["audit_runs"] += 1
+        prev = getattr(_state, "stash_exec", False)
+        _state.stash_exec = True
+        _state.last_exec = None
+        _state.stash_count = 0
+        try:
+            ret = self._fn(*args)
+            _force_tree(ret)
+            stash = getattr(_state, "last_exec", None)
+            count = getattr(_state, "stash_count", 0)
+        finally:
+            _state.stash_exec = prev
+            _state.last_exec = None
+        self._after_slow(args, ret, stash, count, audit)
+        _fp_hit_rate()
+        return ret
+
+    def _after_slow(self, args, ret, stash, count, audit):
+        snap = self._snap
+        if stash is None or count != 1:
+            # the step did not run as exactly one captured replay: either
+            # still warming up / re-recording after a divergence (the
+            # session machinery already fell back by prefix-re-record),
+            # or the body split into multiple segments
+            if snap is not None:
+                self._demote(
+                    "audit_no_replay" if audit else "step_diverged",
+                    why="step did not execute as a single captured replay "
+                        "(capture fell back to re-recording, or the step "
+                        "split into multiple segments)")
+            else:
+                self._pending = None
+                self._nobs = 0
+            return
+        plan, nodes, store, uvals, prev_out, new_out, donate = stash
+        sources = self._derive(plan, uvals, prev_out, args)
+        if snap is not None:
+            if plan is not snap.plan or sources != snap.sources:
+                self._demote(
+                    "audit_divergence",
+                    why="audit: the recorded walk's leaf sources no "
+                        "longer match the armed fingerprint (an input "
+                        "changed behind the fast path's back); falling "
+                        "back and re-observing")
+                # fall through: this run seeds a fresh observation
+            else:
+                # clean audit: keep the armed executable, refresh the
+                # rebind targets from this run's live placeholders.
+                # donate is NOT cross-checked: an audit step runs through
+                # Tensors the fast path rebound to concrete arrays, so
+                # the session's donation preconditions see no LazyArray
+                # store entries and it executes plain — expected, and
+                # donation resumes on the next replayed step.
+                self._since_audit = 0
+                snap.rebind = self._rebind_map(plan, nodes,
+                                               snap.carry_items)
+                return
+        self._observe(plan, nodes, uvals, donate, sources, args, ret)
+
+    def _observe(self, plan, nodes, uvals, donate, sources, args, ret):
+        if plan is self._arm_failed_plan:
+            return  # unmappable return value: hopeless until plans change
+        key = (id(plan), donate, sources)
+        if self._pending != key:
+            self._pending = key
+            self._nobs = 1
+            return
+        self._nobs += 1
+        if self._nobs < 2:
+            return
+        if _donate_enabled() and not donate and self._nobs < 6:
+            # donation confirms over the first few captured steps
+            # (_update_carry proposes, confirms, then the donating
+            # executable takes over); arming with exec_plain now would
+            # freeze donation out for good. The donate flag flipping
+            # resets the observation streak, so a donating loop arms on
+            # two consecutive DONATED steps; after 6 stable looks still
+            # without donation, nothing donatable exists — arm plain.
+            return
+        self._arm(plan, nodes, uvals, donate, sources, args, ret)
+
+    # -------------------------------------------------- fingerprinting --
+    def _derive(self, plan, uvals, prev_out, args):
+        """One source entry per unique leaf class: where the NEXT step's
+        buffer comes from. This tuple (plus arg avals, the donation flag
+        and the installed mesh identity) IS the step's fingerprint."""
+        scalar_by_id = {}
+        for oi, opt in enumerate(self._opts):
+            for name, by_name in (getattr(opt, "_scalar_cache", None)
+                                  or {}).items():
+                for v, tens in by_name.items():
+                    scalar_by_id[id(tens._data)] = (oi, name, v)
+        arg_by_id = {}
+        for i, a in enumerate(args):
+            arg_by_id[id(getattr(a, "_data", a))] = i
+        out_pos = {id(a): j for j, a in enumerate(prev_out)}
+        # id-keyed maps are sound here: every candidate object is held
+        # alive by uvals/prev_out/args for the duration of this call
+        sources = []
+        for c in range(len(plan.classes)):
+            val = uvals[c]
+            j = out_pos.get(id(val))
+            if j is not None and prev_out[j] is val:
+                sources.append(("carry", j))
+                continue
+            hit = scalar_by_id.get(id(val))
+            if hit is not None:
+                oi, name, v = hit
+                if name == "t" and v == self._opts[oi]._opt_step:
+                    sources.append(("t", oi))
+                    continue
+                if name == "lr" and v == self._opts[oi].get_lr():
+                    # uniform lr only: a per-param optimize_attr
+                    # multiplier can't be recomputed generically — those
+                    # leaves stay pinned (audit-guarded)
+                    sources.append(("lr", oi))
+                    continue
+            i = arg_by_id.get(id(val))
+            if i is not None:
+                sources.append(("arg", i, tuple(getattr(val, "shape", ())),
+                                getattr(val, "dtype", None)))
+                continue
+            sources.append(("pin", id(val)))
+        return tuple(sources)
+
+    # ------------------------------------------------------------- arm --
+    def _arm(self, plan, nodes, uvals, donate, sources, args, ret):
+        exe = plan.exec_donate if donate else plan.exec_plain
+        if exe is None:
+            return
+        ret_spec, tensor_cls = self._ret_spec(plan, nodes, ret)
+        if ret_spec is None:
+            # latched per plan: without this the wrapper would re-derive
+            # and re-fail every ~2 steps forever, churning the explainer
+            # ring on a permanently hopeless condition
+            self._arm_failed_plan = plan
+            self._pending = None
+            self._nobs = 0
+            _explain.record(
+                "fastpath_arm_failed", op=plan.ops[0][2],
+                why="step return value is not mapped onto captured "
+                    "executable outputs — return the loss Tensor from "
+                    "the step body to enable zero-dispatch replay")
+            return
+        snap = _Snap()
+        snap.plan = plan
+        snap.exe = exe
+        snap.donate = donate
+        snap.mesh = _spmd_state["mesh"]
+        snap.n_args = len(args)
+        snap.sources = sources
+        # only 'pin' slots are ever READ from the template (every other
+        # source kind overwrites its slot each replay) — drop the rest so
+        # the snapshot doesn't pin a stale generation of params/slots for
+        # the wrapper's lifetime on non-donating plans
+        snap.template = [v if s[0] == "pin" else None
+                         for v, s in zip(uvals, sources)]
+        carry, t_it, lr_it, arg_it = [], [], [], []
+        for c, src in enumerate(sources):
+            kind = src[0]
+            if kind == "carry":
+                carry.append((c, src[1]))
+            elif kind == "t":
+                t_it.append((c, src[1]))
+            elif kind == "lr":
+                lr_it.append((c, src[1]))
+            elif kind == "arg":
+                sh = (plan.in_shardings[c]
+                      if plan.in_shardings is not None else None)
+                arg_it.append((c, src[1], src[2], src[3], sh))
+        snap.carry_items = tuple(carry)
+        snap.t_items = tuple(t_it)
+        snap.lr_items = tuple(lr_it)
+        snap.arg_items = tuple(arg_it)
+        snap.tick_opts = tuple(self._opts)
+        snap.rebind = self._rebind_map(plan, nodes, snap.carry_items)
+        snap.ret_spec = ret_spec
+        snap.tensor_cls = tensor_cls
+        snap.mut_epoch = _mut_epoch
+        nobs = self._nobs
+        self._snap = snap
+        self._pending = None
+        self._nobs = 0
+        self._since_audit = 0
+        _fp_counters["arms"] += 1
+        _explain.record(
+            "fastpath_armed", op=plan.ops[0][2],
+            why=(f"input signature stable for {nobs} recorded walks; "
+                 f"steady steps now replay the captured executable with "
+                 f"zero per-op dispatch (audited every "
+                 f"{self._audit_every} steps)"),
+            n_ops=len(plan.ops), n_leaves=plan.n_leaves,
+            carried=len(carry), args=len(arg_it), donate=donate)
+
+    @staticmethod
+    def _flat_slots(plan):
+        """(rec_idx, out_idx) per flat output position of the captured
+        executable, in plan.last_out order."""
+        slots = []
+        for r in plan.keep_rec:
+            for idx in range(len(plan.ops[r][4])):
+                slots.append((r, idx))
+        return slots
+
+    def _rebind_map(self, plan, nodes, carry_items):
+        """(tensor weakref, flat out index) for every live Tensor holding
+        a loop-carried output placeholder: each replay rebinds them to
+        the fresh buffers so params/optimizer slots (and the next audit's
+        recorded walk) always see the live state."""
+        slots = self._flat_slots(plan)
+        rebind = []
+        seen = set()
+        for _c, j in carry_items:
+            r, idx = slots[j]
+            node = nodes[r]
+            for wr in node.refs:
+                la = wr()
+                if la is None or la.idx != idx or la.node is not node:
+                    continue
+                for tw in _holders(la):
+                    t = tw()
+                    if t is not None and id(t) not in seen:
+                        seen.add(id(t))
+                        rebind.append((tw, j))
+        return tuple(rebind)
+
+    def _ret_spec(self, plan, nodes, ret):
+        """Map the body's return structure onto flat executable output
+        positions; (spec, Tensor class) or (None, None) if unmappable."""
+        slots = self._flat_slots(plan)
+        pos = {}
+        for j, (r, idx) in enumerate(slots):
+            pos[(id(nodes[r]), idx)] = j
+        cls = [None]
+
+        def walk(x):
+            if x is None:
+                return ("none",)
+            if isinstance(x, (tuple, list)):
+                subs = [walk(v) for v in x]
+                if any(s is None for s in subs):
+                    return None
+                return ("seq", type(x) is tuple, tuple(subs))
+            d = getattr(x, "_data", None)
+            if type(d) is LazyArray:
+                j = pos.get((id(d.node), d.idx))
+                if j is None:
+                    return None
+                cls[0] = type(x)
+                return ("t", j)
+            return None
+
+        spec = walk(ret)
+        return spec, cls[0]
+
+    @staticmethod
+    def _rebuild(spec, flat, tensor_cls):
+        k = spec[0]
+        if k == "t":
+            return tensor_cls(flat[spec[1]])
+        if k == "none":
+            return None
+        vals = [ReplayStep._rebuild(s, flat, tensor_cls) for s in spec[2]]
+        return tuple(vals) if spec[1] else vals
+
+    # ---------------------------------------------------------- demote --
+    def _demote(self, cause, why=None, **detail):
+        snap, self._snap = self._snap, None
+        self._pending = None
+        self._nobs = 0
+        fc = _fp_counters
+        fc["demotions"] += 1
+        key = "demote." + cause
+        fc[key] = fc.get(key, 0) + 1
+        _explain.record(
+            "fastpath_demoted",
+            op=snap.plan.ops[0][2] if snap is not None else None,
+            why=why or cause, reason=cause, **detail)
+        _fp_hit_rate()
+
+    # --------------------------------------------- fault injection hook --
+    def _perturb(self, params):
+        """FLAGS_fault_inject mutate_signature: corrupt the armed
+        snapshot the way an undetected external mutation would.
+        mode=scalar (default) perturbs one pinned leaf VALUE — identity
+        and aval look unchanged to the per-step fingerprint, so only the
+        periodic audit's cross-check can catch it. mode=aval corrupts a
+        recorded arg aval — the very next fingerprint check demotes."""
+        snap = self._snap
+        mode = params.get("mode", "scalar")
+        if mode == "aval" and snap.arg_items:
+            c, i, shp, dt, sh = snap.arg_items[0]
+            bad = tuple(d + 1 for d in shp) or (1,)
+            snap.arg_items = ((c, i, bad, dt, sh),) + snap.arg_items[1:]
+            return
+        pins = [c for c, s in enumerate(snap.sources) if s[0] == "pin"]
+        pins.sort(key=lambda c: not np.issubdtype(
+            np.asarray(snap.template[c]).dtype, np.floating))
+        if not pins:
+            return
+        c = pins[0]
+        arr = np.asarray(snap.template[c])
+        pert = (arr + np.ones((), arr.dtype)).astype(arr.dtype)
+        snap.template = list(snap.template)
+        snap.template[c] = pert  # also keeps the id() in sources alive
+        srcs = list(snap.sources)
+        srcs[c] = ("pin", id(pert))
+        snap.sources = tuple(srcs)

@@ -280,6 +280,13 @@ class Tensor:
             raise ValueError(
                 f"set_value shape mismatch: {arr.shape} vs {self._data.shape}")
         self._data = arr.astype(self._data.dtype)
+        # in-place restore contract (checkpoint restore_training_state,
+        # optimizer set_state_dict, Model.load all land here): an armed
+        # zero-dispatch ReplayStep feeds loop-carried leaves from its own
+        # outputs and would silently clobber this write on its next
+        # rebind — the epoch bump demotes it to an audited slow step that
+        # records from the restored buffer instead
+        _lazy.note_external_mutation()
         return self
 
     copy_ = set_value

@@ -163,12 +163,24 @@ class Model:
                 # donated param/slot buffers; GSPMD owns the dp/mp
                 # collectives. Batches are placed dp-sharded up front:
                 # the captured executable pins its input layouts.
+                # The whole body rides lazy.ReplayStep (ISSUE 9): once
+                # the signature is stable, steady train_batch calls
+                # replay the executable with zero per-op dispatch.
+                # Sharding happens OUTSIDE the wrapped body so fresh
+                # batches reach the fingerprint as arg leaves (aval-
+                # checked each replay) instead of unstable pins.
                 from .. import incubate
+                from ..core import lazy as _corelazy
 
-                def lazy_spmd_step(*args):
-                    args = [_spmd.shard_batch(a) for a in args]
+                def spmd_body(*args):
                     with incubate.lazy_eval():
                         return step(*args)
+
+                inner = _corelazy.ReplayStep(spmd_body,
+                                             optimizers=self._optimizer)
+
+                def lazy_spmd_step(*args):
+                    return inner(*[_spmd.shard_batch(a) for a in args])
 
                 self._train_step = lazy_spmd_step
             else:

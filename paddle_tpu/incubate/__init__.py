@@ -30,3 +30,38 @@ def lazy_eval(flag=True):
     from ..core.lazy import lazy_guard
 
     return lazy_guard(flag)
+
+
+def replay_step(fn, optimizers=None, audit_every=None):
+    """Zero-dispatch replay wrapper for a lazy train step (ISSUE 9).
+
+    Wrap the WHOLE step body (forward, backward, optimizer update, all
+    under ``lazy_eval``) and call the wrapper once per iteration. After
+    the capture engine promotes the step and its input signature proves
+    stable, steady iterations stop dispatching ops entirely: one
+    fingerprint check + one cached-executable call, with cursor
+    verification demoted to a periodic audit (``PADDLE_TPU_AUDIT_EVERY``,
+    default 16 steps).
+
+        opt = paddle.optimizer.AdamW(parameters=net.parameters())
+
+        def body(x, y):
+            with paddle.incubate.lazy_eval():
+                loss = ((net(x) - y) ** 2).mean()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+        step = paddle.incubate.replay_step(body, optimizers=opt)
+        for x, y in loader:
+            loss = step(x, y)
+
+    Pass the step's optimizers so their dynamic scalars (step count,
+    learning rate) are recomputed each replayed step. The body should
+    return the Tensors the caller reads (they come back detached on
+    replayed steps). See DESIGN_DECISIONS.md "Replay fast path".
+    """
+    from ..core.lazy import ReplayStep
+
+    return ReplayStep(fn, optimizers=optimizers, audit_every=audit_every)

@@ -146,6 +146,16 @@ class Optimizer:
         with RecordEvent("optimizer-step"):
             self._step_impl(SelectedRows, Tensor)
 
+    def _fastpath_tick(self):
+        """Advance the per-step Python state exactly as step() would —
+        called once per zero-dispatch replayed step (core/lazy.ReplayStep)
+        in place of the full step() body, so the step counter (Adam bias
+        correction, scheduler reads, checkpointed ``_opt_step``) stays
+        true while no op is dispatched. The replay recomputes the 't' /
+        uniform-'lr' scalar leaves from this state every step."""
+        self._opt_step += 1
+        return self._opt_step
+
     def _step_impl(self, SelectedRows, Tensor):
         pg = self._params_grads()
         # SelectedRows grads (sparse embedding, eager): row-capable

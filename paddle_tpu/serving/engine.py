@@ -61,6 +61,17 @@ _counters = _registry.scoped_counters("serving", {
     "active_slot_steps": 0, "prefill_compiles": 0, "decode_compiles": 0,
     "bucket_promotions": 0, "weight_swaps": 0, "reprimes": 0})
 
+# Decode replay fast path (ISSUE 9, same machinery as lazy.ReplayStep):
+# in the steady window a decode iteration is one fingerprint check (the
+# prebuilt device-side arg tuple IS the fingerprint — every slot/weight/
+# executable mutation clears it) plus one executable call; the per-slot
+# state advances ON DEVICE inside the step instead of being re-uploaded
+# from host numpy every iteration. A periodic audit cross-checks the
+# device copies against the host mirrors.
+_fp_counters = _registry.scoped_counters("fastpath", {
+    "decode_fast_steps": 0, "decode_rebuilds": 0, "decode_audit_runs": 0,
+    "decode_demotions": 0})
+
 
 class WeightSwapError(RuntimeError):
     """A proposed weight swap does not fit the running engine (missing or
@@ -183,6 +194,16 @@ class GenerationEngine:
                                    donate_argnums=self._donate)
         self._seen_sigs: set = set()
 
+        # decode fast path state: cached weight-array tuple (invalidated
+        # by swap_weights) and the prebuilt device-side slot-state args
+        # (invalidated by ANY prefill/release/swap/reprime — those are
+        # the batch-boundary events, so the steady decode loop between
+        # them runs with zero host->device uploads and no radar walk)
+        self._state_tuple = None
+        self._fast = None
+        self._decode_since_audit = 0
+        self._audit_every = _lazy.AUDIT_EVERY
+
     # ------------------------------------------------------------- slots --
     def free_slots(self):
         return [i for i in range(self.max_batch_size) if not self._active[i]]
@@ -197,6 +218,7 @@ class GenerationEngine:
         self._active[slot] = False
         self._cur_lens[slot] = 0
         self._gen_idx[slot] = 0
+        self._fast = None  # slot membership changed: rebuild + re-radar
 
     def slot_len(self, slot):
         return int(self._cur_lens[slot])
@@ -221,7 +243,15 @@ class GenerationEngine:
 
     # ----------------------------------------------------- pure step fns --
     def _state_arrays(self):
-        return tuple(self._state[n]._data for n in self._names)
+        # cached between weight swaps: walking hundreds of Tensor
+        # attribute loads per decode step was a measurable slice of the
+        # scheduler->engine hop (_forward_slot's trace-time rebinding
+        # restores the same array objects, so the cache stays valid)
+        cached = self._state_tuple
+        if cached is None:
+            cached = self._state_tuple = tuple(
+                self._state[n]._data for n in self._names)
+        return cached
 
     def _forward_slot(self, state_arrays, ids, positions, ks, vs, offsets,
                       seq_lens):
@@ -281,11 +311,14 @@ class GenerationEngine:
         return tok, new_k, new_v
 
     def _decode_pure(self, state_arrays, ks, vs, last_tokens, cur_lens,
-                     keys, gen_idx, temps, top_ks, top_ps):
+                     keys, gen_idx, temps, top_ks, top_ps, active):
         """One decode iteration for EVERY slot at fixed [B, 1] shape: feed
         each slot's last token at its own position, write its KV row in
         place, sample its next token. Inactive lanes compute garbage that
-        the host discards — batch membership is data, not shape."""
+        the host discards — batch membership is data, not shape. The
+        per-slot cursors advance IN the step (masked by ``active``) so
+        the steady fast path keeps them on device instead of re-uploading
+        host mirrors every iteration."""
         ids = last_tokens[:, None]
         positions = jnp.minimum(cur_lens, self.max_seq_len - 1)[:, None]
         hidden, nk, nv = self._forward_slot(
@@ -296,7 +329,10 @@ class GenerationEngine:
                   @ w.T.astype(jnp.float32))
         gum = _sampling.gumbel_rows(keys, gen_idx, logits.shape[-1])
         toks = _sampling.sample_tokens(logits, temps, top_ks, top_ps, gum)
-        return toks, nk, nv
+        adv = active.astype(cur_lens.dtype)
+        new_last = jnp.where(active, toks, last_tokens)
+        return (toks, nk, nv, new_last, cur_lens + adv,
+                gen_idx + adv.astype(gen_idx.dtype))
 
     # ------------------------------------------------------- weight swap --
     def _resolve_swap_state(self, state):
@@ -382,6 +418,11 @@ class GenerationEngine:
             _faults.fire("kill_during_swap")
         for n, arr in zip(self._names, staged):
             self._state[n]._data = arr
+        # drop the cached weight tuple AND the decode fast path: the
+        # first post-swap decode rebuilds + re-runs the signature radar
+        # (an audited first step, same contract as lazy drop_plans)
+        self._state_tuple = None
+        self._fast = None
         _counters["weight_swaps"] += 1
         _explain.record(
             "serving_weight_swap", op="swap_weights",
@@ -402,6 +443,7 @@ class GenerationEngine:
                                    donate_argnums=self._donate)
         self._seen_sigs = {s for s in self._seen_sigs
                            if s[0] != "decode"}
+        self._fast = None  # fresh executable: audited rebuild first
         _counters["reprimes"] += 1
 
     # ----------------------------------------------------- compile radar --
@@ -465,6 +507,7 @@ class GenerationEngine:
         self._top_ks[slot] = top_k
         self._top_ps[slot] = top_p
         self._keys[slot] = key
+        self._fast = None  # admission is a batch-boundary event: rebuild
         _counters["prefills"] += 1
         _counters["tokens_generated"] += 1
         return tok
@@ -473,8 +516,16 @@ class GenerationEngine:
     def decode_step(self):
         """One continuous-batching iteration over all slots; returns the
         np.int32[B] token block (junk on inactive lanes). Advances every
-        active slot's cursor and per-request RNG index."""
-        active = self._active.copy()
+        active slot's cursor and per-request RNG index.
+
+        Steady fast path: when nothing mutated the batch since the last
+        iteration (no admission, eviction, weight swap or reprime), the
+        prebuilt device-side arg tuple is still valid — the iteration is
+        one fingerprint check plus one executable call, with the host
+        mirrors advanced by cheap numpy stores. Every
+        ``PADDLE_TPU_AUDIT_EVERY`` fast steps an audit cross-checks the
+        device copies against the host mirrors and demotes on mismatch."""
+        active = self._active
         n_active = int(active.sum())
         if n_active == 0:
             raise RuntimeError("decode_step with no active slots")
@@ -482,29 +533,85 @@ class GenerationEngine:
             _faults.fire("slow_decode")
             _faults.fire("replica_kill")
             _faults.fire("decode_error")
-        args = (self._state_arrays(), tuple(self._k), tuple(self._v),
-                jnp.asarray(self._last_tokens), jnp.asarray(self._cur_lens),
-                jnp.asarray(self._keys), jnp.asarray(self._gen_idx),
-                jnp.asarray(self._temps), jnp.asarray(self._top_ks),
-                jnp.asarray(self._top_ps))
+        fast = self._fast
+        if fast is not None \
+                and self._decode_since_audit + 1 >= self._audit_every:
+            self._audit_fast(fast)
+            fast = self._fast  # a failed audit demoted it
+        if fast is None:
+            return self._decode_rebuild(active, n_active)
+        args = (self._state_arrays(), tuple(self._k), tuple(self._v)) + fast
+        # the timing record stays per-step (one observation, no span
+        # stack) so timings.serving.decode_step keeps covering EVERY
+        # iteration, not just the rebuild ones
+        with _registry.time_block("decode_step", scope="serving"):
+            toks_d, nk, nv, nlast, nlens, ngen = self._decode_jit(*args)
+            toks = np.asarray(toks_d)
+        self._k, self._v = list(nk), list(nv)
+        self._fast = (nlast, nlens, fast[2], ngen) + fast[4:]
+        self._finish_decode(active, n_active, toks)
+        self._decode_since_audit += 1
+        _fp_counters["decode_fast_steps"] += 1
+        return toks
+
+    def _decode_rebuild(self, active, n_active):
+        """Off-steady decode: rebuild the device-side slot state from the
+        host mirrors (a batch-boundary event — admission, eviction,
+        weight swap, reprime — invalidated it), run the signature radar,
+        then re-arm the fast path for the next iteration."""
+        tail = (jnp.asarray(self._last_tokens),
+                jnp.asarray(self._cur_lens), jnp.asarray(self._keys),
+                jnp.asarray(self._gen_idx), jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
+                jnp.asarray(active))
+        args = (self._state_arrays(), tuple(self._k), tuple(self._v)) + tail
         self._note_signature(
             "decode", args,
             f"max_batch={self.max_batch_size}, "
             f"max_seq_len={self.max_seq_len}")
+        _fp_counters["decode_rebuilds"] += 1
         with RecordEvent("serving_decode_step"), \
                 _registry.time_block("decode_step", scope="serving"):
-            toks, nk, nv = self._decode_jit(*args)
-            toks = np.asarray(toks)
+            toks_d, nk, nv, nlast, nlens, ngen = self._decode_jit(*args)
+            toks = np.asarray(toks_d)
         self._k, self._v = list(nk), list(nv)
+        self._fast = (nlast, nlens, tail[2], ngen) + tail[4:]
+        self._decode_since_audit = 0
+        self._finish_decode(active, n_active, toks)
+        return toks
+
+    def _finish_decode(self, active, n_active, toks):
+        # host mirrors advance in lockstep with the device copies (numpy
+        # stores over B elements; the audit cross-checks the two)
         self._cur_lens[active] += 1
         self._gen_idx[active] += 1
         self._last_tokens[active] = toks[active]
-        _counters["decode_steps"] += 1
-        _counters["active_slot_steps"] += n_active
-        _counters["tokens_generated"] += n_active
+        c = _counters
+        c["decode_steps"] += 1
+        c["active_slot_steps"] += n_active
+        c["tokens_generated"] += n_active
         _registry.gauge_set("serving.batch_occupancy",
                             n_active / self.max_batch_size)
-        return toks
+
+    def _audit_fast(self, fast):
+        """Periodic decode audit: the device-side slot state must equal
+        the host mirrors bit for bit. A mismatch demotes the fast path
+        (next step rebuilds from the host mirrors, which stay
+        authoritative) with a structured explainer cause."""
+        _fp_counters["decode_audit_runs"] += 1
+        self._decode_since_audit = 0
+        ok = (np.array_equal(np.asarray(fast[0]), self._last_tokens)
+              and np.array_equal(np.asarray(fast[1]), self._cur_lens)
+              and np.array_equal(np.asarray(fast[3]), self._gen_idx)
+              and np.array_equal(np.asarray(fast[7]), self._active))
+        if not ok:
+            _fp_counters["decode_demotions"] += 1
+            self._fast = None
+            _explain.record(
+                "fastpath_demoted", op="serving.decode",
+                reason="decode_audit",
+                why="decode audit: device-side slot state diverged from "
+                    "the host mirrors; rebuilding from host state")
 
     # -------------------------------------------------------------- stats --
     def mean_occupancy(self):

@@ -251,7 +251,16 @@ class ContinuousBatchScheduler:
     # ---------------------------------------------------------- the loop --
     def step(self):
         """One continuous-batching iteration; returns True while any work
-        remains. Single-threaded with respect to itself and the engine."""
+        remains. Single-threaded with respect to itself and the engine.
+
+        The steady decode window (no queued work, no staged swap) skips
+        straight to the decode call: admission, queued-deadline scans and
+        swap application are batch-boundary bookkeeping that only runs
+        when their cheap preconditions fire (attribute reads are atomic
+        in CPython, so the gates take no lock; the locked slow paths
+        re-check under the lock as before). Combined with the engine's
+        prebuilt decode args this makes the scheduler->engine hop one
+        fingerprint check + one executable call per steady iteration."""
         now = time.monotonic()
         if self._t0 is None:
             self._t0 = now
@@ -259,37 +268,43 @@ class ContinuousBatchScheduler:
         # (0) staged weight swap lands HERE — between decode steps, so
         # every token of every request is computed on one consistent set
         # of weights (old until this boundary, new after)
-        self._apply_pending_swap()
+        if self._pending_swap is not None:
+            self._apply_pending_swap()
 
-        # (1) deadline-expired while queued: fail fast, never occupy a slot
-        with self._lock:
-            queued = list(self._queue)
-        for req in queued:
-            if req.deadline is not None and now > req.deadline:
-                with self._lock:
-                    try:
-                        self._queue.remove(req)
-                    except ValueError:
-                        continue
-                self._finish(req, RequestStatus.TIMEOUT)
-
-        # (2) admission: fill free slots from the queue, one prefill each
-        while True:
-            free = self.engine.free_slots()
-            if not free:
-                break
+        if self._queue:
+            # (1) deadline-expired while queued: fail fast, never occupy
+            # a slot
             with self._lock:
-                req = self._queue.popleft() if self._queue else None
-            if req is None:
-                break
-            self._admit(req, free[0])
+                queued = list(self._queue)
+            for req in queued:
+                if req.deadline is not None and now > req.deadline:
+                    with self._lock:
+                        try:
+                            self._queue.remove(req)
+                        except ValueError:
+                            continue
+                    self._finish(req, RequestStatus.TIMEOUT)
 
-        # (3) one decode iteration over every active slot
+            # (2) admission: fill free slots from the queue, one
+            # compiled prefill each
+            while True:
+                free = self.engine.free_slots()
+                if not free:
+                    break
+                with self._lock:
+                    req = self._queue.popleft() if self._queue else None
+                if req is None:
+                    break
+                self._admit(req, free[0])
+
+        # (3) one decode iteration over every active slot; per-request
+        # stop-condition bookkeeping happens once per iteration at this
+        # batch boundary (one shared timestamp, no per-token clock reads)
         if self._active:
             toks = self._decode_with_retry()
+            now = time.monotonic()
             for slot, req in list(self._active.items()):
-                self._append_token(req, int(toks[slot]),
-                                   time.monotonic())
+                self._append_token(req, int(toks[slot]), now)
 
         self._update_throughput()
         return self.has_work()

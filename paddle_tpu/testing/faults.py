@@ -23,6 +23,10 @@ accepted by :func:`configure` directly::
     "slow_decode:delay=0.05,steps=3"     first 3 decode steps sleep
     "decode_error:fails=1"               first decode step(s) raise
     "replica_kill:nth=5"                 5th decode step dies FATALLY
+    "mutate_signature:nth=3"             3rd zero-dispatch replay runs on
+                                         a silently-perturbed signature
+    "mutate_signature:nth=3,mode=aval"   ... perturbing a recorded arg
+                                         aval (fingerprint-visible)
 
 Points (consumed by the named subsystems):
 
@@ -38,6 +42,7 @@ Points (consumed by the named subsystems):
     slow_decode         serving/engine.decode_step               delay, steps
     decode_error        serving/engine.decode_step (transient)   fails
     replica_kill        serving/engine.decode_step (fatal)       nth
+    mutate_signature    core/lazy.ReplayStep._replay             nth, mode
     ==================  =======================================  ============
 
 Each firing bumps `fault.injected.<point>` in the telemetry registry and
@@ -227,6 +232,20 @@ def fire(point, step=None, rank=None, path=None, op=None):
         raise RuntimeError(
             f"injected transient decode failure "
             f"({ent['count']}/{int(p.get('fails', 1))})")
+
+    if point == "mutate_signature":
+        # fires on the nth zero-dispatch replay; the ReplayStep then
+        # perturbs its armed snapshot (mode=scalar: one pinned leaf
+        # VALUE, invisible to the per-step fingerprint — only the
+        # periodic audit's cross-check catches it; mode=aval: a recorded
+        # arg aval, caught by the very next fingerprint check)
+        ent["count"] += 1
+        if ent["count"] != int(p.get("nth", 1)):
+            return False
+        _record(point, f"replay signature perturbed "
+                       f"(mode={p.get('mode', 'scalar')}) at fast step "
+                       f"{ent['count']}")
+        return True
 
     if point == "replica_kill":
         ent["count"] += 1

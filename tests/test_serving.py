@@ -526,6 +526,74 @@ class TestWeightHotSwap:
             srv.stop_watcher()
 
 
+class TestDecodeFastPath:
+    """ISSUE 9: the steady decode iteration runs on prebuilt device-side
+    slot state (one fingerprint check + one executable call); rebuilds
+    happen only at batch boundaries (admission/evict/swap/reprime) and a
+    periodic audit cross-checks device copies against the host mirrors.
+    The bitwise-parity tests above already prove tokens are unchanged —
+    these pin the fast/rebuild/audit accounting."""
+
+    def test_steady_window_runs_fast_and_audits_clean(self):
+        srv = GenerationServer(_build_model(seed=31), max_batch_size=2,
+                               buckets=(8,), max_queue_size=16)
+        srv.engine._audit_every = 5
+        srv.start()
+        try:
+            srv.generate([1, 2, 3], max_new_tokens=2)  # warm both steps
+            f0 = dict(registry.counters("fastpath"))
+            reqs = [srv.submit([3 + i, 4, 5], max_new_tokens=24, seed=i)
+                    for i in range(2)]
+            for r in reqs:
+                assert r.result(120).status == RequestStatus.DONE
+            f1 = dict(registry.counters("fastpath"))
+            fast = f1["decode_fast_steps"] - f0["decode_fast_steps"]
+            rebuilds = f1["decode_rebuilds"] - f0["decode_rebuilds"]
+            audits = f1["decode_audit_runs"] - f0["decode_audit_runs"]
+            assert fast > rebuilds, (fast, rebuilds)
+            assert audits >= 1  # the 5-step cadence fired in the window
+            assert f1["decode_demotions"] == f0["decode_demotions"]
+        finally:
+            srv.shutdown(timeout=30)
+
+    def test_mutations_invalidate_and_mirrors_track_device(self):
+        from paddle_tpu.serving.engine import GenerationEngine
+
+        eng = GenerationEngine(_build_model(seed=32), max_batch_size=2,
+                               buckets=(8,), rng_seed=5)
+        eng.prefill(0, [1, 2, 3], seed=0)
+        eng.prefill(1, [4, 5, 6], seed=1)
+        assert eng._fast is None  # admission invalidated it
+        f0 = dict(registry.counters("fastpath"))
+        eng.decode_step()  # rebuild + re-arm
+        for _ in range(5):
+            eng.decode_step()  # steady: fast
+        f1 = dict(registry.counters("fastpath"))
+        assert f1["decode_rebuilds"] - f0["decode_rebuilds"] == 1
+        assert f1["decode_fast_steps"] - f0["decode_fast_steps"] == 5
+        fast = eng._fast
+        assert fast is not None
+        # host mirrors advance in lockstep with the device copies
+        assert np.array_equal(np.asarray(fast[1]), eng._cur_lens)
+        assert np.array_equal(np.asarray(fast[3]), eng._gen_idx)
+        assert np.array_equal(np.asarray(fast[0]), eng._last_tokens)
+        # eviction is a batch-boundary event: next decode rebuilds
+        eng.release(1)
+        assert eng._fast is None
+        eng.decode_step()
+        f2 = dict(registry.counters("fastpath"))
+        assert f2["decode_rebuilds"] - f1["decode_rebuilds"] == 1
+        # a weight swap drops the cached weight tuple AND the fast
+        # state: the first post-swap decode rebuilds through the radar
+        eng.swap_weights(_np_state(_build_model(seed=33)),
+                         source="fastpath-test")
+        assert eng._state_tuple is None and eng._fast is None
+        eng.decode_step()
+        f3 = dict(registry.counters("fastpath"))
+        assert f3["decode_rebuilds"] - f2["decode_rebuilds"] == 1
+        assert eng._state_tuple is not None  # rebuilt on demand
+
+
 class TestStepRetry:
     @pytest.fixture(autouse=True)
     def _disarm(self):

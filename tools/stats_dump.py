@@ -69,10 +69,41 @@ _TRAIN_SERVE_KEYS = frozenset((
     "fault.elastic.generation_bumps"))
 
 
+def _print_fastpath(counters, gauges):
+    """Replay-fast-path health (ISSUE 9): hit rate, audit cadence and
+    demotion causes — the three numbers that say whether the steady
+    window really ran with zero per-op Python."""
+    fp = {k: counters.pop(k) for k in list(counters)
+          if k.startswith("fastpath.")}
+    fp.update({k: gauges.pop(k) for k in list(gauges)
+               if k.startswith("fastpath.")})
+    if not fp:
+        return
+    print("fast path (replay-by-signature):")
+    hits = fp.get("fastpath.hits", 0)
+    misses = fp.get("fastpath.misses", 0)
+    audits = fp.get("fastpath.audit_runs", 0)
+    if hits + misses:
+        fp.setdefault("fastpath.hit_rate",
+                      round(hits / (hits + misses), 4))
+    if audits:
+        fp["fastpath.steps_per_audit"] = round((hits + misses) / audits, 1)
+    causes = {k: v for k, v in fp.items()
+              if k.startswith("fastpath.demote.")}
+    _print_counters({k: v for k, v in fp.items() if k not in causes})
+    if causes:
+        print("  demotion causes:")
+        _print_counters(causes, indent="    ")
+
+
 def _print_snapshot(snap):
     counters = dict(snap.get("counters") or {})
     timings = dict(snap.get("timings") or {})
     gauges = dict(snap.get("gauges") or {})
+    # replay fast path (ISSUE 9) leads: if the hit rate is low or the
+    # demotion causes are busy, every other per-step number below is
+    # measuring the slow path
+    _print_fastpath(counters, gauges)
     # sharding / SPMD lowering (ISSUE 6) first among the specialist
     # sections: step_compiles and python_collectives_per_step ARE the
     # one-compilation health check (1-2 compiles total, 0 per-step
