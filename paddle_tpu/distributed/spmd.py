@@ -55,9 +55,9 @@ from ..core import lazy as _lazy
 from ..profiler import registry as _registry
 
 __all__ = ["enable", "disable", "enabled", "current_mesh", "spmd_guard",
-           "mesh_from_hcg", "param_pspec", "per_arg_specs",
-           "is_single_spec", "shard_model", "shard_batch",
-           "describe_plans"]
+           "mesh_from_hcg", "serving_mesh", "param_pspec",
+           "per_arg_specs", "is_single_spec", "shard_model",
+           "shard_batch", "describe_plans"]
 
 # shared scope with core/lazy.py (step_compiles / python_collectives /
 # python_collectives_per_step are bumped there and in collective.py)
@@ -126,6 +126,25 @@ def mesh_from_hcg(hcg):
     # (d*sh + s)*mp + m either way, so the two meshes may coexist
     devs = np.array(jax.devices()[: dp * mp]).reshape(dp, mp)
     return Mesh(devs, ("dp", "mp"))
+
+
+def serving_mesh(mp=None):
+    """One-axis ``('mp',)`` decode mesh over the first ``mp`` local
+    devices (default: all of them) — the serving engine's tensor-parallel
+    topology (``GenerationEngine(..., mesh=serving_mesh(2))``). Serving
+    has no batch axis to shard (continuous batching keeps the batch
+    small and latency-bound), so unlike the train mesh this is pure
+    model parallelism; the engine derives weight placement from the same
+    ``sharding_spec`` annotations via :func:`param_pspec`. The mesh is
+    NOT installed globally (no :func:`enable`): decode runs eagerly
+    inside its own jit, never through the lazy capture engine."""
+    devs = jax.devices()
+    mp = len(devs) if mp is None else int(mp)
+    if mp < 1 or mp > len(devs):
+        raise ValueError(
+            f"serving_mesh: mp={mp} outside [1, {len(devs)}] available "
+            "devices")
+    return Mesh(np.array(devs[:mp]), ("mp",))
 
 
 def enable(mesh: Mesh):

@@ -294,7 +294,9 @@ def create_generation_engine(model, **engine_options):
     One-shot dense inference stays on `create_predictor` (a saved
     StableHLO artifact); generation is a live-model loop, so this entry
     takes the model object, not a Config. `engine_options` forward to
-    GenerationEngine (`max_batch_size`, `buckets`, `max_seq_len`)."""
+    GenerationEngine (`max_batch_size`, `buckets`, `max_seq_len`,
+    `block_size`, `num_blocks`, `mesh` — see serving.block_pool for the
+    paged-KV knobs, distributed.spmd.serving_mesh for sharded decode)."""
     from ..serving import GenerationEngine
 
     return GenerationEngine(model, **engine_options)

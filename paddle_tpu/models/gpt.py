@@ -130,10 +130,66 @@ class GPTAttention(nn.Layer):
         self.qkv_proj.weight.sharding_spec = (None, "mp")
         self.out_proj.weight.sharding_spec = ("mp", None)
 
-    def forward(self, x, cache=None, cache_offset=None, seq_lens=None):
+    def forward(self, x, cache=None, cache_offset=None, seq_lens=None,
+                block_tables=None):
         B, T, D = x.shape
         qkv = self.qkv_proj(x).reshape([B, T, 3, self.n_head, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
+        if cache is not None and block_tables is not None:
+            # Paged-cache path (paddle_tpu.serving, ISSUE 10): `cache` is
+            # the SHARED fixed-shape block pool [num_blocks, block_size,
+            # H, Dh]; `block_tables` [B, M] maps each slot's logical
+            # block j to a physical pool block, so slots of wildly
+            # different lengths (and slots SHARING immutable prefix
+            # blocks) live in one buffer with zero copies. The T new rows
+            # scatter into the flattened pool at rows derived from the
+            # table; attention gathers each slot's logical view back out
+            # and masks exactly like the contiguous slot path. Block 0 is
+            # the reserved garbage block: writes for rows outside
+            # [0, seq_len) (bucket padding, inactive decode lanes)
+            # redirect there so they can never clobber live blocks.
+            k_pool, v_pool = cache
+            Nb, bs = k_pool.shape[0], k_pool.shape[1]
+            M = block_tables.shape[1]
+            S = M * bs
+            rows = cache_offset.unsqueeze(1) + ops.arange(0, T,
+                                                          dtype="int32")
+            blk = ops.clip(rows // bs, max=M - 1)
+            phys = ops.take_along_axis(block_tables, blk, axis=1)
+            writable = rows < seq_lens.unsqueeze(-1)
+            flat_rows = ops.where(writable, phys * bs + rows % bs,
+                                  ops.zeros_like(rows))
+            k_flat = k_pool.reshape([Nb * bs, self.n_head, self.head_dim])
+            v_flat = v_pool.reshape([Nb * bs, self.n_head, self.head_dim])
+            widx = ops.broadcast_to(
+                flat_rows.reshape([B * T]).unsqueeze(-1).unsqueeze(-1),
+                [B * T, self.n_head, self.head_dim])
+            k_flat = ops.put_along_axis(
+                k_flat, widx,
+                k.reshape([B * T, self.n_head, self.head_dim]), axis=0)
+            v_flat = ops.put_along_axis(
+                v_flat, widx,
+                v.reshape([B * T, self.n_head, self.head_dim]), axis=0)
+            slot_rows = ((block_tables * bs).unsqueeze(-1)
+                         + ops.arange(0, bs, dtype="int32")).reshape([B, S])
+            k_view = ops.gather(k_flat, slot_rows.reshape([-1]),
+                                axis=0).reshape(
+                                    [B, S, self.n_head, self.head_dim])
+            v_view = ops.gather(v_flat, slot_rows.reshape([-1]),
+                                axis=0).reshape(
+                                    [B, S, self.n_head, self.head_dim])
+            jpos = ops.arange(0, S, dtype="int32")
+            mask = ops.logical_and(
+                jpos.unsqueeze(0).unsqueeze(0) <= rows.unsqueeze(-1),
+                jpos.unsqueeze(0).unsqueeze(0)
+                < seq_lens.unsqueeze(-1).unsqueeze(-1))
+            out = F.scaled_dot_product_attention(
+                q, k_view, v_view, attn_mask=mask.unsqueeze(1),
+                is_causal=False, dropout_p=self.dropout_p,
+                training=self.training)
+            out = self.out_proj(out.reshape([B, T, D]))
+            return out, (k_flat.reshape(k_pool.shape),
+                         v_flat.reshape(v_pool.shape))
         if cache is not None and cache_offset is not None:
             # Slot-cache path (paddle_tpu.serving): `cache` is a
             # preallocated [B, S, H, Dh] buffer; the T new rows are written
@@ -210,11 +266,13 @@ class GPTBlock(nn.Layer):
         x = x + self.dropout(self.attn(self.ln1(x)))
         return x + self.mlp(self.ln2(x))
 
-    def forward(self, x, cache=None, cache_offset=None, seq_lens=None):
+    def forward(self, x, cache=None, cache_offset=None, seq_lens=None,
+                block_tables=None):
         if cache is not None:
             a, new_cache = self.attn(self.ln1(x), cache=cache,
                                      cache_offset=cache_offset,
-                                     seq_lens=seq_lens)
+                                     seq_lens=seq_lens,
+                                     block_tables=block_tables)
             x = x + self.dropout(a)
             return x + self.mlp(self.ln2(x)), new_cache
         if self._recompute and self.training:
@@ -259,7 +317,7 @@ class GPTModel(nn.Layer):
             self.to(dtype=cfg.dtype)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                cache_offsets=None, seq_lens=None):
+                cache_offsets=None, seq_lens=None, block_tables=None):
         if caches is not None and cache_offsets is None:
             _warn_legacy_cache()
         x = self.embeddings(input_ids, position_ids)
@@ -267,7 +325,7 @@ class GPTModel(nn.Layer):
             new_caches = []
             for blk, c in zip(self.blocks, caches):
                 x, nc = blk(x, cache=c, cache_offset=cache_offsets,
-                            seq_lens=seq_lens)
+                            seq_lens=seq_lens, block_tables=block_tables)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
         for blk in self.blocks:

@@ -5,17 +5,23 @@ serving layer is AnalysisPredictor (one-shot ``Predictor.run()``, mirrored
 by ``paddle_tpu.inference``); generation traffic needs the opposite shape —
 long-lived, mid-flight batching, KV-cache reuse. This package provides it,
 following Orca's iteration-level continuous batching (Yu et al., OSDI'22)
-and vLLM's preallocate-don't-grow cache management (Kwon et al., SOSP'23),
-re-designed for XLA's static-shape world: length BUCKETS instead of pages,
-one contiguous slot-major cache instead of an indirection table, so prefill
-compiles once per bucket and the decode step compiles exactly once.
+and vLLM's paged cache management (Kwon et al., SOSP'23), re-designed for
+XLA's static-shape world: a fixed-shape KV block pool addressed through
+per-slot block tables plus length buckets for prefill, so prefill
+compiles once per bucket and the decode step compiles exactly once. A
+RadixAttention-style prefix cache shares immutable prompt blocks between
+requests by refcount (a system prompt is prefilled once, bitwise-equal
+to the cold path), and passing ``mesh=spmd.serving_mesh(mp)`` shards
+weights + KV pools over ``'mp'`` so models larger than one chip serve.
 
 Layers (one file each):
-  * ``engine``    — compiled prefill/decode over a preallocated slot cache
-  * ``scheduler`` — bounded admission queue + per-request stop conditions
-  * ``sampling``  — greedy/temperature/top-k/top-p, seed-deterministic
-  * ``server``    — threaded submit()/result()/generate() frontend with
-                    backpressure, deadlines, and SIGTERM-style drain
+  * ``engine``     — compiled prefill/decode over the paged block pool
+  * ``block_pool`` — refcounted block allocator + radix prefix tree
+  * ``scheduler``  — bounded admission queue (budgeting KV blocks, not
+                     just slots) + per-request stop conditions
+  * ``sampling``   — greedy/temperature/top-k/top-p, seed-deterministic
+  * ``server``     — threaded submit()/result()/generate() frontend with
+                     backpressure, deadlines, and SIGTERM-style drain
 
 Resilience (ISSUE 7 — the train→serve loop): ``server.swap_weights`` /
 ``server.watch_checkpoints`` hot-swap weights between decode steps without
@@ -35,6 +41,8 @@ Quickstart::
     print(server.result(req).tokens)      # or: server.generate(prompt_ids)
     server.shutdown()                     # graceful drain
 """
+from .block_pool import (  # noqa: F401
+    BlockPool, PagePoolExhausted, RadixPrefixCache)
 from .engine import (  # noqa: F401
     FatalEngineError, GenerationEngine, WeightSwapError)
 from .scheduler import (  # noqa: F401
@@ -47,5 +55,6 @@ from . import sampling  # noqa: F401
 __all__ = [
     "GenerationEngine", "ContinuousBatchScheduler", "GenerationRequest",
     "QueueFullError", "RequestStatus", "GenerationServer",
-    "ReplicaSupervisor", "WeightSwapError", "FatalEngineError", "sampling",
+    "ReplicaSupervisor", "WeightSwapError", "FatalEngineError",
+    "BlockPool", "PagePoolExhausted", "RadixPrefixCache", "sampling",
 ]

@@ -1,25 +1,39 @@
-"""paddle_tpu.serving.engine — slot-major generation engine for decoders.
+"""paddle_tpu.serving.engine — paged-KV generation engine for decoders.
 
 The continuous-batching design follows Orca (Yu et al., OSDI'22): the unit
 of scheduling is one decode ITERATION, not one request, so finished slots
 are evicted and refilled mid-flight without touching their neighbors. The
-cache-management idea follows vLLM's PagedAttention (Kwon et al.,
-SOSP'23) in spirit — preallocate KV memory up front instead of growing
-per-token — but adapted to XLA's static-shape world: instead of pages and
-an indirection table (a gather per attention read), the cache is one
-contiguous ``[max_batch, max_seq_len, heads, head_dim]`` buffer per layer,
-slot-major, and PROMPT shapes are padded to a small set of length buckets.
+cache is vLLM-style paged (Kwon et al., SOSP'23), adapted to XLA's
+static-shape world: per layer ONE fixed-shape block pool
+``[num_blocks, block_size, heads, head_dim]``, addressed through per-slot
+int32 block tables — an indirection gather per attention read buys
+(a) per-request memory proportional to ``prompt + max_new_tokens`` instead
+of a full ``max_seq_len`` slab, and (b) prefix sharing: a radix tree over
+block-aligned prompt chunks (RadixAttention-style) hands immutable prefix
+blocks to new requests by refcount, so a system prompt shared by thousands
+of requests is prefilled ONCE (``serving.prefix_hits`` /
+``serving.prefix_hit_tokens`` count the saved work).
 
 Compile discipline (the whole point on a TPU):
 
-* prefill compiles once per bucket — the input is ``[1, bucket_len]``, the
-  real prompt length is data (``prompt_len`` array), never a shape;
+* prefill compiles once per bucket — the input is the ``[1, L]``
+  bucket-padded SUFFIX of the prompt (the part after the cached prefix);
+  prompt length, prefix length and the block table are data, never shapes,
+  so cold prefills and prefix hits share one executable per bucket;
 * the decode step compiles exactly once — fixed ``[max_batch, 1]`` query,
-  in-place ``dynamic_update_slice``-style cache writes at per-slot
-  positions (via ``ops.put_along_axis`` inside the model's slot-cache
-  forward path), valid-length masking instead of shape changes;
+  in-place scatter writes into the flattened pool at block-table-derived
+  rows, valid-length masking instead of shape changes;
 * every per-request difference (current length, sampling config, RNG key,
-  activity) is an ARRAY argument, so no workload mix can retrace.
+  activity, block table) is an ARRAY argument, so no workload mix can
+  retrace.
+
+Sharded decode (ISSUE 10): pass ``mesh=`` (see
+``distributed.spmd.serving_mesh``) and the engine places weights by their
+``sharding_spec`` annotations (``param_pspec``, same derivation as the
+SPMD train step) and the KV pools head-sharded over the ``'mp'`` axis —
+GSPMD partitions the compiled steps, so models larger than one chip serve
+with zero code changes elsewhere. All host-built step inputs are placed
+mesh-replicated; the replay fast path below is layout-agnostic.
 
 The engine tracks call signatures itself, mirroring ``jax.jit``'s aval
 cache: any signature first-seen bumps ``serving.prefill_compiles`` /
@@ -30,13 +44,14 @@ slowdown. Host spans (``serving_prefill`` / ``serving_decode_step``) and
 ``serving.*`` counters/timings ride the same observability stack as the
 training runtime.
 
-Slot lifecycle: free → (prefill: prompt rows written at offset 0, first
-token sampled) → active (each decode step appends one row at the slot's
-own cursor) → released (eviction = flipping a host bit; the stale rows are
-masked by the next occupant's ``seq_lens`` until its prefill overwrites
-them). Inactive slots still flow through the decode step — their lane
-computes garbage that nothing reads — because a data-dependent batch size
-would be a shape change.
+Slot lifecycle: free → (admission: blocks allocated/shared, suffix
+prefill, first token sampled) → active (each decode step appends one row
+at the slot's own cursor, always inside its OWN blocks — shared prefix
+blocks are never written after insertion) → released (blocks decref'd
+back to the pool; the block table row is zeroed so the lane's masked
+garbage writes land in reserved block 0). Inactive slots still flow
+through the decode step — their lane computes garbage that nothing reads —
+because a data-dependent batch size would be a shape change.
 """
 from __future__ import annotations
 
@@ -55,19 +70,25 @@ from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
 from ..testing import faults as _faults
 from . import sampling as _sampling
+from .block_pool import BlockPool, PagePoolExhausted, RadixPrefixCache
 
 _counters = _registry.scoped_counters("serving", {
     "prefills": 0, "decode_steps": 0, "tokens_generated": 0,
     "active_slot_steps": 0, "prefill_compiles": 0, "decode_compiles": 0,
-    "bucket_promotions": 0, "weight_swaps": 0, "reprimes": 0})
+    "bucket_promotions": 0, "weight_swaps": 0, "reprimes": 0,
+    "prefix_hits": 0, "prefix_misses": 0, "prefix_hit_tokens": 0,
+    "prefix_inserted_blocks": 0, "prefix_evicted_blocks": 0,
+    "kv_blocks_hwm": 0})
 
 # Decode replay fast path (ISSUE 9, same machinery as lazy.ReplayStep):
 # in the steady window a decode iteration is one fingerprint check (the
 # prebuilt device-side arg tuple IS the fingerprint — every slot/weight/
 # executable mutation clears it) plus one executable call; the per-slot
 # state advances ON DEVICE inside the step instead of being re-uploaded
-# from host numpy every iteration. A periodic audit cross-checks the
-# device copies against the host mirrors.
+# from host numpy every iteration. Block tables ride the same tuple as
+# device-resident step inputs (they only change at batch boundaries,
+# which rebuild anyway). A periodic audit cross-checks the device copies
+# against the host mirrors.
 _fp_counters = _registry.scoped_counters("fastpath", {
     "decode_fast_steps": 0, "decode_rebuilds": 0, "decode_audit_runs": 0,
     "decode_demotions": 0})
@@ -101,15 +122,17 @@ def _default_buckets(max_seq_len):
 
 
 class GenerationEngine:
-    """Wraps a decoder LM (GPT first) with a preallocated slot-major KV
-    cache and compiled prefill/decode steps. The engine owns device compute
-    and per-slot state; request lifecycle (stop conditions, queueing) lives
-    in ``serving.scheduler``. Not thread-safe — drive it from one thread
+    """Wraps a decoder LM (GPT first) with a paged block-pool KV cache and
+    compiled prefill/decode steps. The engine owns device compute,
+    per-slot state and the block/prefix bookkeeping; request lifecycle
+    (stop conditions, queueing, block-budget admission) lives in
+    ``serving.scheduler``. Not thread-safe — drive it from one thread
     (``serving.GenerationServer`` does).
     """
 
     def __init__(self, model, max_batch_size=4, buckets=None,
-                 max_seq_len=None, rng_seed=None):
+                 max_seq_len=None, rng_seed=None, block_size=16,
+                 num_blocks=None, mesh=None):
         gpt = getattr(model, "gpt", model)
         if not hasattr(gpt, "blocks") or not hasattr(gpt, "embeddings"):
             raise TypeError(
@@ -136,6 +159,21 @@ class GenerationEngine:
                 f"no usable prompt buckets in {buckets!r} "
                 f"(need 0 < bucket <= max_seq_len={self.max_seq_len})")
 
+        # paged-KV geometry: each slot addresses at most blocks_per_slot
+        # blocks through its table row; the pool defaults to capacity
+        # parity with the old contiguous layout (every slot CAN fill to
+        # max_seq_len) plus the reserved garbage block — shrink
+        # num_blocks to oversubscribe and lean on prefix sharing +
+        # admission backpressure
+        self.block_size = int(block_size)
+        if self.block_size < 1:
+            raise ValueError("block_size must be >= 1")
+        self.blocks_per_slot = -(-self.max_seq_len // self.block_size)
+        if num_blocks is None:
+            num_blocks = 1 + self.max_batch_size * self.blocks_per_slot
+        self.pool = BlockPool(num_blocks)
+        self.prefix_cache = RadixPrefixCache(self.pool, self.block_size)
+
         # generation is inference: dropout off, or padded lanes would
         # perturb nothing but sampled RNG streams would diverge
         if hasattr(model, "eval"):
@@ -152,13 +190,45 @@ class GenerationEngine:
             i for i, n in enumerate(self._names) if self._state[n] is wt)
         self._dtype = wt._data.dtype
 
-        B, S = self.max_batch_size, self.max_seq_len
-        self._kv_shapes = [(B, S, blk.attn.n_head, blk.attn.head_dim)
+        # mesh-sharded decode: weights placed by their sharding_spec
+        # annotations (same param_pspec derivation as the SPMD train
+        # step), KV pools head-sharded over 'mp', every host-built step
+        # input replicated — GSPMD partitions the compiled steps
+        self._mesh = mesh
+        self._repl = None
+        kv_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from ..distributed import spmd as _spmd
+
+            self._repl = NamedSharding(mesh, PartitionSpec())
+            for n in self._names:
+                t = self._state[n]
+                arr = _lazy.force(t._data)
+                pspec = _spmd.param_pspec(
+                    getattr(t, "sharding_spec", None), mesh,
+                    tuple(arr.shape))
+                t._data = jax.device_put(arr, NamedSharding(mesh, pspec))
+            axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+            mp = int(axes.get("mp", 1))
+            heads_ok = mp > 1 and all(
+                blk.attn.n_head % mp == 0 for blk in gpt.blocks)
+            kv_sharding = NamedSharding(
+                mesh, PartitionSpec(None, None, "mp", None) if heads_ok
+                else PartitionSpec())
+
+        Nb, bs = self.pool.num_blocks, self.block_size
+        self._kv_shapes = [(Nb, bs, blk.attn.n_head, blk.attn.head_dim)
                            for blk in gpt.blocks]
         self._k = [jnp.zeros(s, self._dtype) for s in self._kv_shapes]
         self._v = [jnp.zeros(s, self._dtype) for s in self._kv_shapes]
+        if kv_sharding is not None:
+            self._k = [jax.device_put(a, kv_sharding) for a in self._k]
+            self._v = [jax.device_put(a, kv_sharding) for a in self._v]
 
         # host-side slot state, mirrored into the decode step as arrays
+        B = self.max_batch_size
         self._active = np.zeros(B, bool)
         self._cur_lens = np.zeros(B, np.int32)
         self._last_tokens = np.zeros(B, np.int32)
@@ -167,6 +237,11 @@ class GenerationEngine:
         self._top_ks = np.zeros(B, np.int32)
         self._top_ps = np.ones(B, np.float32)
         self._keys = np.zeros((B, 2), np.uint32)
+        # per-slot block tables: row of physical block ids, zero-padded
+        # (block 0 = reserved garbage block); _slot_blocks holds the ids
+        # each slot has a pool reference on
+        self._block_tables = np.zeros((B, self.blocks_per_slot), np.int32)
+        self._slot_blocks = [[] for _ in range(B)]
 
         # seed-determinism root: one split of the global generator, so
         # paddle_tpu.seed(s) pins every sampled token this engine produces.
@@ -181,12 +256,12 @@ class GenerationEngine:
             self._base_key = jax.random.PRNGKey(int(rng_seed))
         self._seed_counter = itertools.count()
 
-        # donate the KV buffers (args 1, 2) so the per-step cache update
+        # donate the KV pools (args 1, 2) so the per-step cache update
         # is truly in place on device — without it XLA copies the whole
-        # [B, S, H, Dh]-per-layer cache every decode step. Accelerator
-        # only: XLA-CPU intermittently SIGABRTs with many donated
-        # executables co-resident in one process (hybrid_engine._compile
-        # has the same gate for the same reason).
+        # pool every decode step. Accelerator only: XLA-CPU
+        # intermittently SIGABRTs with many donated executables
+        # co-resident in one process (hybrid_engine._compile has the
+        # same gate for the same reason).
         self._donate = (1, 2) if jax.devices()[0].platform != "cpu" else ()
         self._prefill_jit = jax.jit(self._prefill_pure,
                                     donate_argnums=self._donate)
@@ -204,6 +279,14 @@ class GenerationEngine:
         self._decode_since_audit = 0
         self._audit_every = _lazy.AUDIT_EVERY
 
+    def _put(self, x):
+        """Host → device for step inputs: plain asarray single-chip,
+        mesh-replicated placement when sharded (a single-device-committed
+        input cannot join mesh-committed weights in one jit)."""
+        if self._repl is None:
+            return jnp.asarray(x)
+        return jax.device_put(jnp.asarray(x), self._repl)
+
     # ------------------------------------------------------------- slots --
     def free_slots(self):
         return [i for i in range(self.max_batch_size) if not self._active[i]]
@@ -212,9 +295,16 @@ class GenerationEngine:
         return [i for i in range(self.max_batch_size) if self._active[i]]
 
     def release(self, slot):
-        """Evict a finished request: a host-bit flip. The slot's cache rows
-        stay until the next occupant's prefill overwrites them — masked by
-        seq_lens in the meantime, so no scrub pass is needed."""
+        """Evict a finished request: drop the slot's pool references and
+        zero its table row (its lane now scribbles into the reserved
+        garbage block). Shared prefix blocks stay alive through the radix
+        tree's own reference — only truly dead blocks return to the free
+        list."""
+        if self._slot_blocks[slot]:
+            self.pool.decref(self._slot_blocks[slot])
+            self._slot_blocks[slot] = []
+            self._note_pool()
+        self._block_tables[slot] = 0
         self._active[slot] = False
         self._cur_lens[slot] = 0
         self._gen_idx[slot] = 0
@@ -241,6 +331,46 @@ class GenerationEngine:
             f"prompt length {prompt_len} exceeds the largest bucket "
             f"{self.buckets[-1]} (buckets={self.buckets})")
 
+    # --------------------------------------------------- block budgeting --
+    def _budget_rows(self, prompt_len, max_new_tokens):
+        """Worst-case KV rows a request can ever write: its prompt plus
+        its token budget, capped by the cache ceiling. Allocating this up
+        front at admission means generation can NEVER run out of blocks
+        mid-flight — pool pressure is answered with admission
+        backpressure, not a truncated response."""
+        if max_new_tokens is None:
+            return self.max_seq_len
+        return min(prompt_len + int(max_new_tokens), self.max_seq_len)
+
+    def blocks_needed(self, prompt_len, max_new_tokens=None):
+        b = self._budget_rows(prompt_len, max_new_tokens)
+        return -(-b // self.block_size)
+
+    def can_admit(self, prompt_ids, max_new_tokens=None):
+        """Admission budget check for the scheduler: can the pool cover
+        this request's worst case, counting cold prefix blocks as
+        evictable? Conservative on purpose — it ignores the prefix-hit
+        discount, so a True here guarantees ``prefill`` cannot raise
+        ``PagePoolExhausted`` (a matched block either still stands, which
+        only lowers the real need, or was evicted into the free count)."""
+        if _faults.ACTIVE and _faults.fire("page_pool_exhausted"):
+            return False
+        need = self.blocks_needed(len(prompt_ids), max_new_tokens)
+        return need <= (self.pool.free_count()
+                        + self.prefix_cache.evictable_count())
+
+    def _evict(self, n):
+        freed = self.prefix_cache.evict(n)
+        if freed:
+            _counters["prefix_evicted_blocks"] += freed
+        return freed
+
+    def _note_pool(self):
+        used = self.pool.in_use()
+        _registry.gauge_set("serving.kv_blocks_in_use", used)
+        if used > _counters["kv_blocks_hwm"]:
+            _counters["kv_blocks_hwm"] = used
+
     # ----------------------------------------------------- pure step fns --
     def _state_arrays(self):
         # cached between weight swaps: walking hundreds of Tensor
@@ -254,8 +384,8 @@ class GenerationEngine:
         return cached
 
     def _forward_slot(self, state_arrays, ids, positions, ks, vs, offsets,
-                      seq_lens):
-        """Run the model's slot-cache forward path on traced arrays by
+                      seq_lens, block_tables):
+        """Run the model's paged-cache forward path on traced arrays by
         temporarily binding them into the layer parameters (the
         jit.StaticFunction state-swap idiom). Trace-time only — the jitted
         executables never re-enter Python."""
@@ -268,7 +398,8 @@ class GenerationEngine:
                 hidden, new_caches = self._gpt(
                     Tensor(ids), position_ids=Tensor(positions),
                     caches=caches, cache_offsets=Tensor(offsets),
-                    seq_lens=Tensor(seq_lens))
+                    seq_lens=Tensor(seq_lens),
+                    block_tables=Tensor(block_tables))
             return (hidden._data,
                     tuple(c[0]._data for c in new_caches),
                     tuple(c[1]._data for c in new_caches))
@@ -276,25 +407,28 @@ class GenerationEngine:
             for n in self._names:
                 self._state[n]._data = old[n]
 
-    def _prefill_pure(self, state_arrays, ks, vs, ids, prompt_len, slot,
-                      key, temp, top_k, top_p):
-        """One request's prompt pass at bucket shape [1, L]: compute its KV
-        rows in a fresh [1, L] cache, sample the first token at position
-        prompt_len-1, then splice the rows into the big slot cache at
-        (slot, 0) — a true dynamic_update_slice, in place under XLA."""
+    def _prefill_pure(self, state_arrays, ks, vs, ids, prompt_len,
+                      prefix_len, block_table, key, temp, top_k, top_p):
+        """One request's prompt-SUFFIX pass at bucket shape [1, L]: the
+        tokens after the cached prefix are embedded at absolute positions
+        prefix_len.., their KV rows scatter through the block table into
+        the pool, attention reads the slot's whole logical view (cached
+        prefix blocks included), and the first token is sampled at the
+        prompt's true last position. A cold prefill is the SAME program
+        with prefix_len == 0 — prefix length is data, never a shape, so
+        hits and misses share one executable per bucket (and stay
+        token-bitwise: same program, same reduction order)."""
         L = ids.shape[1]
-        positions = jnp.arange(L, dtype=jnp.int32)[None]
-        zero_ks = [jnp.zeros((1, L, s[2], s[3]), self._dtype)
-                   for s in self._kv_shapes]
-        zero_vs = [jnp.zeros((1, L, s[2], s[3]), self._dtype)
-                   for s in self._kv_shapes]
-        offsets = jnp.zeros((1,), jnp.int32)
+        positions = jnp.minimum(
+            prefix_len[:, None] + jnp.arange(L, dtype=jnp.int32)[None],
+            self.max_seq_len - 1)
         hidden, nk, nv = self._forward_slot(
-            state_arrays, ids, positions, zero_ks, zero_vs, offsets,
-            prompt_len)
+            state_arrays, ids, positions, ks, vs, prefix_len, prompt_len,
+            block_table)
+        last_local = prompt_len - 1 - prefix_len
         last = jnp.take_along_axis(
             hidden,
-            jnp.broadcast_to((prompt_len - 1)[:, None, None],
+            jnp.broadcast_to(last_local[:, None, None],
                              (1, 1, hidden.shape[2])).astype(jnp.int32),
             axis=1)[:, 0]
         w = state_arrays[self._emb_idx]
@@ -302,28 +436,25 @@ class GenerationEngine:
         gum = _sampling.gumbel_rows(key[None], jnp.zeros((1,), jnp.int32),
                                     logits.shape[-1])
         tok = _sampling.sample_tokens(logits, temp, top_k, top_p, gum)
-        zero = jnp.zeros((), slot.dtype)
-        start = (slot, zero, zero, zero)
-        new_k = tuple(jax.lax.dynamic_update_slice(K, rows, start)
-                      for K, rows in zip(ks, nk))
-        new_v = tuple(jax.lax.dynamic_update_slice(V, rows, start)
-                      for V, rows in zip(vs, nv))
-        return tok, new_k, new_v
+        return tok, nk, nv
 
     def _decode_pure(self, state_arrays, ks, vs, last_tokens, cur_lens,
-                     keys, gen_idx, temps, top_ks, top_ps, active):
+                     keys, gen_idx, temps, top_ks, top_ps, active,
+                     block_tables):
         """One decode iteration for EVERY slot at fixed [B, 1] shape: feed
-        each slot's last token at its own position, write its KV row in
-        place, sample its next token. Inactive lanes compute garbage that
-        the host discards — batch membership is data, not shape. The
-        per-slot cursors advance IN the step (masked by ``active``) so
-        the steady fast path keeps them on device instead of re-uploading
-        host mirrors every iteration."""
+        each slot's last token at its own position, scatter its KV row
+        through its block table, sample its next token. Inactive lanes
+        compute garbage that the host discards — their zeroed table rows
+        aim every write at the reserved garbage block, so batch
+        membership is data, not shape, and a dead lane can never corrupt
+        a live slot's blocks. The per-slot cursors advance IN the step
+        (masked by ``active``) so the steady fast path keeps them on
+        device instead of re-uploading host mirrors every iteration."""
         ids = last_tokens[:, None]
         positions = jnp.minimum(cur_lens, self.max_seq_len - 1)[:, None]
         hidden, nk, nv = self._forward_slot(
             state_arrays, ids, positions, ks, vs,
-            positions[:, 0], cur_lens + 1)
+            positions[:, 0], cur_lens + 1, block_tables)
         w = state_arrays[self._emb_idx]
         logits = (hidden[:, 0].astype(jnp.float32)
                   @ w.T.astype(jnp.float32))
@@ -378,7 +509,11 @@ class GenerationEngine:
         untouched: in-flight requests keep their prefix state and simply
         decode their next token under the new weights, and because the
         new arrays have the same avals the compiled decode step replays
-        with ZERO recompiles."""
+        with ZERO recompiles. The PREFIX cache, however, is flushed: its
+        blocks hold KV computed under the old weights, and reusing them
+        would serve a franken-model (prefix under old weights, suffix
+        under new) — the weight-generation bump makes every cached prefix
+        unmatchable, so post-swap requests recompute their prefixes."""
         resolved = self._resolve_swap_state(state)
         staged = []
         for n in self._names:
@@ -413,6 +548,8 @@ class GenerationEngine:
                         f"{tuple(cur.shape)}, swap offers "
                         f"{tuple(a.shape)} — this is a different model")
                 arr = jnp.asarray(a, cur.dtype)
+                if self._mesh is not None:
+                    arr = jax.device_put(arr, cur.sharding)
             staged.append(arr)
         if _faults.ACTIVE:
             _faults.fire("kill_during_swap")
@@ -420,16 +557,21 @@ class GenerationEngine:
             self._state[n]._data = arr
         # drop the cached weight tuple AND the decode fast path: the
         # first post-swap decode rebuilds + re-runs the signature radar
-        # (an audited first step, same contract as lazy drop_plans)
+        # (an audited first step, same contract as lazy drop_plans).
+        # The prefix cache is invalidated by generation bump (satellite
+        # 1): old-weight KV blocks must never serve the new weights.
         self._state_tuple = None
         self._fast = None
+        self.prefix_cache.new_generation()
+        self._note_pool()
         _counters["weight_swaps"] += 1
         _explain.record(
             "serving_weight_swap", op="swap_weights",
             why=f"swapped {len(staged)} weights"
                 + (f" from {source}" if source else "")
                 + "; in-flight requests keep their KV cache and decode "
-                  "the next token on the new weights",
+                  "the next token on the new weights; the prefix cache "
+                  "is flushed (old-weight KV is unreusable)",
             weights=len(staged), source=source)
 
     def reprime(self):
@@ -438,12 +580,17 @@ class GenerationEngine:
         retries one decode after a step error before failing the batch.
         The compile radar mirrors jax.jit's aval cache, so the decode
         signatures are forgotten with it — the retry's recompile must
-        count in ``decode_compiles``, not hide behind a stale entry."""
+        count in ``decode_compiles``, not hide behind a stale entry. The
+        prefix cache is flushed too: a fault mid-step may have left
+        cached prefix blocks in an unknown state, and recomputing a
+        prefix is cheap next to serving a corrupt one."""
         self._decode_jit = jax.jit(self._decode_pure,
                                    donate_argnums=self._donate)
         self._seen_sigs = {s for s in self._seen_sigs
                            if s[0] != "decode"}
         self._fast = None  # fresh executable: audited rebuild first
+        self.prefix_cache.new_generation()
+        self._note_pool()
         _counters["reprimes"] += 1
 
     # ----------------------------------------------------- compile radar --
@@ -467,38 +614,91 @@ class GenerationEngine:
 
     # ------------------------------------------------------------ prefill --
     def prefill(self, slot, prompt_ids, temperature=0.0, top_k=0,
-                top_p=1.0, seed=None):
-        """Admit a prompt into `slot`: pad it to its bucket, run the
-        compiled prefill, install the slot state. Returns the first
-        generated token (so TTFT == prefill latency)."""
+                top_p=1.0, seed=None, max_new_tokens=None):
+        """Admit a prompt into `slot`: match its longest cached block
+        prefix (shared blocks join the slot's table by refcount, their
+        prefill FLOPs skipped), allocate fresh blocks for the suffix +
+        generation budget, run the compiled suffix prefill, install the
+        slot state and publish the prompt's full blocks into the prefix
+        cache. Returns the first generated token (TTFT == prefill
+        latency). Raises ``PagePoolExhausted`` when the pool cannot cover
+        the request even after evicting cold prefixes (the scheduler's
+        ``can_admit`` pre-check makes that unreachable in normal
+        operation)."""
         if self._active[slot]:
             raise RuntimeError(f"slot {slot} is still active")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        L = self.bucket_for(len(prompt))
+        if len(prompt) < 1:
+            raise ValueError("prompt must contain at least one token")
+        if len(prompt) > self.buckets[-1]:
+            raise ValueError(
+                f"prompt length {len(prompt)} exceeds the largest bucket "
+                f"{self.buckets[-1]} (buckets={self.buckets})")
         if len(prompt) >= self.max_seq_len:
             raise ValueError(
                 f"prompt length {len(prompt)} leaves no room to generate "
                 f"(max_seq_len={self.max_seq_len})")
+        bs = self.block_size
+
+        # longest cached block-aligned prefix, capped so at least the
+        # prompt's last token is always recomputed (its hidden state
+        # feeds the first sample)
+        matched = self.prefix_cache.match(prompt)
+        max_full = (len(prompt) - 1) // bs
+        matched = matched[:max_full]
+        P = len(matched) * bs
+        need = self.blocks_needed(len(prompt), max_new_tokens) \
+            - len(matched)
+        self.pool.incref(matched)  # pin before eviction can run
+        try:
+            fresh = self.pool.alloc(need, evict=self._evict)
+        except PagePoolExhausted:
+            self.pool.decref(matched)
+            raise
+        table_ids = matched + fresh
+        bt_row = np.zeros(self.blocks_per_slot, np.int32)
+        bt_row[:len(table_ids)] = table_ids
+
+        suffix = prompt[P:]
+        L = self.bucket_for(len(suffix))
         ids = np.zeros((1, L), np.int32)
-        ids[0, :len(prompt)] = prompt
+        ids[0, :len(suffix)] = suffix
         if seed is None:
             seed = next(self._seed_counter)
         key = np.asarray(_sampling.request_key(self._base_key, seed),
                          np.uint32)
         args = (self._state_arrays(), tuple(self._k), tuple(self._v),
-                jnp.asarray(ids), jnp.asarray([len(prompt)], np.int32),
-                jnp.asarray(slot, np.int32), jnp.asarray(key),
-                jnp.asarray([temperature], np.float32),
-                jnp.asarray([top_k], np.int32),
-                jnp.asarray([top_p], np.float32))
+                self._put(ids),
+                self._put(np.asarray([len(prompt)], np.int32)),
+                self._put(np.asarray([P], np.int32)),
+                self._put(bt_row[None]), self._put(key),
+                self._put(np.asarray([temperature], np.float32)),
+                self._put(np.asarray([top_k], np.int32)),
+                self._put(np.asarray([top_p], np.float32)))
         self._note_signature(
             "prefill", args,
             f"bucket_len={L}, max_batch={self.max_batch_size}")
-        with RecordEvent("serving_prefill"), \
-                _registry.time_block("prefill", scope="serving"):
-            tok, nk, nv = self._prefill_jit(*args)
-            tok = int(np.asarray(tok)[0])
+        try:
+            with RecordEvent("serving_prefill"), \
+                    _registry.time_block("prefill", scope="serving"):
+                tok, nk, nv = self._prefill_jit(*args)
+                tok = int(np.asarray(tok)[0])
+        except Exception:
+            self.pool.decref(table_ids)  # failed admission leaks nothing
+            raise
         self._k, self._v = list(nk), list(nv)
+        if P:
+            _counters["prefix_hits"] += 1
+            _counters["prefix_hit_tokens"] += P
+        else:
+            _counters["prefix_misses"] += 1
+        full = len(prompt) // bs
+        if full:
+            created = self.prefix_cache.insert(prompt[:full * bs],
+                                               table_ids[:full])
+            _counters["prefix_inserted_blocks"] += created
+        self._slot_blocks[slot] = table_ids
+        self._block_tables[slot] = bt_row
         self._active[slot] = True
         self._cur_lens[slot] = len(prompt)
         self._last_tokens[slot] = tok
@@ -508,6 +708,7 @@ class GenerationEngine:
         self._top_ps[slot] = top_p
         self._keys[slot] = key
         self._fast = None  # admission is a batch-boundary event: rebuild
+        self._note_pool()
         _counters["prefills"] += 1
         _counters["tokens_generated"] += 1
         return tok
@@ -559,11 +760,11 @@ class GenerationEngine:
         host mirrors (a batch-boundary event — admission, eviction,
         weight swap, reprime — invalidated it), run the signature radar,
         then re-arm the fast path for the next iteration."""
-        tail = (jnp.asarray(self._last_tokens),
-                jnp.asarray(self._cur_lens), jnp.asarray(self._keys),
-                jnp.asarray(self._gen_idx), jnp.asarray(self._temps),
-                jnp.asarray(self._top_ks), jnp.asarray(self._top_ps),
-                jnp.asarray(active))
+        tail = (self._put(self._last_tokens),
+                self._put(self._cur_lens), self._put(self._keys),
+                self._put(self._gen_idx), self._put(self._temps),
+                self._put(self._top_ks), self._put(self._top_ps),
+                self._put(active), self._put(self._block_tables))
         args = (self._state_arrays(), tuple(self._k), tuple(self._v)) + tail
         self._note_signature(
             "decode", args,
@@ -603,7 +804,8 @@ class GenerationEngine:
         ok = (np.array_equal(np.asarray(fast[0]), self._last_tokens)
               and np.array_equal(np.asarray(fast[1]), self._cur_lens)
               and np.array_equal(np.asarray(fast[3]), self._gen_idx)
-              and np.array_equal(np.asarray(fast[7]), self._active))
+              and np.array_equal(np.asarray(fast[7]), self._active)
+              and np.array_equal(np.asarray(fast[8]), self._block_tables))
         if not ok:
             _fp_counters["decode_demotions"] += 1
             self._fast = None
@@ -621,6 +823,17 @@ class GenerationEngine:
         return _counters["active_slot_steps"] / (
             steps * self.max_batch_size)
 
+    def prefix_hit_rate(self):
+        hits = _counters["prefix_hits"]
+        total = hits + _counters["prefix_misses"]
+        return hits / total if total else 0.0
+
     def stats(self):
         return {**_registry.counters("serving"),
-                "mean_occupancy": self.mean_occupancy()}
+                "mean_occupancy": self.mean_occupancy(),
+                "prefix_hit_rate": self.prefix_hit_rate(),
+                "kv_blocks_total": self.pool.usable_blocks,
+                "kv_blocks_in_use": self.pool.in_use(),
+                "kv_blocks_free": self.pool.free_count(),
+                "prefix_cache_nodes": len(self.prefix_cache),
+                "weight_generation": self.prefix_cache.generation}

@@ -28,12 +28,14 @@ import time
 
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
+from .block_pool import PagePoolExhausted
 from .engine import FatalEngineError
 
 _counters = _registry.scoped_counters("serving", {
     "requests_submitted": 0, "requests_completed": 0,
     "requests_rejected": 0, "requests_timeout": 0, "requests_failed": 0,
-    "step_retries": 0, "swap_failures": 0, "requeued_requests": 0})
+    "step_retries": 0, "swap_failures": 0, "requeued_requests": 0,
+    "pool_exhausted": 0})
 
 
 class QueueFullError(RuntimeError):
@@ -286,16 +288,47 @@ class ContinuousBatchScheduler:
                     self._finish(req, RequestStatus.TIMEOUT)
 
             # (2) admission: fill free slots from the queue, one
-            # compiled prefill each
+            # compiled prefill each. Admission budgets KV BLOCKS, not
+            # just slots (ISSUE 10): a request only leaves the queue when
+            # the paged pool can cover its worst case (prompt + token
+            # budget, prefix-evictable blocks counted), so generation can
+            # never run out of cache mid-flight. A pool-exhausted head
+            # request simply stays queued — FIFO order is preserved, the
+            # queue backs up, and submit() turns the pressure into
+            # QueueFullError backpressure at the edge.
+            can_admit = getattr(self.engine, "can_admit", None)
             while True:
                 free = self.engine.free_slots()
                 if not free:
                     break
                 with self._lock:
+                    head = self._queue[0] if self._queue else None
+                if head is None:
+                    break
+                if can_admit is not None and not can_admit(
+                        head.prompt_ids, head.max_new_tokens):
+                    _counters["pool_exhausted"] += 1
+                    _explain.record(
+                        "serving_pool_exhausted", op="admission",
+                        why="KV block pool cannot cover the next queued "
+                            "request even after prefix eviction; leaving "
+                            "it queued (admission backpressure) until "
+                            "running requests release blocks",
+                        queued=len(self._queue))
+                    break
+                with self._lock:
+                    # step() is the only consumer and the deadline scan
+                    # above already ran, so the head we budgeted is still
+                    # the head we pop
                     req = self._queue.popleft() if self._queue else None
                 if req is None:
                     break
-                self._admit(req, free[0])
+                if not self._admit(req, free[0]):
+                    # prefill hit pool pressure despite the budget check
+                    # and the request went back to the head: stop
+                    # admitting THIS step (retrying in this loop would
+                    # spin forever) and let decode progress free blocks
+                    break
 
         # (3) one decode iteration over every active slot; per-request
         # stop-condition bookkeeping happens once per iteration at this
@@ -344,11 +377,26 @@ class ContinuousBatchScheduler:
 
     # ----------------------------------------------------------- helpers --
     def _admit(self, req, slot):
+        """Prefill `req` into `slot`. Returns False when admission hit
+        pool pressure and the request was requeued (the caller must stop
+        admitting this step — retrying immediately would spin); True for
+        every terminal outcome (admitted or failed)."""
         t_start = time.monotonic()
         try:
             first = self.engine.prefill(
                 slot, req.prompt_ids, temperature=req.temperature,
-                top_k=req.top_k, top_p=req.top_p, seed=req.seed)
+                top_k=req.top_k, top_p=req.top_p, seed=req.seed,
+                max_new_tokens=req.max_new_tokens)
+        except PagePoolExhausted:
+            # can_admit's conservative budget makes this unreachable in
+            # normal operation (belt and braces for fault injection /
+            # future over-commit policies): the request goes BACK to the
+            # queue head un-finished — backpressure, never a truncated
+            # or failed generation
+            _counters["pool_exhausted"] += 1
+            with self._lock:
+                self._queue.appendleft(req)
+            return False
         except Exception as e:
             # the request left the queue but never reached _active, so
             # fail it HERE — nothing else (fail_all iterates _active) can
@@ -358,7 +406,7 @@ class ContinuousBatchScheduler:
             self._finish(req, RequestStatus.ERROR, error=str(e))
             if not isinstance(e, (ValueError, TypeError)):
                 raise
-            return
+            return True
         req.slot = slot
         req.status = RequestStatus.RUNNING
         self._active[slot] = req
@@ -368,6 +416,7 @@ class ContinuousBatchScheduler:
         req.ttft_s = now - req.submit_ts
         _registry.timing("ttft", req.ttft_s, scope="serving")
         self._append_token(req, first, now)
+        return True
 
     def _append_token(self, req, token, now):
         req.tokens.append(token)

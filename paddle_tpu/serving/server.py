@@ -37,14 +37,17 @@ from .scheduler import (ContinuousBatchScheduler, GenerationRequest,
 class GenerationServer:
     def __init__(self, model=None, engine=None, max_batch_size=4,
                  buckets=None, max_seq_len=None, max_queue_size=16,
-                 idle_wait_s=0.005, fail_fast_on_fatal=True):
+                 idle_wait_s=0.005, fail_fast_on_fatal=True,
+                 block_size=16, num_blocks=None, mesh=None):
         if engine is None:
             if model is None:
                 raise ValueError("GenerationServer needs a model or an "
                                  "engine")
             engine = GenerationEngine(model, max_batch_size=max_batch_size,
                                       buckets=buckets,
-                                      max_seq_len=max_seq_len)
+                                      max_seq_len=max_seq_len,
+                                      block_size=block_size,
+                                      num_blocks=num_blocks, mesh=mesh)
         self.engine = engine
         self.scheduler = ContinuousBatchScheduler(
             engine, max_queue_size=max_queue_size)

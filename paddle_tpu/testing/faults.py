@@ -23,6 +23,8 @@ accepted by :func:`configure` directly::
     "slow_decode:delay=0.05,steps=3"     first 3 decode steps sleep
     "decode_error:fails=1"               first decode step(s) raise
     "replica_kill:nth=5"                 5th decode step dies FATALLY
+    "page_pool_exhausted:times=3"        first 3 admission budget checks
+                                         report the KV block pool full
     "mutate_signature:nth=3"             3rd zero-dispatch replay runs on
                                          a silently-perturbed signature
     "mutate_signature:nth=3,mode=aval"   ... perturbing a recorded arg
@@ -42,6 +44,7 @@ Points (consumed by the named subsystems):
     slow_decode         serving/engine.decode_step               delay, steps
     decode_error        serving/engine.decode_step (transient)   fails
     replica_kill        serving/engine.decode_step (fatal)       nth
+    page_pool_exhausted serving/engine.can_admit (admission)     times
     mutate_signature    core/lazy.ReplayStep._replay             nth, mode
     ==================  =======================================  ============
 
@@ -213,6 +216,19 @@ def fire(point, step=None, rank=None, path=None, op=None):
         _record(point, "weight swap killed between validation and commit")
         raise RuntimeError(
             "injected failure during weight swap (kill_during_swap)")
+
+    if point == "page_pool_exhausted":
+        # fires in engine.can_admit: the scheduler must answer a full KV
+        # block pool with admission backpressure (requests stay queued,
+        # submit() raises QueueFullError at the edge, the
+        # serving.pool_exhausted counter climbs) — never a crash and
+        # never a silently truncated generation
+        if ent["count"] >= int(p.get("times", 1)):
+            return False
+        ent["count"] += 1
+        _record(point, f"KV block pool reported exhausted at admission "
+                       f"check #{ent['count']}")
+        return True
 
     if point == "slow_decode":
         ent["count"] += 1

@@ -96,6 +96,30 @@ def _print_fastpath(counters, gauges):
         _print_counters(causes, indent="    ")
 
 
+_KV_POOL_PREFIXES = ("serving.prefix_", "serving.kv_blocks")
+_KV_POOL_KEYS = frozenset(("serving.pool_exhausted",))
+
+
+def _print_kv_pool(counters, gauges):
+    """Paged-KV + prefix-cache health (ISSUE 10): the hit rate and the
+    blocks-in-use high-water mark say whether shared-prompt traffic is
+    actually sharing, and pool_exhausted says whether admission is
+    backpressuring on cache memory."""
+    kv = {k: counters.pop(k) for k in list(counters)
+          if k.startswith(_KV_POOL_PREFIXES) or k in _KV_POOL_KEYS}
+    kv.update({k: gauges.pop(k) for k in list(gauges)
+               if k.startswith(_KV_POOL_PREFIXES) or k in _KV_POOL_KEYS})
+    if not kv:
+        return
+    print("kv pool (paged + prefix cache):")
+    hits = kv.get("serving.prefix_hits", 0)
+    misses = kv.get("serving.prefix_misses", 0)
+    if hits + misses:
+        kv.setdefault("serving.prefix_hit_rate",
+                      round(hits / (hits + misses), 4))
+    _print_counters(kv)
+
+
 def _print_snapshot(snap):
     counters = dict(snap.get("counters") or {})
     timings = dict(snap.get("timings") or {})
@@ -124,6 +148,9 @@ def _print_snapshot(snap):
         print("train->serve loop:")
         _print_counters(ts_counters)
         _print_counters(ts_gauges)
+    # kv pool (ISSUE 10) claims its serving.* keys before the general
+    # serving section so cache-memory health reads as one table
+    _print_kv_pool(counters, gauges)
     # serving telemetry (ISSUE 5) first: TTFT / tokens-per-sec / occupancy
     # are the operator's serving health triple, pulled out of the general
     # tables (counters, timings AND the throughput/occupancy gauges)
