@@ -39,11 +39,15 @@ _counters = _registry.scoped_counters("fault", {
     "elastic.generation_bumps": 0})
 
 
-def publish_generation(store, world, log=None):
+def publish_generation(store, world, log=None, scope="elastic"):
     """Publish a new elastic generation through a rendezvous store so
     watchers re-rendezvous with a restarted member. Shared by the launch
-    ``Pod`` (trainer restarts) and the serving ``ReplicaSupervisor``
-    (replica restarts) — one protocol, one implementation.
+    ``Pod`` (trainer restarts), the serving ``ReplicaSupervisor``
+    (replica restarts) and the serving ``ServingFleet`` (pod restarts,
+    ``scope="serving"``) — one protocol, one implementation. ``scope``
+    is the store key prefix: a serving fleet sharing a trainer's store
+    publishes under its own namespace so the two supervision planes
+    cannot race each other's generation counters.
 
     Mirrors ``ElasticManager._publish`` exactly: exclusive claim via
     ``add()==1`` (a racing publisher must not double-bump), members
@@ -55,13 +59,13 @@ def publish_generation(store, world, log=None):
     if store is None:
         return False
     try:
-        gen = int(store.add("elastic/gen", 0))
-        if int(store.add(f"elastic/claim/{gen + 1}", 1)) != 1:
+        gen = int(store.add(f"{scope}/gen", 0))
+        if int(store.add(f"{scope}/claim/{gen + 1}", 1)) != 1:
             return False  # another publisher owns generation gen+1
         members = ",".join(str(r) for r in range(int(world)))
-        store.set(f"elastic/members/{gen + 1}", members)
-        if int(store.add("elastic/gen", 0)) == gen:
-            store.add("elastic/gen", 1)
+        store.set(f"{scope}/members/{gen + 1}", members)
+        if int(store.add(f"{scope}/gen", 0)) == gen:
+            store.add(f"{scope}/gen", 1)
         _counters["elastic.generation_bumps"] += 1
         return True
     except Exception as e:  # rendezvous best-effort: restart anyway

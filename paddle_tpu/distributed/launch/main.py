@@ -99,7 +99,8 @@ class Pod:
     """
 
     def __init__(self, max_restarts=3, restart_backoff=1.0,
-                 terminate_grace=10.0, store=None, log=None):
+                 terminate_grace=10.0, store=None, log=None,
+                 generation_scope="elastic"):
         self.procs: list[subprocess.Popen] = []
         self.specs: list[tuple] = []  # (cmd, env, log_path) per local rank
         self.restarts: list[int] = []
@@ -107,6 +108,11 @@ class Pod:
         self.restart_backoff = float(restart_backoff)
         self.terminate_grace = float(terminate_grace)
         self.store = store
+        # rendezvous-store key prefix for generation bumps: trainer pods
+        # publish under "elastic/", a serving fleet sharing the same
+        # store publishes under "serving/" so the two supervision planes
+        # can't race each other's generations (serving/fleet.py)
+        self.generation_scope = str(generation_scope)
         self._log = log or (lambda msg: print(f"[launch] {msg}",
                                               file=sys.stderr, flush=True))
 
@@ -146,7 +152,17 @@ class Pod:
             # kill the pod supervisor mid-restart
             self._log(f"elastic generation bump failed: {e}")
             return
-        publish_generation(self.store, world, log=self._log)
+        publish_generation(self.store, world, log=self._log,
+                           scope=self.generation_scope)
+
+    def respawn(self, i):
+        """Respawn local rank ``i`` in place (new process, same spec,
+        restart count in env) after publishing a fresh generation.
+        Shared by :meth:`watch` and the serving-fleet supervisor
+        (``serving/fleet.py``), which reuses this Pod's spawn/backoff/
+        terminate conventions for pods that never exit on their own."""
+        self._bump_generation()
+        self._respawn(i)
 
     def watch(self):
         """Supervise until every rank exits 0 (return 0), a rank exhausts
@@ -165,8 +181,7 @@ class Pod:
                     if respawn_at[i] is not None:
                         if now >= respawn_at[i]:
                             respawn_at[i] = None
-                            self._bump_generation()
-                            self._respawn(i)
+                            self.respawn(i)
                         continue
                     rc = p.poll()
                     if rc is None:

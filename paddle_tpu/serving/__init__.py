@@ -31,6 +31,15 @@ automatically, merging N-rank shards via ``incubate.checkpoint``);
 backoff, replays their requests bitwise by seed, and autoscales the fleet
 off queue-depth/occupancy telemetry.
 
+Cross-process fleet (ISSUE 11): ``ServingFleet`` (``fleet``) promotes the
+replica contracts to real subprocess PODS under the launch stack's
+supervision conventions, fronted by a ``FleetRouter`` (``router``) that
+spreads load, routes by radix-prefix affinity, replays a dead pod's
+requests bitwise, and backpressures only at fleet-wide admission
+exhaustion; ``roles=("prefill", "decode")`` disaggregates prompt and
+decode work with a block-table KV handoff (``pod_worker`` is the pod
+process entry point).
+
 Quickstart::
 
     from paddle_tpu.serving import GenerationServer
@@ -48,7 +57,10 @@ from .engine import (  # noqa: F401
 from .scheduler import (  # noqa: F401
     ContinuousBatchScheduler, GenerationRequest, QueueFullError,
     RequestStatus)
-from .server import GenerationServer  # noqa: F401
+from .fleet import ServingFleet  # noqa: F401
+from .router import FleetRequest, FleetRouter, PodClient  # noqa: F401
+from .server import (  # noqa: F401
+    CheckpointFollower, GenerationServer)
 from .supervisor import ReplicaSupervisor  # noqa: F401
 from . import sampling  # noqa: F401
 
@@ -57,4 +69,6 @@ __all__ = [
     "QueueFullError", "RequestStatus", "GenerationServer",
     "ReplicaSupervisor", "WeightSwapError", "FatalEngineError",
     "BlockPool", "PagePoolExhausted", "RadixPrefixCache", "sampling",
+    "ServingFleet", "FleetRouter", "FleetRequest", "PodClient",
+    "CheckpointFollower",
 ]

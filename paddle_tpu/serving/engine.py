@@ -78,7 +78,8 @@ _counters = _registry.scoped_counters("serving", {
     "bucket_promotions": 0, "weight_swaps": 0, "reprimes": 0,
     "prefix_hits": 0, "prefix_misses": 0, "prefix_hit_tokens": 0,
     "prefix_inserted_blocks": 0, "prefix_evicted_blocks": 0,
-    "kv_blocks_hwm": 0})
+    "kv_blocks_hwm": 0, "handoff_exports": 0, "handoff_imports": 0,
+    "handoff_stale": 0})
 
 # Decode replay fast path (ISSUE 9, same machinery as lazy.ReplayStep):
 # in the steady window a decode iteration is one fingerprint check (the
@@ -99,6 +100,15 @@ class WeightSwapError(RuntimeError):
     extra names, shape mismatch, incompatible device placement). Raised
     BEFORE any weight is replaced — the engine keeps serving the old
     weights, and the KV cache is never touched."""
+
+
+class StaleHandoffError(RuntimeError):
+    """A handed-off KV payload was exported under a different weight
+    generation than this engine is serving — adopting it would decode
+    new weights over old-weight prompt KV (and publish stale blocks
+    into the prefix cache). The scheduler answers this by re-prefilling
+    the prompt locally under the CURRENT weights, which is exactly what
+    a monolithic pod that swapped before the request would have done."""
 
 
 class FatalEngineError(RuntimeError):
@@ -713,6 +723,137 @@ class GenerationEngine:
         _counters["tokens_generated"] += 1
         return tok
 
+    # --------------------------------------------- prefill→decode handoff --
+    def export_request_kv(self, slot):
+        """Serialize an active slot's paged-KV state for a cross-pod
+        handoff (disaggregated serving, ISSUE 11): the slot's physical
+        blocks are gathered out of every layer's pool in block-table
+        order, together with the per-slot decode state (cursor, last
+        token, RNG key, sampling knobs). ``import_request_kv`` on ANY
+        engine with the same model + block geometry reproduces the slot
+        exactly, and because sampling depends only on (request key,
+        token index) and the KV bytes are carried verbatim, decoding
+        there is token-BITWISE with decoding here — a prefill pod can
+        hand its finished prompt KV to a decode pod and the stream is
+        indistinguishable from a monolithic pod's."""
+        if not self._active[slot]:
+            raise RuntimeError(f"slot {slot} is not active; nothing to "
+                               "export")
+        ids = list(self._slot_blocks[slot])
+        idx = jnp.asarray(np.asarray(ids, np.int32))
+        ks = [np.asarray(jnp.take(a, idx, axis=0)) for a in self._k]
+        vs = [np.asarray(jnp.take(a, idx, axis=0)) for a in self._v]
+        _counters["handoff_exports"] += 1
+        return {
+            "n_blocks": len(ids),
+            "block_size": self.block_size,
+            "kv_k": ks, "kv_v": vs,
+            "cur_len": int(self._cur_lens[slot]),
+            "last_token": int(self._last_tokens[slot]),
+            "gen_idx": int(self._gen_idx[slot]),
+            "key": np.asarray(self._keys[slot]).copy(),
+            "temperature": float(self._temps[slot]),
+            "top_k": int(self._top_ks[slot]),
+            "top_p": float(self._top_ps[slot]),
+            "weight_generation": self.prefix_cache.generation,
+        }
+
+    def can_import(self, payload):
+        """Admission budget check for a handed-off slot: the pool must
+        cover the payload's block count (prefill already allocated the
+        request's WORST CASE — prompt + token budget — so an import can
+        never run out of blocks mid-flight either). Same conservative
+        contract as ``can_admit``: True guarantees ``import_request_kv``
+        cannot raise ``PagePoolExhausted``."""
+        if _faults.ACTIVE and _faults.fire("page_pool_exhausted"):
+            return False
+        return int(payload["n_blocks"]) <= (
+            self.pool.free_count() + self.prefix_cache.evictable_count())
+
+    def import_request_kv(self, slot, payload, prompt_ids=None):
+        """Adopt a slot exported by :meth:`export_request_kv` on another
+        engine: allocate fresh blocks, scatter the handed-off KV rows
+        into this engine's pools, install the slot state. Returns the
+        request's first generated token (sampled by the exporting
+        engine) so the scheduler's admission path can append it exactly
+        as it would a local prefill's. Passing ``prompt_ids`` publishes
+        the prompt's full blocks into THIS engine's prefix cache too, so
+        a handed-off shared prefix keeps earning hits on the decode
+        pod."""
+        if self._active[slot]:
+            raise RuntimeError(f"slot {slot} is still active")
+        gen = payload.get("weight_generation")
+        if gen is not None and int(gen) != self.prefix_cache.generation:
+            # a weight swap landed between the export and this import:
+            # the payload's KV belongs to another weight generation
+            # (same invalidation rule the prefix cache enforces locally)
+            _counters["handoff_stale"] += 1
+            raise StaleHandoffError(
+                f"handoff exported under weight generation {gen}, this "
+                f"engine serves generation "
+                f"{self.prefix_cache.generation}; re-prefill under the "
+                "current weights instead of adopting stale KV")
+        n = int(payload["n_blocks"])
+        if int(payload["block_size"]) != self.block_size:
+            raise ValueError(
+                f"handoff block_size {payload['block_size']} != engine "
+                f"block_size {self.block_size} — pods must share one KV "
+                "geometry")
+        if n > self.blocks_per_slot:
+            raise ValueError(
+                f"handoff carries {n} blocks but a slot holds at most "
+                f"{self.blocks_per_slot}")
+        if len(payload["kv_k"]) != len(self._k):
+            raise ValueError(
+                f"handoff has {len(payload['kv_k'])} layers, engine has "
+                f"{len(self._k)} — different model")
+        for li, kb in enumerate(payload["kv_k"]):
+            want = self._kv_shapes[li][1:]
+            if tuple(np.shape(kb))[1:] != tuple(want):
+                raise ValueError(
+                    f"handoff layer {li} block shape "
+                    f"{tuple(np.shape(kb))[1:]} != engine {tuple(want)}")
+        fresh = self.pool.alloc(n, evict=self._evict)
+        idx = jnp.asarray(np.asarray(fresh, np.int32))
+        try:
+            for li in range(len(self._k)):
+                kb = jnp.asarray(np.asarray(payload["kv_k"][li]),
+                                 self._dtype)
+                vb = jnp.asarray(np.asarray(payload["kv_v"][li]),
+                                 self._dtype)
+                if self._repl is not None:
+                    kb = jax.device_put(kb, self._repl)
+                    vb = jax.device_put(vb, self._repl)
+                self._k[li] = self._k[li].at[idx].set(kb)
+                self._v[li] = self._v[li].at[idx].set(vb)
+        except Exception:
+            self.pool.decref(fresh)  # failed adoption leaks nothing
+            raise
+        bt_row = np.zeros(self.blocks_per_slot, np.int32)
+        bt_row[:n] = fresh
+        if prompt_ids is not None:
+            prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+            full = min(len(prompt) // self.block_size, n)
+            if full:
+                created = self.prefix_cache.insert(
+                    prompt[:full * self.block_size], fresh[:full])
+                _counters["prefix_inserted_blocks"] += created
+        self._slot_blocks[slot] = fresh
+        self._block_tables[slot] = bt_row
+        self._active[slot] = True
+        self._cur_lens[slot] = int(payload["cur_len"])
+        self._last_tokens[slot] = int(payload["last_token"])
+        self._gen_idx[slot] = int(payload["gen_idx"])
+        self._temps[slot] = float(payload["temperature"])
+        self._top_ks[slot] = int(payload["top_k"])
+        self._top_ps[slot] = float(payload["top_p"])
+        self._keys[slot] = np.asarray(payload["key"], np.uint32)
+        self._fast = None  # admission is a batch-boundary event: rebuild
+        self._note_pool()
+        _counters["handoff_imports"] += 1
+        _counters["tokens_generated"] += 1  # the adopted first token
+        return int(payload["last_token"])
+
     # ------------------------------------------------------------- decode --
     def decode_step(self):
         """One continuous-batching iteration over all slots; returns the
@@ -732,6 +873,7 @@ class GenerationEngine:
             raise RuntimeError("decode_step with no active slots")
         if _faults.ACTIVE:
             _faults.fire("slow_decode")
+            _faults.fire("pod_slow")
             _faults.fire("replica_kill")
             _faults.fire("decode_error")
         fast = self._fast

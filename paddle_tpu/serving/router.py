@@ -1,0 +1,671 @@
+"""paddle_tpu.serving.router — prefix-aware request router for a pod fleet.
+
+The front end of the cross-host serving fleet (ISSUE 11): ``ServingFleet``
+(``serving/fleet.py``) owns pod PROCESSES; ``FleetRouter`` owns REQUESTS.
+It speaks the fleet wire protocol (newline-delimited JSON over a TCP
+connection per pod, ``PodClient``) and holds the routing policy:
+
+* **Load spreading** — pods are tried in ascending outstanding-request
+  order (acked-but-unfinished count, the router's own bookkeeping — a
+  slow pod accumulates outstanding work and organically receives less),
+  with each pod's last-reported queue depth / active count kept for
+  ``stats()``.
+
+* **Prefix affinity** (default policy) — requests are keyed by the PR 9
+  ``RadixPrefixCache`` block-aligned scheme: the first
+  ``affinity_blocks`` full ``block_size``-token chunks of the prompt
+  (prompts shorter than one block have no key and fall through to
+  least-loaded). A key is sticky to the pod that first served it, so
+  shared-system-prompt traffic lands where its KV blocks already live
+  and every request after the first is a radix-tree hit instead of a
+  recomputed prefill. When the sticky pod refuses (admission budget
+  exhausted) the request spills to the least-loaded pod and the key is
+  REMAPPED there — the prefix's KV will now live on the new pod, so
+  follow-up traffic should too. ``policy="round_robin"`` disables
+  affinity (the bench's comparison baseline).
+
+* **Backpressure** — a pod that answers ``reject`` is out of admission
+  budget. ``QueueFullError`` is raised ONLY when every eligible healthy
+  pod explicitly rejected; a pod that is down or mid-restart is not
+  "full", so its requests are HELD and replayed by the fleet monitor
+  once a pod returns (mirroring ``ReplicaSupervisor``'s orphan
+  handling).
+
+* **Loss recovery** — every request's sampling seed is pinned by the
+  router at first submission, so re-sending is IDEMPOTENT: a request
+  lost before the pod's ack (``router_drop`` injection, a dying
+  connection) is re-sent to the next candidate; a request orphaned by a
+  pod death (``pod_down``) is re-routed to a healthy pod and — because
+  pods are built with a fixed engine ``rng_seed`` — regenerates
+  BITWISE-identical tokens. Duplicated completions (the "lost" submit
+  actually landed) are harmless: the first ``done`` wins, later ones
+  are dropped, and pods themselves dedup re-sent submits by request id.
+
+* **Disaggregated routing** — with prefill/decode roles the router
+  pipelines each request through two pods: the least-loaded PREFILL pod
+  runs the prompt and returns the exported KV payload
+  (``engine.export_request_kv``), which the router forwards to a DECODE
+  pod chosen by the same affinity scheme; the decode pod adopts the
+  slot (``engine.import_request_kv``) and streams tokens. The handoff
+  rides the block-table serialization — raw block bytes, base64 over
+  the wire — and is token-bitwise with a monolithic pod.
+"""
+from __future__ import annotations
+
+import base64
+import itertools
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+from ..testing import faults as _faults
+from .scheduler import QueueFullError, RequestStatus
+
+__all__ = ["FleetRequest", "FleetRouter", "PodClient",
+           "pack_array", "unpack_array"]
+
+_counters = _registry.scoped_counters("fleet", {
+    "requests_routed": 0, "requests_completed": 0, "requests_failed": 0,
+    "router_rejects": 0, "router_resubmits": 0, "affinity_hits": 0,
+    "affinity_misses": 0, "affinity_spills": 0, "orphans_replayed": 0,
+    "handoffs": 0})
+
+
+# ------------------------------------------------------------ wire utils --
+def pack_array(a):
+    """numpy array → JSON-safe dict (raw little-endian bytes, base64).
+    Bitwise round-trip — the KV handoff and RNG keys must survive the
+    wire exactly."""
+    a = np.ascontiguousarray(a)
+    return {"shape": list(a.shape), "dtype": str(a.dtype),
+            "data": base64.b64encode(a.tobytes()).decode("ascii")}
+
+
+def unpack_array(d):
+    return np.frombuffer(
+        base64.b64decode(d["data"]), dtype=np.dtype(d["dtype"])
+    ).reshape(d["shape"]).copy()
+
+
+def pack_payload(payload):
+    """engine.export_request_kv dict → wire dict (arrays packed)."""
+    out = dict(payload)
+    out["kv_k"] = [pack_array(a) for a in payload["kv_k"]]
+    out["kv_v"] = [pack_array(a) for a in payload["kv_v"]]
+    out["key"] = pack_array(np.asarray(payload["key"], np.uint32))
+    return out
+
+
+def unpack_payload(wire):
+    out = dict(wire)
+    out["kv_k"] = [unpack_array(d) for d in wire["kv_k"]]
+    out["kv_v"] = [unpack_array(d) for d in wire["kv_v"]]
+    out["key"] = unpack_array(wire["key"])
+    return out
+
+
+class FleetRequest:
+    """Router-side request handle; mirrors ``GenerationRequest``'s
+    frontend surface (``result()`` / ``tokens`` / ``status``) so fleet
+    callers read like single-server callers. The sampling ``seed`` is
+    pinned by the router, which is what makes every re-send and
+    orphan replay bitwise-idempotent."""
+
+    def __init__(self, prompt_ids, options):
+        self.prompt_ids = [int(t) for t in prompt_ids]
+        if not self.prompt_ids:
+            raise ValueError("prompt_ids must not be empty")
+        self.options = dict(options)
+        self.rid = None
+        self.pod = None          # pod id the request is currently on
+        self.attempts = 0        # route attempts (resubmits included)
+        self.tokens: list = []
+        self.status = RequestStatus.QUEUED
+        self.stop_reason = None
+        self.error = None
+        self.finished = threading.Event()
+
+    @property
+    def done(self):
+        return self.finished.is_set()
+
+    def result(self, timeout=None):
+        if not self.finished.wait(timeout):
+            raise TimeoutError(
+                f"fleet request {self.rid} still {self.status} after "
+                f"waiting {timeout}s")
+        return self
+
+    def __repr__(self):
+        return (f"FleetRequest(rid={self.rid}, pod={self.pod}, "
+                f"status={self.status}, tokens={len(self.tokens)})")
+
+
+class PodClient:
+    """Line-JSON RPC client for one serving pod. One socket, one reader
+    thread; ``call()`` is a blocking request/response matched on ``mid``,
+    async ``done`` messages go to the router's callback. A dead
+    connection resolves every pending call with None immediately (the
+    caller treats that exactly like a lost message: re-route)."""
+
+    def __init__(self, pod_id, port=None, on_async=None,
+                 host="127.0.0.1", port_file=None):
+        if (port is None) == (port_file is None):
+            raise ValueError("PodClient needs exactly one of port / "
+                             "port_file")
+        self.pod_id = pod_id
+        self.host = host
+        self.port = None if port is None else int(port)
+        # port_file: the pod binds port 0 and publishes the assigned
+        # port here (no preallocation race); re-read every connect
+        # attempt so a respawned pod's fresh port is picked up
+        self.port_file = port_file
+        self._on_async = on_async
+        self._mid = itertools.count(1)
+        self._pending: dict = {}   # mid -> [Event, reply|None]
+        self._plock = threading.Lock()
+        self._slock = threading.Lock()  # writer serialization
+        self._sock = None
+        self._alive = False
+
+    @property
+    def alive(self):
+        return self._alive
+
+    def _resolve_port(self):
+        if self.port_file is None:
+            return self.port
+        try:
+            with open(self.port_file) as f:
+                return int(f.read().strip() or 0) or None
+        except (OSError, ValueError):
+            return None
+
+    def connect(self, timeout=60.0):
+        """Retry-connect until the pod's handler loop is up (the pod
+        binds its socket — and publishes its port — only after the
+        engine is built, so a successful connect doubles as the
+        readiness probe). Returns True on success."""
+        deadline = time.monotonic() + float(timeout)
+        while time.monotonic() < deadline:
+            port = self._resolve_port()
+            if port is None:
+                time.sleep(0.1)
+                continue
+            try:
+                s = socket.create_connection((self.host, port),
+                                             timeout=1.0)
+                s.settimeout(None)
+                # small JSON lines in a request/response pattern: Nagle
+                # + delayed-ACK stalls every ack ~40ms without this
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                break
+            except OSError:
+                time.sleep(0.1)
+        else:
+            return False
+        self._sock = s
+        self._alive = True
+        threading.Thread(target=self._read_loop, args=(s,), daemon=True,
+                         name=f"paddle-tpu-pod-client-{self.pod_id}"
+                         ).start()
+        return True
+
+    def reconnect(self, timeout=60.0):
+        self.close()
+        return self.connect(timeout)
+
+    def close(self):
+        self._alive = False
+        sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+        self._fail_pending()
+
+    def _fail_pending(self):
+        with self._plock:
+            pending, self._pending = self._pending, {}
+        for ev, _ in pending.values():
+            ev.set()
+
+    def _read_loop(self, sock):
+        try:
+            f = sock.makefile("r", encoding="utf-8")
+            for line in f:
+                try:
+                    msg = json.loads(line)
+                except ValueError:
+                    continue
+                mid = msg.get("mid")
+                if mid is not None:
+                    with self._plock:
+                        ent = self._pending.pop(mid, None)
+                    if ent is not None:
+                        ent[1] = msg
+                        ent[0].set()
+                        continue
+                try:
+                    self._on_async(self.pod_id, msg)
+                except Exception:
+                    pass  # a bad async handler must not kill the reader
+        except (OSError, ValueError):
+            pass
+        finally:
+            # only the ACTIVE connection's reader may fail pending
+            # calls: after a reconnect the dying old reader must not
+            # kill calls already registered on the new socket
+            if self._sock is sock:
+                self._alive = False
+                self._fail_pending()
+
+    def call(self, msg, timeout=15.0):
+        """Send ``msg`` and wait for its reply (matched on mid). Returns
+        the reply dict, or None when the message/ack was lost (dead or
+        dying connection, timeout)."""
+        if not self._alive or self._sock is None:
+            return None
+        mid = next(self._mid)
+        msg = dict(msg)
+        msg["mid"] = mid
+        ent = [threading.Event(), None]
+        with self._plock:
+            self._pending[mid] = ent
+        data = (json.dumps(msg) + "\n").encode("utf-8")
+        try:
+            with self._slock:
+                self._sock.sendall(data)
+        except (OSError, AttributeError):
+            with self._plock:
+                self._pending.pop(mid, None)
+            self._alive = False
+            return None
+        ent[0].wait(timeout)
+        with self._plock:
+            self._pending.pop(mid, None)
+        return ent[1]
+
+
+class _PodRec:
+    __slots__ = ("pod_id", "client", "role", "healthy", "outstanding",
+                 "queued", "active")
+
+    def __init__(self, pod_id, client, role):
+        self.pod_id = pod_id
+        self.client = client
+        self.role = role
+        self.healthy = True
+        self.outstanding: set = set()  # rids acked on this pod, not done
+        self.queued = 0
+        self.active = 0
+
+    @property
+    def load(self):
+        return len(self.outstanding)
+
+
+class FleetRouter:
+    """Route fleet requests across pod clients. Thread-safe frontend;
+    the fleet's monitor thread drives ``pod_down`` / ``pod_up`` /
+    ``redistribute``."""
+
+    def __init__(self, policy="prefix", block_size=16, affinity_blocks=2,
+                 ack_timeout=15.0, prefill_timeout=300.0):
+        if policy not in ("prefix", "round_robin", "least_loaded"):
+            raise ValueError(f"unknown routing policy {policy!r}")
+        self.policy = policy
+        self.block_size = int(block_size)
+        self.affinity_blocks = int(affinity_blocks)
+        self.ack_timeout = float(ack_timeout)
+        self.prefill_timeout = float(prefill_timeout)
+        self._pods: dict = {}       # pod_id -> _PodRec
+        self._reqs: dict = {}       # rid -> FleetRequest
+        self._affinity: dict = {}   # prefix key -> pod_id
+        self._held: list = []       # requests waiting for a healthy pod
+        self._lock = threading.Lock()
+        self._rid = itertools.count(1)
+        self._seeds = itertools.count()
+        self._rr = itertools.count()
+
+    # -------------------------------------------------------- membership --
+    def register_pod(self, pod_id, client, role="serve"):
+        with self._lock:
+            self._pods[pod_id] = _PodRec(pod_id, client, role)
+
+    def pod_down(self, pod_id):
+        """Mark a pod dead and reclaim its un-finished requests — they
+        are held and replayed onto healthy pods by ``redistribute()``
+        (seeds pinned ⇒ the replay is bitwise)."""
+        with self._lock:
+            rec = self._pods.get(pod_id)
+            if rec is None:
+                return 0
+            rec.healthy = False
+            orphans = [self._reqs[r] for r in rec.outstanding
+                       if r in self._reqs and not self._reqs[r].done]
+            rec.outstanding.clear()
+            # drop stale affinity: the prefix KV died with the pod
+            self._affinity = {k: p for k, p in self._affinity.items()
+                              if p != pod_id}
+            for req in orphans:
+                req.pod = None
+            self._held.extend(orphans)
+        if orphans:
+            _counters["orphans_replayed"] += len(orphans)
+            _explain.record(
+                "fleet_pod_orphans", op="router",
+                why=f"pod {pod_id} died with {len(orphans)} un-finished "
+                    "requests; they re-route to healthy pods and replay "
+                    "bitwise (router-pinned seeds + fixed engine "
+                    "rng_seed)",
+                pod=pod_id, orphans=len(orphans))
+        return len(orphans)
+
+    def pod_up(self, pod_id):
+        with self._lock:
+            rec = self._pods.get(pod_id)
+            if rec is not None:
+                rec.healthy = True
+
+    def retire_pod(self, pod_id):
+        with self._lock:
+            rec = self._pods.pop(pod_id, None)
+        if rec is not None:
+            rec.client.close()
+
+    # ---------------------------------------------------------- frontend --
+    def submit(self, prompt_ids, **options):
+        """Route one request; returns its FleetRequest handle. The seed
+        is pinned here if the caller didn't — replay idempotency needs
+        it assigned exactly once."""
+        if options.get("seed") is None:
+            options["seed"] = next(self._seeds)
+        req = FleetRequest(prompt_ids, options)
+        req.rid = next(self._rid)
+        with self._lock:
+            self._reqs[req.rid] = req
+        _counters["requests_routed"] += 1
+        self._route(req)
+        return req
+
+    def generate(self, prompt_ids, result_timeout=None, **options):
+        req = self.submit(prompt_ids, **options).result(result_timeout)
+        if req.status == RequestStatus.DONE:
+            return list(req.tokens)
+        raise RuntimeError(
+            f"fleet request {req.rid} ended {req.status}: {req.error}")
+
+    def held(self):
+        with self._lock:
+            return len(self._held)
+
+    def outstanding(self):
+        with self._lock:
+            return {pid: rec.load for pid, rec in self._pods.items()}
+
+    def stats(self):
+        with self._lock:
+            pods = {pid: {"role": rec.role, "healthy": rec.healthy,
+                          "outstanding": rec.load, "queued": rec.queued,
+                          "active": rec.active}
+                    for pid, rec in self._pods.items()}
+            held = len(self._held)
+        return {"pods": pods, "held": held,
+                "affinity_keys": len(self._affinity),
+                **{k: v for k, v in
+                   _registry.counters("fleet").items()}}
+
+    def fail_pending(self, reason):
+        """Shutdown path: fail every un-finished request (held or
+        routed) — nothing will ever run them."""
+        with self._lock:
+            reqs = list(self._reqs.values())
+            self._held = []
+        for req in reqs:
+            if not req.done:
+                self._finish(req, RequestStatus.ERROR, error=reason)
+
+    # ----------------------------------------------------------- routing --
+    def _affinity_key(self, prompt_ids):
+        """PR 9 block-aligned key scheme: the first ``affinity_blocks``
+        FULL block_size-token chunks. Prompts without one full block
+        have no key (nothing shareable lives in the radix tree for
+        them)."""
+        bs = self.block_size
+        full = min(len(prompt_ids) // bs, self.affinity_blocks)
+        if full < 1:
+            return None
+        return tuple(int(t) for t in prompt_ids[:full * bs])
+
+    def _candidates(self, req, roles=("serve", "decode")):
+        """Ordered candidate pods for a request. Returns (pods, sticky)
+        where sticky is the affinity pod id that led the list (for hit
+        accounting)."""
+        with self._lock:
+            live = [rec for rec in self._pods.values()
+                    if rec.healthy and rec.role in roles
+                    and rec.client.alive]
+            if not live:
+                return [], None
+            if self.policy == "round_robin":
+                i = next(self._rr) % len(live)
+                ordered = sorted(live, key=lambda r: r.pod_id)
+                return ordered[i:] + ordered[:i], None
+            ordered = sorted(live, key=lambda r: (r.load, r.pod_id))
+            if self.policy == "least_loaded":
+                return ordered, None
+            key = self._affinity_key(req.prompt_ids)
+            if key is None:
+                return ordered, None
+            sticky = self._affinity.get(key)
+            if sticky is not None:
+                for rec in ordered:
+                    if rec.pod_id == sticky:
+                        return ([rec] + [r for r in ordered
+                                         if r is not rec], sticky)
+                sticky = None  # mapped pod gone; remap below
+            return ordered, None
+
+    def _remember_affinity(self, req, pod_id, sticky):
+        if self.policy != "prefix":
+            return
+        key = self._affinity_key(req.prompt_ids)
+        if key is None:
+            return
+        if sticky == pod_id:
+            _counters["affinity_hits"] += 1
+        else:
+            if sticky is not None:
+                _counters["affinity_spills"] += 1
+            _counters["affinity_misses"] += 1
+        with self._lock:
+            self._affinity[key] = pod_id
+
+    def _route(self, req):
+        """Place ``req`` on a pod (synchronous up to the pod's ack).
+        Every eligible pod rejecting → QueueFullError; no pod reachable
+        but some may come back → hold for redistribute()."""
+        disagg = any(rec.role == "prefill"
+                     for rec in self._pods.values())
+        if disagg:
+            return self._route_disagg(req)
+        pods, sticky = self._candidates(req)
+        rejects = 0
+        for rec in pods:
+            req.attempts += 1
+            if req.attempts > 1:
+                _counters["router_resubmits"] += 1
+            if _faults.ACTIVE and _faults.fire("router_drop"):
+                # message lost in transit: no send, no ack — fall
+                # through to the resubmit path like any other loss
+                reply = None
+            else:
+                reply = rec.client.call(
+                    {"op": "submit", "rid": req.rid,
+                     "prompt": req.prompt_ids, "options": req.options},
+                    timeout=self.ack_timeout)
+            if reply is None:
+                continue  # lost before ack: try the next pod
+            if reply.get("op") == "ack":
+                if not self._bind(req, rec, reply):
+                    continue  # pod died as it acked: next candidate
+                self._remember_affinity(req, rec.pod_id, sticky)
+                return
+            rejects += 1
+            _counters["router_rejects"] += 1
+        if pods and rejects == len(pods):
+            # every eligible pod's admission budget is exhausted — THE
+            # fleet-wide backpressure condition, and the only one that
+            # surfaces QueueFullError to the caller
+            with self._lock:
+                self._reqs.pop(req.rid, None)
+            raise QueueFullError(
+                f"all {rejects} eligible pods rejected request "
+                f"{req.rid} (admission budgets exhausted); retry later")
+        self._hold(req)
+
+    def _route_disagg(self, req):
+        """Two-stage placement: prefill pod computes the prompt KV and
+        first token, the payload hops (router-mediated) to a decode pod
+        that adopts the slot. Either stage failing falls back to the
+        next candidate; a mid-pipeline pod death just re-runs the whole
+        pipeline (prefill is idempotent by seed)."""
+        opts = req.options
+        pre_pods, _ = self._candidates(req, roles=("prefill",))
+        payload = None
+        for rec in pre_pods:
+            reply = rec.client.call(
+                {"op": "prefill", "rid": req.rid,
+                 "prompt": req.prompt_ids, "options": opts},
+                timeout=self.prefill_timeout)
+            if reply is not None and reply.get("op") == "prefill_done":
+                payload = reply["payload"]
+                break
+        if payload is None:
+            self._hold(req)
+            return
+        _counters["handoffs"] += 1
+        dec_pods, sticky = self._candidates(req, roles=("decode",))
+        rejects = 0
+        for rec in dec_pods:
+            req.attempts += 1
+            if req.attempts > 1:
+                _counters["router_resubmits"] += 1
+            if _faults.ACTIVE and _faults.fire("router_drop"):
+                reply = None
+            else:
+                reply = rec.client.call(
+                    {"op": "adopt", "rid": req.rid,
+                     "prompt": req.prompt_ids, "options": opts,
+                     "payload": payload},
+                    timeout=self.ack_timeout)
+            if reply is None:
+                continue
+            if reply.get("op") == "ack":
+                if not self._bind(req, rec, reply):
+                    continue
+                self._remember_affinity(req, rec.pod_id, sticky)
+                return
+            rejects += 1
+            _counters["router_rejects"] += 1
+        if dec_pods and rejects == len(dec_pods):
+            with self._lock:
+                self._reqs.pop(req.rid, None)
+            raise QueueFullError(
+                f"all {rejects} eligible decode pods rejected request "
+                f"{req.rid} (admission budgets exhausted); retry later")
+        self._hold(req)
+
+    def _bind(self, req, rec, reply):
+        """Record an acked placement. The healthy check happens under
+        the SAME lock pod_down uses to snapshot its orphan list, so a
+        pod dying as it acks cannot strand the request: either pod_down
+        ran first (healthy already False here → the caller re-routes)
+        or this add lands before the snapshot and the rid is orphaned
+        normally. Returns False when the pod is already down."""
+        with self._lock:
+            if not rec.healthy:
+                return False
+            rec.outstanding.add(req.rid)
+            rec.queued = int(reply.get("queued", rec.queued))
+            rec.active = int(reply.get("active", rec.active))
+        req.pod = rec.pod_id
+        return True
+
+    def _hold(self, req):
+        """No pod reachable right now (all down / mid-restart): park the
+        request; the fleet monitor's redistribute() replays it once a
+        pod returns. Matches ReplicaSupervisor's orphan semantics — the
+        caller keeps waiting on result(), it never sees a transient
+        fleet outage."""
+        req.pod = None
+        with self._lock:
+            self._held.append(req)
+        _explain.record(
+            "fleet_request_held", op="router",
+            why=f"request {req.rid} has no reachable pod (all down or "
+                "restarting); held for replay when one returns",
+            rid=req.rid)
+
+    def redistribute(self):
+        """Replay held requests onto healthy pods. Driven by the fleet
+        monitor each tick; safe to call from any single thread."""
+        with self._lock:
+            held, self._held = self._held, []
+        for req in held:
+            if req.done:
+                continue
+            try:
+                self._route(req)
+            except QueueFullError:
+                # budgets full right now: keep holding (these requests
+                # were already accepted by submit(); failing them late
+                # over transient pressure would break the zero-failed
+                # contract). _route popped the rid on raise — restore it
+                # so the eventual completion still resolves.
+                with self._lock:
+                    self._reqs[req.rid] = req
+                    self._held.append(req)
+
+    # --------------------------------------------------------- completion --
+    def on_pod_message(self, pod_id, msg):
+        """Async pod→router messages (the PodClient reader thread's
+        callback). Only ``done`` is meaningful today."""
+        if msg.get("op") != "done":
+            return
+        rid = msg.get("rid")
+        with self._lock:
+            req = self._reqs.get(rid)
+            rec = self._pods.get(pod_id)
+            if rec is not None:
+                rec.outstanding.discard(rid)
+                rec.queued = int(msg.get("queued", rec.queued))
+                rec.active = int(msg.get("active", rec.active))
+        if req is None or req.done:
+            return  # duplicate completion (re-sent submit): first wins
+        req.tokens = [int(t) for t in msg.get("tokens", ())]
+        req.stop_reason = msg.get("stop_reason")
+        status = msg.get("status", RequestStatus.ERROR)
+        self._finish(req, status, error=msg.get("error"))
+
+    def _finish(self, req, status, error=None):
+        req.status = status
+        req.error = error
+        if status == RequestStatus.DONE:
+            _counters["requests_completed"] += 1
+        else:
+            _counters["requests_failed"] += 1
+        req.finished.set()
+        with self._lock:
+            self._reqs.pop(req.rid, None)

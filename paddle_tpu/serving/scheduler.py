@@ -29,7 +29,7 @@ import time
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
 from .block_pool import PagePoolExhausted
-from .engine import FatalEngineError
+from .engine import FatalEngineError, StaleHandoffError
 
 _counters = _registry.scoped_counters("serving", {
     "requests_submitted": 0, "requests_completed": 0,
@@ -81,6 +81,11 @@ class GenerationRequest:
 
         self.rid = None
         self.slot = None
+        # disaggregated serving (ISSUE 11): a decode pod receives a
+        # request whose prompt KV was already computed by a prefill pod;
+        # the exported slot payload rides here and admission adopts it
+        # (engine.import_request_kv) instead of running a local prefill
+        self.kv_payload = None
         self.tokens: list = []
         self.status = RequestStatus.QUEUED
         self.stop_reason = None
@@ -305,8 +310,13 @@ class ContinuousBatchScheduler:
                     head = self._queue[0] if self._queue else None
                 if head is None:
                     break
-                if can_admit is not None and not can_admit(
-                        head.prompt_ids, head.max_new_tokens):
+                can_import = getattr(self.engine, "can_import", None)
+                if head.kv_payload is not None and can_import is not None:
+                    fits = can_import(head.kv_payload)
+                else:
+                    fits = can_admit is None or can_admit(
+                        head.prompt_ids, head.max_new_tokens)
+                if not fits:
                     _counters["pool_exhausted"] += 1
                     _explain.record(
                         "serving_pool_exhausted", op="admission",
@@ -383,10 +393,34 @@ class ContinuousBatchScheduler:
         every terminal outcome (admitted or failed)."""
         t_start = time.monotonic()
         try:
-            first = self.engine.prefill(
-                slot, req.prompt_ids, temperature=req.temperature,
-                top_k=req.top_k, top_p=req.top_p, seed=req.seed,
-                max_new_tokens=req.max_new_tokens)
+            first = None
+            if req.kv_payload is not None:
+                # handed-off request (disaggregated serving): the prompt
+                # KV and first token already exist — adopt the exported
+                # slot instead of prefilling
+                try:
+                    first = self.engine.import_request_kv(
+                        slot, req.kv_payload, prompt_ids=req.prompt_ids)
+                except StaleHandoffError as e:
+                    # a weight swap landed between the prefill pod's
+                    # export and this admission: adopting would decode
+                    # new weights over old-weight KV. Re-prefill the
+                    # prompt locally under the CURRENT weights — exactly
+                    # what a monolithic pod that swapped before this
+                    # request would have produced; the block budget is
+                    # identical (same prompt + token-budget formula), so
+                    # the can_import approval still covers it.
+                    _explain.record(
+                        "serving_handoff_stale", op="admission",
+                        why=f"{e}; falling back to a fresh local "
+                            "prefill on the current weights",
+                        rid=req.rid)
+                req.kv_payload = None  # adopted or discarded
+            if first is None:
+                first = self.engine.prefill(
+                    slot, req.prompt_ids, temperature=req.temperature,
+                    top_k=req.top_k, top_p=req.top_p, seed=req.seed,
+                    max_new_tokens=req.max_new_tokens)
         except PagePoolExhausted:
             # can_admit's conservative budget makes this unreachable in
             # normal operation (belt and braces for fault injection /

@@ -27,11 +27,102 @@ import os
 import signal
 import threading
 import time
+import zlib
 
 from ..profiler import explainer as _explain
 from .engine import FatalEngineError, GenerationEngine
 from .scheduler import (ContinuousBatchScheduler, GenerationRequest,
                         QueueFullError, RequestStatus)
+
+
+def pod_jitter_fraction(ident=None):
+    """Deterministic per-pod fraction in [0, 1) for de-phasing periodic
+    work (checkpoint-dir polling) across a serving fleet: N pods tailing
+    ONE checkpoint directory must not hit the manifest read in lockstep
+    every interval. Derived from the pod's identity env
+    (``PADDLE_POD_ID``, falling back to ``PADDLE_TRAINER_ID``) so the
+    schedule is reproducible run to run — a thundering herd fixed by
+    random jitter would come back in every bug report replay."""
+    if ident is None:
+        ident = os.environ.get("PADDLE_POD_ID") \
+            or os.environ.get("PADDLE_TRAINER_ID") or "0"
+    return (zlib.crc32(str(ident).encode()) % 1000) / 1000.0
+
+
+class CheckpointFollower:
+    """One checkpoint-directory tail: the poll step of
+    ``GenerationServer.watch_checkpoints``, factored out so the fleet
+    swap path (``pod_worker``'s ``swap`` op) reuses the SAME
+    file-set-change dedup — a torn or late-arriving multi-rank
+    checkpoint is attempted once per distinct (step, file set), never
+    re-unpickled in a hot loop per pod, while a new shard landing
+    (file-set change) re-attempts automatically.
+
+    ``owner`` duck-types ``GenerationServer``: ``swap_weights(state,
+    source)`` staging, ``scheduler.swap_count`` / ``last_swap_error``,
+    and a mutable ``last_swap_step`` (advanced HERE only once a swap is
+    APPLIED — a refused swap must not report success, and stays
+    re-attemptable when the checkpoint dir changes)."""
+
+    def __init__(self, owner, ckpt_dir):
+        self.owner = owner
+        self.ckpt_dir = str(ckpt_dir)
+        # (step, file set) of the newest attempted checkpoint — the
+        # watcher dedup that keeps a torn payload from being re-read
+        # every tick while a late-arriving shard still re-attempts
+        self._attempted = (-1, ())
+        # the follower is deliberately SHARED (watcher thread + fleet
+        # swap ops): serialize polls, or two concurrent callers would
+        # both pass the dedup and both re-unpickle the checkpoint —
+        # the exact work the dedup exists to prevent
+        self._lock = threading.Lock()
+
+    def poll(self, wait_applied=30.0, stop_event=None):
+        """Check the directory once; when a newer VALID checkpoint has
+        committed, stage a weight swap and wait (bounded) for the
+        scheduler to apply it. Returns the applied step, or None (no
+        news, torn payload, refused swap, or still pending). Thread-
+        safe: concurrent polls serialize, the loser re-checks the dedup
+        and returns without re-reading."""
+        with self._lock:
+            return self._poll(wait_applied, stop_event)
+
+    def _poll(self, wait_applied, stop_event):
+        from ..incubate import checkpoint as _ckpt
+
+        step = _ckpt.latest_step(self.ckpt_dir)
+        if step is None or step <= self.owner.last_swap_step:
+            return None
+        d = os.path.join(self.ckpt_dir, f"ckpt-{step:08d}")
+        try:
+            probe = (step, tuple(sorted(os.listdir(d))))
+        except OSError:
+            probe = (step, ())
+        if probe == self._attempted:
+            return None
+        self._attempted = probe
+        state, man = _ckpt.load_resharded(self.ckpt_dir, world_size=1)
+        if state is None or int(man["step"]) <= self.owner.last_swap_step:
+            return None
+        model_state = state.get("model", state) \
+            if isinstance(state, dict) else state
+        got = int(man["step"])
+        c0 = self.owner.scheduler.swap_count
+        e0 = self.owner.scheduler.last_swap_error
+        self.owner.swap_weights(
+            model_state, source=f"{self.ckpt_dir}/ckpt-{got:08d}")
+        waited = 0.0
+        while waited < float(wait_applied) \
+                and not (stop_event is not None and stop_event.is_set()):
+            if self.owner.scheduler.swap_count > c0:
+                self.owner.last_swap_step = got
+                return got
+            err = self.owner.scheduler.last_swap_error
+            if err is not None and err is not e0:
+                return None  # refused; the explainer ring has why
+            time.sleep(0.02)
+            waited += 0.02
+        return None
 
 
 class GenerationServer:
@@ -63,9 +154,12 @@ class GenerationServer:
         # requests and replay them on a restarted replica
         self._fail_fast_on_fatal = bool(fail_fast_on_fatal)
         self._fatal = None
-        # checkpoint watcher (train→serve loop)
+        # checkpoint watcher (train→serve loop); followers are cached
+        # per directory so the watcher loop AND the fleet swap path
+        # share one file-set-change dedup state per checkpoint dir
         self._watcher = None
         self._watch_stop = None
+        self._followers: dict = {}
         self.last_swap_step = -1
 
     # ----------------------------------------------------------- control --
@@ -146,77 +240,55 @@ class GenerationServer:
         with self._work:
             self._work.notify()
 
-    def watch_checkpoints(self, ckpt_dir, interval=0.5):
+    def checkpoint_follower(self, ckpt_dir):
+        """The (cached) ``CheckpointFollower`` for ``ckpt_dir``. One
+        follower per directory per server, shared by ``watch_checkpoints``
+        and the fleet swap path, so both reuse one file-set-change dedup
+        state — a fleet-wide swap retry against a torn checkpoint is a
+        no-op until the directory actually changes."""
+        key = str(ckpt_dir)
+        f = self._followers.get(key)
+        if f is None:
+            f = self._followers[key] = CheckpointFollower(self, key)
+        return f
+
+    def watch_checkpoints(self, ckpt_dir, interval=0.5, jitter=None):
         """Tail a training checkpoint directory: whenever a newer VALID
         checkpoint commits, merge its per-rank shards (any world size —
         incubate.checkpoint.load_resharded) and stage a weight swap, so
         serving follows training automatically. Torn or partial
         checkpoints are skipped by the checksummed-manifest loader — the
         watcher never crashes the server, it just waits for the next
-        commit. Stops with shutdown()."""
-        from ..incubate import checkpoint as _ckpt
+        commit. Stops with shutdown().
 
+        ``jitter`` de-phases a FLEET of watchers tailing one directory
+        (thundering-herd on the manifest read): each pod stretches its
+        poll period by up to 50% of ``interval`` and offsets its first
+        poll, both by a deterministic per-pod fraction
+        (``pod_jitter_fraction``, derived from ``PADDLE_POD_ID``).
+        Pass an explicit fraction in [0, 1) to override, or 0 to
+        disable."""
         if self._watcher is not None and self._watcher.is_alive():
             return self
-        ckpt_dir = str(ckpt_dir)
+        follower = self.checkpoint_follower(ckpt_dir)
+        frac = pod_jitter_fraction() if jitter is None else float(jitter)
+        eff_interval = float(interval) * (1.0 + 0.5 * frac)
         self._watch_stop = threading.Event()
-        # (step, file set) of the newest attempted checkpoint. A multi-rank
-        # checkpoint commits rank 0's manifest before the other shards may
-        # have landed, so a failed merge must NOT blacklist the step — we
-        # re-attempt whenever the step dir's file set changes (late-arriving
-        # shard) while a byte-torn payload (same files) stays skipped, which
-        # keeps the poll loop from re-unpickling a bad checkpoint every tick.
-        attempted = [(-1, ())]
 
         def _tail():
+            # first poll offset: even identical effective periods start
+            # de-phased across the fleet
+            self._watch_stop.wait(frac * float(interval))
             while not self._watch_stop.is_set():
                 try:
-                    step = _ckpt.latest_step(ckpt_dir)
-                    if step is not None and step > self.last_swap_step:
-                        d = os.path.join(ckpt_dir, f"ckpt-{step:08d}")
-                        try:
-                            probe = (step, tuple(sorted(os.listdir(d))))
-                        except OSError:
-                            probe = (step, ())
-                        if probe == attempted[0]:
-                            self._watch_stop.wait(float(interval))
-                            continue
-                        attempted[0] = probe
-                        state, man = _ckpt.load_resharded(ckpt_dir,
-                                                          world_size=1)
-                        if state is not None and \
-                                int(man["step"]) > self.last_swap_step:
-                            model_state = state.get("model", state) \
-                                if isinstance(state, dict) else state
-                            got = int(man["step"])
-                            # last_swap_step advances only once the
-                            # scheduler APPLIES the swap — a refused one
-                            # (aval/name mismatch) must not report
-                            # success, and stays re-attemptable if the
-                            # checkpoint dir changes
-                            c0 = self.scheduler.swap_count
-                            e0 = self.scheduler.last_swap_error
-                            self.swap_weights(
-                                model_state,
-                                source=f"{ckpt_dir}/ckpt-{got:08d}")
-                            waited = 0.0
-                            while not self._watch_stop.is_set() \
-                                    and waited < 30.0:
-                                if self.scheduler.swap_count > c0:
-                                    self.last_swap_step = got
-                                    break
-                                err = self.scheduler.last_swap_error
-                                if err is not None and err is not e0:
-                                    break  # refused; explainer has why
-                                time.sleep(0.02)
-                                waited += 0.02
+                    follower.poll(stop_event=self._watch_stop)
                 except Exception as e:
                     _explain.record(
                         "serving_watcher_error", op="watch_checkpoints",
                         why=f"checkpoint watcher poll failed "
                             f"({type(e).__name__}: {e}); retrying next "
                             "interval", error=str(e))
-                self._watch_stop.wait(float(interval))
+                self._watch_stop.wait(eff_interval)
 
         self._watcher = threading.Thread(target=_tail, daemon=True,
                                          name="paddle-tpu-ckpt-watcher")

@@ -23,6 +23,12 @@ accepted by :func:`configure` directly::
     "slow_decode:delay=0.05,steps=3"     first 3 decode steps sleep
     "decode_error:fails=1"               first decode step(s) raise
     "replica_kill:nth=5"                 5th decode step dies FATALLY
+    "pod_kill:at_request=3"              serving pod SIGKILLs itself when
+                                         its 3rd request arrives (rc 137)
+    "pod_slow:delay=0.05,steps=3"        first 3 decode steps of this POD
+                                         sleep (steps omitted = every one)
+    "router_drop:nth=2"                  2nd routed request is lost in
+                                         transit before the pod acks
     "page_pool_exhausted:times=3"        first 3 admission budget checks
                                          report the KV block pool full
     "mutate_signature:nth=3"             3rd zero-dispatch replay runs on
@@ -44,6 +50,9 @@ Points (consumed by the named subsystems):
     slow_decode         serving/engine.decode_step               delay, steps
     decode_error        serving/engine.decode_step (transient)   fails
     replica_kill        serving/engine.decode_step (fatal)       nth
+    pod_kill            serving/pod_worker request handlers      at_request
+    pod_slow            serving/engine.decode_step               delay, steps
+    router_drop         serving/router.FleetRouter send path     nth
     page_pool_exhausted serving/engine.can_admit (admission)     times
     mutate_signature    core/lazy.ReplayStep._replay             nth, mode
     ==================  =======================================  ============
@@ -230,7 +239,11 @@ def fire(point, step=None, rank=None, path=None, op=None):
                        f"check #{ent['count']}")
         return True
 
-    if point == "slow_decode":
+    if point in ("slow_decode", "pod_slow"):
+        # same latency semantics, two names: slow_decode targets one
+        # in-process replica, pod_slow is armed in ONE serving pod's
+        # environment (fleet scenarios) so a straggler pod can be
+        # injected without touching its siblings
         ent["count"] += 1
         steps = p.get("steps")
         if steps is not None and ent["count"] > int(steps):
@@ -238,6 +251,32 @@ def fire(point, step=None, rank=None, path=None, op=None):
         delay = float(p.get("delay", 0.05))
         _record(point, f"decode step #{ent['count']} delayed {delay}s")
         time.sleep(delay)
+        return True
+
+    if point == "pod_kill":
+        # serving-pod analogue of kill_at_step: the pod dies like an
+        # OOM-killed/preempted process (SIGKILL-style rc, no flush, the
+        # in-flight socket goes EOF mid-handler) the instant its Nth
+        # request arrives — the fleet supervisor must respawn it and the
+        # router must replay every orphaned request bitwise
+        ent["count"] += 1
+        if ent["count"] != int(p.get("at_request", 1)):
+            return False
+        _record(point,
+                f"serving pod SIGKILLed at request #{ent['count']}")
+        os._exit(137)
+
+    if point == "router_drop":
+        # fires in the router's send path BEFORE the submit message
+        # reaches the pod: the request is lost in transit, the ack never
+        # arrives, and the router must re-submit it (idempotent by
+        # request seed) instead of wedging the caller
+        ent["count"] += 1
+        if ent["count"] != int(p.get("nth", 1)):
+            return False
+        _record(point, f"routed request #{ent['count']} lost before pod "
+                       "ack; the router must re-submit (idempotent by "
+                       "request seed)")
         return True
 
     if point == "decode_error":

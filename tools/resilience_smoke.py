@@ -23,9 +23,25 @@ recovery contract from ISSUE 7:
                   and retries once — the request finishes with the same
                   tokens, nothing fails
 
+Fleet scenarios (ISSUE 11 — serving pods as REAL subprocesses under the
+launch supervision conventions, fronted by the prefix-aware router):
+
+    fleet-pod-kill     a pod SIGKILLed mid-handler is respawned with
+                       backoff; the router replays its orphans BITWISE
+                       on the respawn — zero failed requests
+    fleet-slow-pod     one straggler pod (injected decode latency) in a
+                       2-pod fleet: everything completes, zero failed
+    fleet-swap         fleet-wide checkpoint hot-swap lands on EVERY pod
+                       at its decode boundary: 0 failed, 0 recompiles,
+                       post-swap tokens are the new weights'
+    fleet-router-drop  a routed request lost before the pod's ack is
+                       re-submitted by the router (idempotent by seed):
+                       same tokens, nothing fails
+
 The RUNNER is pure stdlib (no paddle_tpu/jax import in this process) so
 CI can invoke it anywhere; the scenarios import paddle_tpu in their child
-processes on JAX_PLATFORMS=cpu.
+processes on JAX_PLATFORMS=cpu (fleet scenarios additionally spawn pod
+GRANDCHILD processes — the whole point).
 
 Usage:
     python tools/resilience_smoke.py              # full matrix
@@ -112,6 +128,33 @@ for step in range(start, STEPS):
         mgr.save({"w": w}, step=step)
 mgr.wait()
 print("FINAL", np.asarray(w.numpy()).tobytes().hex())
+"""
+
+# Fleet scenarios share this rig: the pod-worker model spec, its engine
+# config, and a local single-server reference computing the tokens the
+# fleet must reproduce bitwise (router seeds are pinned 0, 1, 2, ... in
+# submission order; pods build with the same fixed engine rng_seed).
+_FLEET_PRELUDE = _SERVE_PRELUDE + r"""
+from paddle_tpu.serving import GenerationEngine, GenerationServer
+from paddle_tpu.serving.fleet import ServingFleet
+
+MODEL_SPEC = {"kind": "gpt", "seed": 21,
+              "config": dict(vocab_size=VOCAB, n_layer=2, n_head=2,
+                             d_model=48, seq_len=64,
+                             initializer_range=0.35)}
+ENGINE_KW = dict(max_batch_size=2, buckets=[16], block_size=4, rng_seed=0)
+PROMPTS = [[3, 5, 7, 9, 11], [2, 4, 6], [1, 2, 3, 4, 5, 6, 7]]
+OPTS = dict(max_new_tokens=8, temperature=0.8)
+
+def reference_tokens(model_seed=21):
+    srv = GenerationServer(
+        engine=GenerationEngine(build(model_seed), max_batch_size=2,
+                                buckets=(16,), block_size=4, rng_seed=0))
+    srv.start()
+    out = [srv.generate(p, seed=i, **OPTS)
+           for i, p in enumerate(PROMPTS)]
+    srv.shutdown(timeout=30)
+    return out
 """
 
 SCENARIOS = {}
@@ -328,6 +371,128 @@ print("RETRY-OK")
     if ok and "RETRY-OK" not in out:
         return False, "scenario exited 0 without completing"
     return ok, why or "single retry recovered; tokens unchanged"
+
+
+@scenario("fleet-pod-kill", "SIGKILLed serving pod respawns; router "
+                            "replays orphans bitwise, zero failed")
+def _fleet_pod_kill(timeout):
+    code = _FLEET_PRELUDE + r"""
+want = reference_tokens()
+fleet = ServingFleet(MODEL_SPEC, pods=1, engine=ENGINE_KW,
+                     restart_backoff=0.05,
+                     pod_faults={0: "pod_kill:at_request=2"}).start()
+reqs = [fleet.submit(p, **OPTS) for p in PROMPTS]
+got = [list(r.result(180).tokens) for r in reqs]
+assert [r.status for r in reqs] == ["done"] * 3, [r.status for r in reqs]
+assert got == want, "replayed tokens not bitwise-identical"
+st = fleet.stats()
+assert st["pods"][0]["restarts"] >= 1
+assert st["router"]["requests_failed"] == 0
+assert registry.counters("fleet")["orphans_replayed"] >= 1
+fleet.shutdown()
+print("FLEET-KILL-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "FLEET-KILL-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or ("pod respawned under backoff; orphans replayed "
+                       "bitwise, zero failed")
+
+
+@scenario("fleet-slow-pod", "one straggler pod in a 2-pod fleet: all "
+                            "requests complete, zero failed")
+def _fleet_slow_pod(timeout):
+    code = _FLEET_PRELUDE + r"""
+fleet = ServingFleet(MODEL_SPEC, pods=2, engine=ENGINE_KW,
+                     pod_faults={1: "pod_slow:delay=0.05"}).start()
+reqs = [fleet.submit(p, seed=100 + i, max_new_tokens=8)
+        for i, p in enumerate(PROMPTS * 2)]
+for r in reqs:
+    r.result(180)
+assert all(r.status == "done" for r in reqs), [r.status for r in reqs]
+st = fleet.stats()
+assert st["router"]["requests_failed"] == 0
+assert st["pods"][0]["fatal"] is False and st["pods"][1]["fatal"] is False
+fleet.shutdown()
+print("FLEET-SLOW-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "FLEET-SLOW-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or "straggler absorbed; zero failed across the fleet"
+
+
+@scenario("fleet-swap", "fleet-wide ckpt hot-swap: every pod applies at "
+                        "its decode boundary, 0 failed, 0 recompiles")
+def _fleet_swap(timeout):
+    code = _FLEET_PRELUDE + r"""
+import tempfile
+from paddle_tpu.incubate import checkpoint as ckpt
+
+b_sd = np_state(build(22))
+probe = PROMPTS[0]
+srv = GenerationServer(
+    engine=GenerationEngine(build(22), max_batch_size=2, buckets=(16,),
+                            block_size=4, rng_seed=0)).start()
+want_b = srv.generate(probe, max_new_tokens=6, seed=50)
+srv.shutdown(timeout=30)
+
+fleet = ServingFleet(MODEL_SPEC, pods=2, engine=ENGINE_KW).start()
+fleet.generate(probe, max_new_tokens=4, result_timeout=120)
+fleet.generate([9, 8, 7], max_new_tokens=4, result_timeout=120)
+compiles0 = {p: d.get("decode_compiles")
+             for p, d in fleet.stats()["pods"].items()}
+with tempfile.TemporaryDirectory() as d:
+    ckpt.save_checkpoint(d, {"model": b_sd}, step=1)
+    reqs = [fleet.submit([2, 4, 6, 8], max_new_tokens=12,
+                         temperature=0.5) for _ in range(4)]
+    replies = fleet.swap_weights(d, timeout=60)
+    for r in reqs:
+        r.result(120)
+assert all(r.status == "done" for r in reqs), [r.status for r in reqs]
+assert all(rep is not None and rep["applied_step"] == 1
+           and rep["swap_error"] is None for rep in replies.values()), \
+    replies
+st = fleet.stats()
+compiles1 = {p: d.get("decode_compiles") for p, d in st["pods"].items()}
+assert compiles1 == compiles0, "fleet swap recompiled decode"
+assert st["router"]["requests_failed"] == 0
+assert fleet.generate(probe, max_new_tokens=6, seed=50,
+                      result_timeout=120) == want_b
+fleet.shutdown()
+print("FLEET-SWAP-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "FLEET-SWAP-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or ("swap applied on every pod mid-flight; 0 failed, "
+                       "0 recompiles")
+
+
+@scenario("fleet-router-drop", "request lost before pod ack is re-"
+                               "submitted by seed: same tokens, 0 failed")
+def _fleet_router_drop(timeout):
+    code = _FLEET_PRELUDE + r"""
+fleet = ServingFleet(MODEL_SPEC, pods=2, engine=ENGINE_KW).start()
+want = fleet.generate([4, 5, 6], max_new_tokens=5, seed=50,
+                      temperature=0.9, result_timeout=120)
+faults.configure("router_drop:nth=1")
+got = fleet.generate([4, 5, 6], max_new_tokens=5, seed=50,
+                     temperature=0.9, result_timeout=120)
+faults.reset()
+assert got == want, "re-submitted request changed its tokens"
+st = fleet.stats()
+assert st["router"]["router_resubmits"] >= 1
+assert st["router"]["requests_failed"] == 0
+assert registry.counters("fault").get("injected.router_drop", 0) >= 1
+fleet.shutdown()
+print("FLEET-DROP-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "FLEET-DROP-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or ("dropped route re-submitted idempotently; tokens "
+                       "unchanged")
 
 
 def main(argv=None):

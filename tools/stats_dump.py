@@ -96,6 +96,30 @@ def _print_fastpath(counters, gauges):
         _print_counters(causes, indent="    ")
 
 
+_FLEET_PREFIXES = ("fleet.",)
+_FLEET_HANDOFF_KEYS = frozenset(("serving.handoff_exports",
+                                 "serving.handoff_imports"))
+
+
+def _print_fleet(counters, gauges):
+    """Serving-fleet health (ISSUE 11): per-pod restarts/retirements,
+    orphan replays (every one is a request that survived a pod death),
+    the routing hit rate (how often prefix affinity landed traffic on
+    its sticky pod), and the disaggregation handoff counts."""
+    fl = {k: counters.pop(k) for k in list(counters)
+          if k.startswith(_FLEET_PREFIXES) or k in _FLEET_HANDOFF_KEYS}
+    fl.update({k: gauges.pop(k) for k in list(gauges)
+               if k.startswith(_FLEET_PREFIXES)})
+    if not fl:
+        return
+    print("serving fleet:")
+    hits = fl.get("fleet.affinity_hits", 0)
+    total = hits + fl.get("fleet.affinity_misses", 0)
+    if total:
+        fl.setdefault("fleet.routing_hit_rate", round(hits / total, 4))
+    _print_counters(fl)
+
+
 _KV_POOL_PREFIXES = ("serving.prefix_", "serving.kv_blocks")
 _KV_POOL_KEYS = frozenset(("serving.pool_exhausted",))
 
@@ -148,6 +172,10 @@ def _print_snapshot(snap):
         print("train->serve loop:")
         _print_counters(ts_counters)
         _print_counters(ts_gauges)
+    # serving fleet (ISSUE 11) before the per-subsystem serving tables:
+    # pod restarts / orphan replays / routing hit rate are the
+    # cross-process resilience story, read as one table
+    _print_fleet(counters, gauges)
     # kv pool (ISSUE 10) claims its serving.* keys before the general
     # serving section so cache-memory health reads as one table
     _print_kv_pool(counters, gauges)
