@@ -527,6 +527,23 @@ def _run_serve_child():
     radix prefix cache and reports prefix_hit_rate (gate: > 0.5),
     blocks-in-use high-water mark and prefill-FLOPs-saved; the
     0-post-warmup-compile and 0-failed-request gates cover BOTH phases.
+
+    Third phase (ISSUE 12) — CHUNKED-PREFILL inter-token latency: the
+    same server replays a decode stream while three near-max-length
+    prompts arrive, once with chunking off and once with
+    ``prefill_chunk_tokens`` toggled on (same engine, same compiled
+    executables), and reports the stream's p99 inter-token gap both
+    ways — the line chunking must visibly flatten.
+
+    Fourth phase (ISSUE 12) — SPECULATIVE DECODE: a wider damped-
+    residual target (memory-bound decode, the regime speculation pays
+    in) plus a 1-layer layer-skip drafter run the SAME greedy+sampled
+    workload on a plain server and a DraftVerifyEngine server built on
+    identical target weights: tokens must be bitwise-equal, the record
+    reports acceptance_rate / accepted_len_mean / spec_tokens_per_s vs
+    the plain baseline, and the phase's own 0-verify-recompile and
+    0-failed gates ride the existing envelope.
+
     Convention matches --ratio: the telemetry line prints first, the
     {"metric": "serving"} result line stays last."""
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -542,8 +559,10 @@ def _run_serve_child():
     cfg = GPTConfig(vocab_size=128, n_layer=2, n_head=2, d_model=64,
                     seq_len=64, initializer_range=0.3)
     model = GPTForPretraining(GPTModel(cfg))
-    server = GenerationServer(model, max_batch_size=4, buckets=(16, 32),
-                              max_queue_size=32)
+    # the 64 bucket exists for the chunked-prefill ITL phase's near-max
+    # prompts; it compiles lazily there, not in the phase-1/2 window
+    server = GenerationServer(model, max_batch_size=4,
+                              buckets=(16, 32, 64), max_queue_size=32)
     server.start()
     rng = np.random.default_rng(0)
 
@@ -600,9 +619,142 @@ def _run_serve_child():
     flops_saved = hit_tokens * cfg.flops_per_token() / 3
     swap_count = server.scheduler.swap_count
     swap_err = server.scheduler.last_swap_error
+
+    # ---- chunked-prefill inter-token-latency phase (ISSUE 12) --------
+    # One decode stream runs while three near-max prompts arrive; the
+    # stream's token-arrival gaps are sampled from this thread. Chunking
+    # is toggled LIVE on the same scheduler (same engine, same compiled
+    # executables), so the two runs differ only in interleave policy.
+    def _itl_run(chunk_tokens, seed_base):
+        server.scheduler.prefill_chunk_tokens = chunk_tokens
+        stream = server.submit(list(rng.integers(1, 128, 6)),
+                               max_new_tokens=48, seed=seed_base)
+        while not stream.tokens:  # admitted and decoding
+            _t.sleep(0.0005)
+        arrivals = [(_t.perf_counter(), len(stream.tokens))]
+        longs = []
+        for i in range(3):
+            longs.append(server.submit(
+                list(rng.integers(1, 128, 56)), max_new_tokens=4,
+                seed=seed_base + 1 + i))
+        while not stream.done:
+            n = len(stream.tokens)
+            if n > arrivals[-1][1]:
+                arrivals.append((_t.perf_counter(), n))
+            _t.sleep(0.0005)
+        for r in longs:
+            r.result(timeout=300)
+        server.scheduler.prefill_chunk_tokens = None
+        gaps = sorted((b[0] - a[0]) / max(1, b[1] - a[1])
+                      for a, b in zip(arrivals, arrivals[1:]))
+        p99 = gaps[min(len(gaps) - 1, int(round(0.99 * (len(gaps) - 1))))]
+        return p99 * 1e3, [stream] + longs
+
+    itl_off_p99, itl_off_reqs = _itl_run(None, 400)
+    itl_on_p99, itl_on_reqs = _itl_run(16, 500)
+    c3 = dict(_reg.counters("serving"))
+    itl_reqs = itl_off_reqs + itl_on_reqs
     server.shutdown()
 
-    failed = len([r for r in reqs + preqs if r.status != "done"])
+    # ---- speculative-decode phase (ISSUE 12) -------------------------
+    # Single-stream LATENCY mode (max_batch_size=1): a [1, 1] decode
+    # step is a pure weight-streaming GEMV — the memory-bound regime a
+    # TPU decode lives in, and the one speculation pays in (a [1, K+1]
+    # verify reads the weights once for K+1 tokens).  The target damps
+    # its later blocks' residuals so the 1-layer LAYER-SKIP drafter
+    # (embeddings + block 0 + final LN copied from the target) genuinely
+    # correlates — the stand-in for a distilled drafter that untrained
+    # random weights cannot otherwise provide.
+    def _spec_target(seed=0):
+        paddle.seed(seed)
+        scfg = GPTConfig(vocab_size=128, n_layer=6, n_head=4,
+                         d_model=384, seq_len=128,
+                         initializer_range=0.3)
+        m = GPTForPretraining(GPTModel(scfg))
+        for blk in m.gpt.blocks[1:]:
+            for w in (blk.attn.out_proj.weight, blk.mlp.fc2.weight):
+                w.set_value(w * paddle.to_tensor(np.float32(0.03)))
+        return m, scfg
+
+    def _spec_drafter(target, scfg):
+        paddle.seed(1)
+        dcfg = GPTConfig(vocab_size=scfg.vocab_size, n_layer=1,
+                         n_head=scfg.n_head, d_model=scfg.d_model,
+                         seq_len=scfg.seq_len, initializer_range=0.3)
+        d = GPTForPretraining(GPTModel(dcfg))
+        tsd = target.gpt.state_dict()
+        for k, v in d.gpt.state_dict().items():
+            if k in tsd:
+                v.set_value(tsd[k])
+        return d
+
+    from paddle_tpu.serving import DraftVerifyEngine, GenerationEngine
+
+    spec_prompt = list(rng.integers(1, 128, 10))
+    SPEC_GREEDY, SPEC_SAMPLED = 60, 40
+
+    def _spec_run(eng, spec_mode):
+        step = eng.decode_step_spec if spec_mode else eng.decode_step
+
+        def gen(n, warm=0, **kw):
+            out = [eng.prefill(0, spec_prompt, **kw)]
+            base = None
+            while len(out) < n:
+                if base is None and len(out) >= max(1, warm):
+                    base = (len(out), _t.perf_counter())  # steady window
+                toks = step()
+                out.extend(int(x) for x in
+                           (toks[0] if spec_mode else [toks[0]]))
+            tps = (len(out) - base[0]) / (_t.perf_counter() - base[1])
+            eng.release(0)
+            return out[:n], tps
+
+        # warmup: long enough for SEVERAL rounds per generation — the
+        # first-round (host-rebuilt args), steady (chained jit outputs)
+        # and post-release-rebuild argument-commitment patterns each
+        # compile their own executable under jax's lowering cache, and
+        # all three must be paid here, not in the timed window (a
+        # 5-token warmup ran ONE round at high acceptance and leaked a
+        # 1.1s compile into the measurement)
+        gen(16, seed=98)
+        gen(16, seed=99)
+        greedy, tps = gen(SPEC_GREEDY, warm=4, seed=0)
+        # counters snapshot BETWEEN legs: the reported acceptance_rate
+        # must measure the temperature>0 leg alone, not be diluted by
+        # the (usually easier) greedy rounds
+        mid = dict(_reg.counters("serving"))
+        sampled, _ = gen(SPEC_SAMPLED, warm=4, seed=1, temperature=0.8,
+                         top_k=40)
+        return greedy, sampled, tps, mid
+
+    tmodel, scfg = _spec_target()
+    plain_model, _ = _spec_target()
+    ekw = dict(max_batch_size=1, buckets=(16,), rng_seed=7,
+               block_size=8, max_seq_len=128)
+    plain_greedy, plain_sampled, plain_tps, _ = _spec_run(
+        GenerationEngine(plain_model, **ekw), False)
+    c4 = dict(_reg.counters("serving"))
+    spec_eng = DraftVerifyEngine(tmodel, _spec_drafter(tmodel, scfg),
+                                 draft_k=4, **ekw)
+    spec_greedy, spec_sampled, spec_tps, c4s = _spec_run(spec_eng, True)
+    c5 = dict(_reg.counters("serving"))
+    spec_eng.pool.audit()
+    spec_eng.draft_pool.audit()
+    spec_bitwise = (plain_greedy == spec_greedy
+                    and plain_sampled == spec_sampled)
+    # acceptance over the SAMPLED leg only (temperature 0.8)
+    spec_prop = c5["spec_proposed"] - c4s["spec_proposed"]
+    spec_acc = (c5["spec_accepted"] - c4s["spec_accepted"]) / spec_prop \
+        if spec_prop else 0.0
+    spec_sr = c5["spec_slot_rounds"] - c4s["spec_slot_rounds"]
+    spec_alm = (c5["spec_emitted"] - c4s["spec_emitted"]) / spec_sr \
+        if spec_sr else 0.0
+    # the spec engine compiled ONE verify executable (warmup); the
+    # measured window added zero
+    spec_compiles = c5["verify_compiles"] - c4["verify_compiles"]
+
+    failed = len([r for r in reqs + preqs + itl_reqs
+                  if r.status != "done"])
     tokens = sum(len(r.tokens) for r in reqs)
     steps = c1["decode_steps"] - c0["decode_steps"]
     occ = ((c1["active_slot_steps"] - c0["active_slot_steps"])
@@ -625,12 +777,14 @@ def _run_serve_child():
         "swap_count": swap_count,
         "failed_requests": failed,
         "swap_error": repr(swap_err) if swap_err is not None else None,
-        # compile gates span BOTH phases: the shared-prefix traffic must
-        # ride the exact same executables as the disjoint workload
-        "decode_compiles": c2["decode_compiles"],
+        # compile gates span the mixed, shared-prefix AND chunked-ITL
+        # phases: all three must ride the exact same decode executable
+        # (the spec phase below builds separate engines and gates its
+        # own verify compiles)
+        "decode_compiles": c3["decode_compiles"],
         "decode_compiles_after_warmup":
-            c2["decode_compiles"] - c0["decode_compiles"],
-        "prefill_compiles": c2["prefill_compiles"],
+            c3["decode_compiles"] - c0["decode_compiles"],
+        "prefill_compiles": c3["prefill_compiles"],
         # paged KV + radix prefix cache (ISSUE 10): shared-prefix phase
         # health — gate: prefix_hit_rate > 0.5 on the 8-request
         # shared-system-prompt workload
@@ -655,10 +809,46 @@ def _run_serve_child():
             f2["decode_audit_runs"] - f0["decode_audit_runs"],
         "decode_demotions":
             f2["decode_demotions"] - f0["decode_demotions"],
+        # chunked prefill (ISSUE 12): the decode stream's p99 inter-
+        # token gap while near-max prompts arrive, chunking off vs on —
+        # same engine, same executables, only the interleave differs.
+        # The flatten ratio is the headline: > 1 means chunking cut the
+        # long-prompt stall.
+        "p99_inter_token_latency_ms": round(itl_off_p99, 2),
+        "p99_inter_token_latency_chunked_ms": round(itl_on_p99, 2),
+        "itl_flatten_x": round(itl_off_p99 / itl_on_p99, 2)
+        if itl_on_p99 else 0.0,
+        "prefill_chunks": c3["prefill_chunks"],
+        "chunked_prefills": c3["chunked_prefills"],
+        # speculative decode (ISSUE 12): same workload, plain vs draft-
+        # verify on identical target weights — bitwise-equal tokens
+        # (greedy AND sampled), acceptance measured at temperature > 0,
+        # ONE verify executable (the warmup compile), and the tokens/s
+        # ratio is the speedup gate at this damped-target config
+        "spec_bitwise_equal": spec_bitwise,
+        "spec_tokens_per_s": round(spec_tps, 1),
+        "plain_tokens_per_s": round(plain_tps, 1),
+        "spec_speedup_x": round(spec_tps / plain_tps, 3)
+        if plain_tps else 0.0,
+        "acceptance_rate": round(spec_acc, 4),
+        "accepted_len_mean": round(spec_alm, 2),
+        "acceptance_rate_greedy": round(
+            (c4s["spec_accepted"] - c4["spec_accepted"])
+            / max(1, c4s["spec_proposed"] - c4["spec_proposed"]), 4),
+        "spec_draft_k": 4,
+        "spec_verify_compiles": spec_compiles,
         "platform": "cpu",
     }
     print(json.dumps(rec), flush=True)
-    return 0
+    # ISSUE 12 envelope: zero failed, zero post-warmup decode compiles,
+    # ONE verify executable, bitwise spec output, a real tokens/s
+    # speedup at temperature 0, and chunking visibly flattening the p99
+    # inter-token line (measured 40-107x; gate leaves CI-noise margin)
+    gates_ok = (failed == 0 and spec_bitwise and spec_compiles == 1
+                and rec["decode_compiles_after_warmup"] == 0
+                and rec["spec_speedup_x"] > 1.0
+                and rec["itl_flatten_x"] > 1.5)
+    return 0 if gates_ok else 1
 
 
 def _run_serve_fleet_child():

@@ -31,6 +31,15 @@ automatically, merging N-rank shards via ``incubate.checkpoint``);
 backoff, replays their requests bitwise by seed, and autoscales the fleet
 off queue-depth/occupancy telemetry.
 
+Speculative decoding (ISSUE 12): ``DraftVerifyEngine`` (``spec_decode``)
+lets a small drafter propose K tokens per iteration and verifies them in
+ONE fixed-shape target forward; the seeded Gumbel-max sampler makes the
+acceptance rule EXACT, so accepted tokens are bitwise-equal to plain
+decode at any temperature and a wrong drafter can only cost throughput.
+Chunked prefill (``prefill_chunk_tokens`` on the scheduler/server)
+interleaves long-prompt prefills with decode steps in block-aligned
+chunks — latency bounded, admission memory budget unchanged.
+
 Cross-process fleet (ISSUE 11): ``ServingFleet`` (``fleet``) promotes the
 replica contracts to real subprocess PODS under the launch stack's
 supervision conventions, fronted by a ``FleetRouter`` (``router``) that
@@ -61,6 +70,7 @@ from .fleet import ServingFleet  # noqa: F401
 from .router import FleetRequest, FleetRouter, PodClient  # noqa: F401
 from .server import (  # noqa: F401
     CheckpointFollower, GenerationServer)
+from .spec_decode import DraftVerifyEngine  # noqa: F401
 from .supervisor import ReplicaSupervisor  # noqa: F401
 from . import sampling  # noqa: F401
 
@@ -70,5 +80,5 @@ __all__ = [
     "ReplicaSupervisor", "WeightSwapError", "FatalEngineError",
     "BlockPool", "PagePoolExhausted", "RadixPrefixCache", "sampling",
     "ServingFleet", "FleetRouter", "FleetRequest", "PodClient",
-    "CheckpointFollower",
+    "CheckpointFollower", "DraftVerifyEngine",
 ]

@@ -56,8 +56,12 @@ class BlockPool:
     decode step's masked lanes scribble there instead of into live data.
     """
 
-    def __init__(self, num_blocks):
+    def __init__(self, num_blocks, name=""):
         self.num_blocks = int(num_blocks)
+        # `name` labels multi-pool engines' errors (the spec-decode
+        # drafter runs its own pool: "draft KV block pool exhausted"
+        # must not read like the target pool backpressuring)
+        self.name = str(name)
         if self.num_blocks < 2:
             raise ValueError(
                 f"BlockPool needs >= 2 blocks (1 reserved + 1 usable), "
@@ -86,8 +90,9 @@ class BlockPool:
         if n > len(self._free) and evict is not None:
             evict(n - len(self._free))
         if n > len(self._free):
+            label = f"{self.name} KV" if self.name else "KV"
             raise PagePoolExhausted(
-                f"KV block pool exhausted: need {n} blocks, "
+                f"{label} block pool exhausted: need {n} blocks, "
                 f"{len(self._free)}/{self.usable_blocks} free and nothing "
                 "left to evict")
         out = [self._free.pop() for _ in range(n)]

@@ -79,7 +79,7 @@ _counters = _registry.scoped_counters("serving", {
     "prefix_hits": 0, "prefix_misses": 0, "prefix_hit_tokens": 0,
     "prefix_inserted_blocks": 0, "prefix_evicted_blocks": 0,
     "kv_blocks_hwm": 0, "handoff_exports": 0, "handoff_imports": 0,
-    "handoff_stale": 0})
+    "handoff_stale": 0, "chunked_prefills": 0, "prefill_chunks": 0})
 
 # Decode replay fast path (ISSUE 9, same machinery as lazy.ReplayStep):
 # in the steady window a decode iteration is one fingerprint check (the
@@ -252,6 +252,11 @@ class GenerationEngine:
         # each slot has a pool reference on
         self._block_tables = np.zeros((B, self.blocks_per_slot), np.int32)
         self._slot_blocks = [[] for _ in range(B)]
+        # chunked prefill (ISSUE 12): slot -> in-progress admission state.
+        # A mid-prefill slot is RESERVED — neither free (its blocks are
+        # allocated, chunks are landing) nor active (it must not join the
+        # decode batch until its first token is sampled).
+        self._mid_prefill: dict = {}
 
         # seed-determinism root: one split of the global generator, so
         # paddle_tpu.seed(s) pins every sampled token this engine produces.
@@ -299,7 +304,8 @@ class GenerationEngine:
 
     # ------------------------------------------------------------- slots --
     def free_slots(self):
-        return [i for i in range(self.max_batch_size) if not self._active[i]]
+        return [i for i in range(self.max_batch_size)
+                if not self._active[i] and i not in self._mid_prefill]
 
     def active_slots(self):
         return [i for i in range(self.max_batch_size) if self._active[i]]
@@ -309,7 +315,12 @@ class GenerationEngine:
         zero its table row (its lane now scribbles into the reserved
         garbage block). Shared prefix blocks stay alive through the radix
         tree's own reference — only truly dead blocks return to the free
-        list."""
+        list. A mid-chunked-prefill slot releases its staged blocks the
+        same way (deadline/cancel before the first token)."""
+        st = self._mid_prefill.pop(slot, None)
+        if st is not None:
+            self.pool.decref(st["table_ids"])
+            self._note_pool()
         if self._slot_blocks[slot]:
             self.pool.decref(self._slot_blocks[slot])
             self._slot_blocks[slot] = []
@@ -623,20 +634,11 @@ class GenerationEngine:
             **{"detail": detail})
 
     # ------------------------------------------------------------ prefill --
-    def prefill(self, slot, prompt_ids, temperature=0.0, top_k=0,
-                top_p=1.0, seed=None, max_new_tokens=None):
-        """Admit a prompt into `slot`: match its longest cached block
-        prefix (shared blocks join the slot's table by refcount, their
-        prefill FLOPs skipped), allocate fresh blocks for the suffix +
-        generation budget, run the compiled suffix prefill, install the
-        slot state and publish the prompt's full blocks into the prefix
-        cache. Returns the first generated token (TTFT == prefill
-        latency). Raises ``PagePoolExhausted`` when the pool cannot cover
-        the request even after evicting cold prefixes (the scheduler's
-        ``can_admit`` pre-check makes that unreachable in normal
-        operation)."""
+    def _check_prompt(self, slot, prompt_ids):
         if self._active[slot]:
             raise RuntimeError(f"slot {slot} is still active")
+        if slot in self._mid_prefill:
+            raise RuntimeError(f"slot {slot} has a prefill in progress")
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if len(prompt) < 1:
             raise ValueError("prompt must contain at least one token")
@@ -648,11 +650,14 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt length {len(prompt)} leaves no room to generate "
                 f"(max_seq_len={self.max_seq_len})")
-        bs = self.block_size
+        return prompt
 
-        # longest cached block-aligned prefix, capped so at least the
-        # prompt's last token is always recomputed (its hidden state
-        # feeds the first sample)
+    def _admit_blocks(self, prompt, max_new_tokens):
+        """Match + pin the longest cached block-aligned prefix (capped so
+        the prompt's last token is always recomputed — its hidden state
+        feeds the first sample) and allocate the rest of the worst-case
+        budget. Returns (table_ids, bt_row, matched_prefix_len)."""
+        bs = self.block_size
         matched = self.prefix_cache.match(prompt)
         max_full = (len(prompt) - 1) // bs
         matched = matched[:max_full]
@@ -668,19 +673,23 @@ class GenerationEngine:
         table_ids = matched + fresh
         bt_row = np.zeros(self.blocks_per_slot, np.int32)
         bt_row[:len(table_ids)] = table_ids
+        return table_ids, bt_row, P
 
-        suffix = prompt[P:]
-        L = self.bucket_for(len(suffix))
+    def _prefill_call(self, window, end, start, bt_row, key, temperature,
+                      top_k, top_p):
+        """One compiled prefill pass over prompt[start:end] at the
+        window's bucket. ``end`` doubles as the write mask (only the
+        window's rows land) and positions the sample at ``end - 1`` —
+        intermediate chunks discard that sample, the final window's IS
+        the request's first token. Same executable per bucket whether the
+        window is a whole suffix, a prefix-hit remainder or one chunk."""
+        L = self.bucket_for(len(window))
         ids = np.zeros((1, L), np.int32)
-        ids[0, :len(suffix)] = suffix
-        if seed is None:
-            seed = next(self._seed_counter)
-        key = np.asarray(_sampling.request_key(self._base_key, seed),
-                         np.uint32)
+        ids[0, :len(window)] = window
         args = (self._state_arrays(), tuple(self._k), tuple(self._v),
                 self._put(ids),
-                self._put(np.asarray([len(prompt)], np.int32)),
-                self._put(np.asarray([P], np.int32)),
+                self._put(np.asarray([end], np.int32)),
+                self._put(np.asarray([start], np.int32)),
                 self._put(bt_row[None]), self._put(key),
                 self._put(np.asarray([temperature], np.float32)),
                 self._put(np.asarray([top_k], np.int32)),
@@ -688,20 +697,47 @@ class GenerationEngine:
         self._note_signature(
             "prefill", args,
             f"bucket_len={L}, max_batch={self.max_batch_size}")
+        with RecordEvent("serving_prefill"), \
+                _registry.time_block("prefill", scope="serving"):
+            tok, nk, nv = self._prefill_jit(*args)
+            tok = int(np.asarray(tok)[0])
+        self._k, self._v = list(nk), list(nv)
+        return tok
+
+    def _reserve_extra(self, slot, prompt, max_new_tokens):
+        """Subclass hook (spec decode): reserve any EXTRA per-slot
+        resources (the drafter's block budget) at admission time.
+        Called by ``begin_prefill`` so chunked admissions hold their
+        whole footprint up front — a shortage surfaces HERE as
+        ``PagePoolExhausted`` (admission backpressure), never as a
+        mid-flight failure at the final chunk."""
+
+    def _chunk_extra(self, slot, prompt, start, end):
+        """Subclass hook (spec decode): extra work per prefill chunk —
+        the drafter ingests the same window, so its catch-up cost is
+        bounded by one chunk too, not deferred into one whole-prompt
+        stall at installation."""
+
+    def _install_extra(self, slot, prompt, max_new_tokens):
+        """Subclass hook (spec decode): extra per-slot admission work —
+        drafter blocks + drafter prompt ingestion — run BEFORE the slot
+        state is installed. Raising here unwinds the admission."""
+
+    def _install_slot(self, slot, prompt, table_ids, bt_row, tok, key,
+                      temperature, top_k, top_p, matched_prefix,
+                      max_new_tokens):
         try:
-            with RecordEvent("serving_prefill"), \
-                    _registry.time_block("prefill", scope="serving"):
-                tok, nk, nv = self._prefill_jit(*args)
-                tok = int(np.asarray(tok)[0])
+            self._install_extra(slot, prompt, max_new_tokens)
         except Exception:
             self.pool.decref(table_ids)  # failed admission leaks nothing
+            self._note_pool()
             raise
-        self._k, self._v = list(nk), list(nv)
-        if P:
+        if matched_prefix:
             _counters["prefix_hits"] += 1
-            _counters["prefix_hit_tokens"] += P
+            _counters["prefix_hit_tokens"] += matched_prefix
         else:
             _counters["prefix_misses"] += 1
+        bs = self.block_size
         full = len(prompt) // bs
         if full:
             created = self.prefix_cache.insert(prompt[:full * bs],
@@ -721,6 +757,107 @@ class GenerationEngine:
         self._note_pool()
         _counters["prefills"] += 1
         _counters["tokens_generated"] += 1
+
+    def _request_key(self, seed):
+        if seed is None:
+            seed = next(self._seed_counter)
+        return np.asarray(_sampling.request_key(self._base_key, seed),
+                          np.uint32)
+
+    def prefill(self, slot, prompt_ids, temperature=0.0, top_k=0,
+                top_p=1.0, seed=None, max_new_tokens=None):
+        """Admit a prompt into `slot`: match its longest cached block
+        prefix (shared blocks join the slot's table by refcount, their
+        prefill FLOPs skipped), allocate fresh blocks for the suffix +
+        generation budget, run the compiled suffix prefill, install the
+        slot state and publish the prompt's full blocks into the prefix
+        cache. Returns the first generated token (TTFT == prefill
+        latency). Raises ``PagePoolExhausted`` when the pool cannot cover
+        the request even after evicting cold prefixes (the scheduler's
+        ``can_admit`` pre-check makes that unreachable in normal
+        operation)."""
+        prompt = self._check_prompt(slot, prompt_ids)
+        table_ids, bt_row, P = self._admit_blocks(prompt, max_new_tokens)
+        key = self._request_key(seed)
+        try:
+            tok = self._prefill_call(prompt[P:], len(prompt), P, bt_row,
+                                     key, temperature, top_k, top_p)
+        except Exception:
+            self.pool.decref(table_ids)  # failed admission leaks nothing
+            self._note_pool()
+            raise
+        self._install_slot(slot, prompt, table_ids, bt_row, tok, key,
+                           temperature, top_k, top_p, P, max_new_tokens)
+        return tok
+
+    # -------------------------------------------------- chunked prefill --
+    def begin_prefill(self, slot, prompt_ids, temperature=0.0, top_k=0,
+                      top_p=1.0, seed=None, max_new_tokens=None,
+                      chunk_tokens=None):
+        """Start a CHUNKED admission (ISSUE 12): allocate the request's
+        worst-case blocks up front (identical admission budget to
+        ``prefill`` — chunking bounds LATENCY, never memory), match the
+        prefix cache, then leave the prompt to be processed in
+        block-aligned chunks by :meth:`prefill_chunk`. The slot is
+        reserved (not free, not active) until the final chunk samples the
+        first token, so decode iterations for in-flight streams
+        interleave between chunks instead of stalling behind one long
+        prompt. Returns the number of pending chunks."""
+        prompt = self._check_prompt(slot, prompt_ids)
+        bs = self.block_size
+        chunk = max(bs, (int(chunk_tokens or bs) // bs) * bs)
+        table_ids, bt_row, P = self._admit_blocks(prompt, max_new_tokens)
+        try:
+            self._reserve_extra(slot, prompt, max_new_tokens)
+        except Exception:
+            self.pool.decref(table_ids)  # failed admission leaks nothing
+            self._note_pool()
+            raise
+        self._mid_prefill[slot] = {
+            "prompt": prompt, "done": P, "chunk": chunk,
+            "table_ids": table_ids, "bt_row": bt_row,
+            "key": self._request_key(seed), "temperature": temperature,
+            "top_k": top_k, "top_p": top_p, "matched": P,
+            "max_new_tokens": max_new_tokens,
+        }
+        self._note_pool()
+        _counters["chunked_prefills"] += 1
+        return -(-(len(prompt) - P) // chunk)
+
+    def prefill_chunk(self, slot):
+        """Process the next chunk of a :meth:`begin_prefill` admission.
+        Returns ``None`` while chunks remain; the FINAL chunk samples the
+        request's first token, installs the slot (it joins the next
+        decode batch) and returns that token. Chunks reuse the ordinary
+        per-bucket prefill executable — earlier chunks are just a longer
+        'prefix' whose length is data, so a chunked prompt is token-
+        bitwise with an unchunked one."""
+        st = self._mid_prefill.get(slot)
+        if st is None:
+            raise RuntimeError(f"slot {slot} has no prefill in progress")
+        prompt, start = st["prompt"], st["done"]
+        end = min(start + st["chunk"], len(prompt))
+        try:
+            tok = self._prefill_call(
+                prompt[start:end], end, start, st["bt_row"], st["key"],
+                st["temperature"], st["top_k"], st["top_p"])
+            self._chunk_extra(slot, prompt, start, end)
+        except Exception:
+            # drop the chunk state; reserved extras (drafter blocks)
+            # come back when the scheduler releases the slot
+            del self._mid_prefill[slot]
+            self.pool.decref(st["table_ids"])
+            self._note_pool()
+            raise
+        st["done"] = end
+        _counters["prefill_chunks"] += 1
+        if end < len(prompt):
+            return None
+        del self._mid_prefill[slot]
+        self._install_slot(
+            slot, prompt, st["table_ids"], st["bt_row"], tok, st["key"],
+            st["temperature"], st["top_k"], st["top_p"], st["matched"],
+            st["max_new_tokens"])
         return tok
 
     # --------------------------------------------- prefill→decode handoff --

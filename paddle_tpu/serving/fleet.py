@@ -35,9 +35,18 @@ Fleet-wide versions of the per-replica contracts:
 
 Pods default to ``platform="cpu"`` — a host that owns an accelerator
 runs ONE engine per chip, and multiple pods racing to initialize one
-TPU would fight over the device; point each pod's env at its own chip
-(or run fleets per-host under ``distributed.launch``) for accelerator
-serving.
+TPU would fight over the device.  For accelerator fleets, ``platform``
+accepts a per-pod dict/list and ``pod_env`` injects per-pod environment
+(visible-device pinning) before any jax import, so one fleet runs one
+pod per chip::
+
+    ServingFleet(spec, pods=4, platform="tpu",
+                 pod_env={i: {"TPU_VISIBLE_DEVICES": str(i)}
+                          for i in range(4)})
+
+Passing ``draft={model spec}`` (+ ``draft_k``) builds every pod's engine
+as a ``DraftVerifyEngine`` — fleet-wide speculative decoding with the
+same bitwise routing/replay contracts.
 
 Quickstart::
 
@@ -116,7 +125,8 @@ class ServingFleet:
                  terminate_grace=5.0, monitor_interval=0.05,
                  connect_timeout=120.0, ack_timeout=15.0,
                  prefill_timeout=300.0, platform="cpu", log_dir=None,
-                 store=None, watch=None, pod_faults=None, env=None):
+                 store=None, watch=None, pod_faults=None, env=None,
+                 pod_env=None, draft=None, draft_k=4):
         self.model_spec = dict(model_spec)
         self.roles = list(roles) if roles is not None \
             else ["serve"] * int(pods)
@@ -140,6 +150,19 @@ class ServingFleet:
         self.watch = dict(watch) if watch else None
         self.pod_faults = dict(pod_faults or {})
         self._extra_env = dict(env or {})
+        # per-pod overrides (ISSUE 12 satellite — the PR 10 "pods default
+        # to cpu" residual): `platform` may be one string for the whole
+        # fleet, or a dict/list of per-pod platforms; `pod_env` maps pod
+        # index -> env dict applied in THAT pod only, before any jax
+        # import. An accelerator host runs one pod per chip:
+        #   ServingFleet(spec, pods=4, platform="tpu",
+        #                pod_env={i: {"TPU_VISIBLE_DEVICES": str(i)}
+        #                         for i in range(4)})
+        self.pod_env = {int(k): dict(v)
+                        for k, v in (pod_env or {}).items()}
+        # speculative decoding in every pod: a drafter model spec + K
+        self.draft_spec = dict(draft) if draft else None
+        self.draft_k = int(draft_k)
         self._log_dir = log_dir
         self._own_log_dir = None
         self.router = FleetRouter(
@@ -209,10 +232,25 @@ class ServingFleet:
         _registry.gauge_set("fleet.pods", len(self._handles))
         return self
 
+    def _platform_for(self, idx):
+        p = self.platform
+        if isinstance(p, dict):
+            return p.get(idx, p.get(None, "cpu"))
+        if isinstance(p, (list, tuple)):
+            return p[idx] if idx < len(p) else "cpu"
+        return p
+
     def _spawn_pod(self, idx, role):
+        plat = self._platform_for(idx)
         spec = {"model": self.model_spec, "role": role,
                 "engine": self.engine_kwargs, "server": self.server_kwargs,
-                "platform": self.platform}
+                "platform": plat}
+        if self.draft_spec:
+            spec["draft"] = self.draft_spec
+            spec["draft_k"] = self.draft_k
+        per_env = self.pod_env.get(idx)
+        if per_env:
+            spec["env"] = {str(k): str(v) for k, v in per_env.items()}
         if self.watch and role != "prefill":
             spec["watch"] = self.watch
         spec_path = os.path.join(self._log_dir, f"pod{idx}.json")
@@ -228,8 +266,10 @@ class ServingFleet:
             "PYTHONPATH": _repo_root() + os.pathsep
             + env.get("PYTHONPATH", ""),
         })
-        if self.platform:
-            env["JAX_PLATFORMS"] = self.platform
+        if plat:
+            env["JAX_PLATFORMS"] = plat
+        if per_env:
+            env.update({str(k): str(v) for k, v in per_env.items()})
         fault_spec = self.pod_faults.get(idx)
         if fault_spec:
             env["FLAGS_fault_inject"] = fault_spec

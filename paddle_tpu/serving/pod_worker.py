@@ -11,9 +11,17 @@ everything needed to rebuild the pod deterministically on a respawn:
     {"model":  {"kind": "gpt", "seed": 21, "config": {"n_layer": 2}},
      "role":   "serve",              // or "prefill" / "decode"
      "engine": {"max_batch_size": 4, "rng_seed": 0, "block_size": 16},
-     "server": {"max_queue_size": 16},
+     "server": {"max_queue_size": 16, "prefill_chunk_tokens": 64},
      "watch":  {"dir": "/ckpts/run0", "interval": 0.5},
-     "platform": "cpu"}
+     "draft":  {"kind": "gpt", "seed": 5, "config": {"n_layer": 1}},
+     "draft_k": 4,
+     "platform": "cpu",
+     "env": {"TPU_VISIBLE_DEVICES": "0"}}
+
+``draft`` (optional) builds a second model and promotes the engine to a
+``DraftVerifyEngine`` (ISSUE 12 speculative decoding); ``env`` entries
+land in ``os.environ`` before any jax import, so accelerator fleets run
+one pod per chip by pinning per-pod visible devices.
 
 ``model`` is either the built-in ``gpt`` kind (seeded ``GPTConfig``
 build — what tests/bench/smoke use) or ``{"factory": "pkg.mod:fn",
@@ -118,7 +126,20 @@ class PodWorker:
         model = _build_model(spec.get("model") or {})
         ekw = dict(spec.get("engine") or {})
         ekw.setdefault("rng_seed", 0)
-        self.engine = GenerationEngine(model, **ekw)
+        draft_spec = spec.get("draft")
+        if draft_spec:
+            # speculative-decode pod (ISSUE 12): a second, smaller model
+            # spec builds the drafter; the engine becomes draft-verify.
+            # Built AFTER the target so the target's seeded init draws
+            # are identical with or without a drafter.
+            from paddle_tpu.serving.spec_decode import DraftVerifyEngine
+
+            draft_model = _build_model(draft_spec)
+            self.engine = DraftVerifyEngine(
+                model, draft_model,
+                draft_k=int(spec.get("draft_k", 4)), **ekw)
+        else:
+            self.engine = GenerationEngine(model, **ekw)
         self.lock = threading.Lock()  # engine ops for scheduler-less roles
         self._reqs: dict = {}         # wire rid -> GenerationRequest
         self._rlock = threading.Lock()
@@ -302,12 +323,24 @@ class PodWorker:
     def _op_prefill(self, msg, send):
         """Disaggregated prefill side: run the prompt, export the KV
         blocks + first token, release the slot (the prefix cache keeps
-        the full prompt blocks for the next shared-prefix request)."""
-        from paddle_tpu.serving.block_pool import PagePoolExhausted
-        from paddle_tpu.serving.router import pack_payload
+        the full prompt blocks for the next shared-prefix request).
 
+        The engine work runs on a SIDE thread (serialized by the engine
+        lock) so the connection's handler loop keeps reading: a router
+        can keep many prefill requests in flight on ONE connection —
+        mid-matched replies land whenever each prefill finishes — instead
+        of one request per round-trip (the PR 10 residual)."""
         if self._faults.ACTIVE:
             self._faults.fire("pod_kill")
+        threading.Thread(target=self._do_prefill, args=(msg, send),
+                         daemon=True,
+                         name="paddle-tpu-pod-prefill").start()
+
+    def _do_prefill(self, msg, send):
+        from paddle_tpu.serving.block_pool import PagePoolExhausted
+        from paddle_tpu.serving.engine import FatalEngineError
+        from paddle_tpu.serving.router import pack_payload
+
         opts = self._options(msg)
         try:
             with self.lock:
@@ -326,6 +359,14 @@ class PodWorker:
                 self.engine.release(slot)
         except PagePoolExhausted as e:
             send({"op": "reject", "mid": msg["mid"], "reason": str(e)})
+            return
+        except FatalEngineError:
+            os._exit(17)
+        except Exception as e:
+            # off the handler loop now: this thread owns its own error
+            # reply (the _serve_conn catch-all can't see it)
+            send({"op": "error", "mid": msg["mid"],
+                  "error": f"{type(e).__name__}: {e}"})
             return
         send({"op": "prefill_done", "mid": msg["mid"], "first": first,
               "payload": pack_payload(payload)})
@@ -436,8 +477,15 @@ def main(argv=None):
         return 2
     with open(argv[0]) as f:
         spec = json.load(f)
+    # per-pod env overrides (ISSUE 12 satellite): applied BEFORE any
+    # jax import so accelerator fleets can pin one pod per chip
+    # (JAX_PLATFORMS, TPU_VISIBLE_DEVICES / CUDA_VISIBLE_DEVICES, ...).
+    # Spec env wins over inherited env; `platform` is the shorthand for
+    # JAX_PLATFORMS and loses to an explicit env entry.
     if spec.get("platform"):
         os.environ.setdefault("JAX_PLATFORMS", spec["platform"])
+    for k, v in (spec.get("env") or {}).items():
+        os.environ[str(k)] = str(v)
     worker = PodWorker(spec)
     worker.run()
     return 0

@@ -48,7 +48,13 @@ connection per pod, ``PodClient``) and holds the routing policy:
   pod chosen by the same affinity scheme; the decode pod adopts the
   slot (``engine.import_request_kv``) and streams tokens. The handoff
   rides the block-table serialization — raw block bytes, base64 over
-  the wire — and is token-bitwise with a monolithic pod.
+  the wire — and is token-bitwise with a monolithic pod.  Prefill
+  round-trips PIPELINE per connection (ISSUE 12 satellite, the PR 10
+  one-request-per-round-trip residual): ``PodClient.call`` is
+  mid-matched and thread-safe, and the pod runs each prefill on a side
+  thread, so N concurrent ``submit()`` callers keep N prefills in
+  flight on one socket — replies land as each engine-lock turn
+  finishes, not in lockstep.
 """
 from __future__ import annotations
 

@@ -117,11 +117,20 @@ class GenerationRequest:
 class ContinuousBatchScheduler:
     """Bounded admission queue feeding an engine's free slots each step."""
 
-    def __init__(self, engine, max_queue_size=16):
+    def __init__(self, engine, max_queue_size=16,
+                 prefill_chunk_tokens=None):
         self.engine = engine
         self.max_queue_size = int(max_queue_size)
+        # chunked prefill (ISSUE 12): prompts LONGER than this many
+        # tokens admit via engine.begin_prefill and process one
+        # block-aligned chunk per step(), interleaved with decode
+        # iterations — one 8k-token prompt can no longer stall every
+        # in-flight stream for its whole prefill. None disables.
+        self.prefill_chunk_tokens = None if prefill_chunk_tokens is None \
+            else int(prefill_chunk_tokens)
         self._queue: collections.deque = collections.deque()
         self._active: dict = {}  # slot -> request
+        self._prefilling: dict = {}  # slot -> request (chunked admission)
         self._lock = threading.Lock()
         self._rid = itertools.count(1)
         self._closed = False
@@ -154,7 +163,10 @@ class ContinuousBatchScheduler:
         return request
 
     def has_work(self):
-        return bool(self._queue or self._active)
+        return bool(self._queue or self._active or self._prefilling)
+
+    def prefilling(self):
+        return len(self._prefilling)
 
     def queued(self):
         return len(self._queue)
@@ -181,13 +193,15 @@ class ContinuousBatchScheduler:
             self._queue.clear()
         for req in pending:
             self._finish(req, RequestStatus.ERROR, error=reason)
-        for slot, req in list(self._active.items()):
+        for slot, req in list(self._active.items()) \
+                + list(self._prefilling.items()):
             self._finish(req, RequestStatus.ERROR, error=reason)
 
     def fail_all(self, exc):
         """Engine fault escape hatch: fail in-flight work loudly instead of
         wedging callers blocked on result()."""
-        for slot, req in list(self._active.items()):
+        for slot, req in list(self._active.items()) \
+                + list(self._prefilling.items()):
             self._finish(req, RequestStatus.ERROR, error=repr(exc))
 
     def takeover_requests(self):
@@ -204,8 +218,10 @@ class ContinuousBatchScheduler:
         with self._lock:
             queued = list(self._queue)
             self._queue.clear()
-        inflight = list(self._active.values())
+        inflight = list(self._active.values()) \
+            + list(self._prefilling.values())
         self._active.clear()
+        self._prefilling.clear()
         try:
             self.engine.reset()
         except Exception:
@@ -340,26 +356,71 @@ class ContinuousBatchScheduler:
                     # spin forever) and let decode progress free blocks
                     break
 
+        # (2b) chunked prefill (ISSUE 12): advance ONE block-aligned
+        # chunk per mid-prefill slot, then fall through to the decode
+        # iteration — every in-flight stream emits a token between
+        # chunks, so a long prompt bounds inter-token latency at one
+        # chunk's latency instead of its whole prefill
+        if self._prefilling:
+            now = time.monotonic()
+            for slot, req in list(self._prefilling.items()):
+                if req.deadline is not None and now > req.deadline:
+                    self._finish(req, RequestStatus.TIMEOUT)
+                    continue
+                try:
+                    first = self.engine.prefill_chunk(slot)
+                except Exception as e:
+                    # the engine dropped the chunk state and its blocks;
+                    # same terminal split as _admit
+                    self._finish(req, RequestStatus.ERROR, error=str(e))
+                    if not isinstance(e, (ValueError, TypeError)):
+                        raise
+                    continue
+                if first is None:
+                    continue
+                self._prefilling.pop(slot, None)
+                self._active[slot] = req
+                now = time.monotonic()
+                req.ttft_s = now - req.submit_ts
+                _registry.timing("ttft", req.ttft_s, scope="serving")
+                self._append_token(req, first, now)
+
         # (3) one decode iteration over every active slot; per-request
         # stop-condition bookkeeping happens once per iteration at this
-        # batch boundary (one shared timestamp, no per-token clock reads)
+        # batch boundary (one shared timestamp, no per-token clock reads).
+        # A speculative engine (decode_step_spec) emits 1..K+1 tokens per
+        # slot per iteration — each bitwise-equal to plain decode's — and
+        # stop conditions are applied per token in emission order.
         if self._active:
-            toks = self._decode_with_retry()
-            now = time.monotonic()
-            for slot, req in list(self._active.items()):
-                self._append_token(req, int(toks[slot]), now)
+            spec = getattr(self.engine, "decode_step_spec", None)
+            if spec is not None:
+                per_slot = self._decode_with_retry(spec)
+                now = time.monotonic()
+                for slot, req in list(self._active.items()):
+                    toks = per_slot[slot]
+                    base = self.engine.slot_len(slot) - len(toks)
+                    for i, t in enumerate(toks):
+                        self._append_token(req, int(t), now,
+                                           slot_len=base + i + 1)
+                        if req.done:
+                            break
+            else:
+                toks = self._decode_with_retry(self.engine.decode_step)
+                now = time.monotonic()
+                for slot, req in list(self._active.items()):
+                    self._append_token(req, int(toks[slot]), now)
 
         self._update_throughput()
         return self.has_work()
 
-    def _decode_with_retry(self):
+    def _decode_with_retry(self, step_fn):
         """One decode iteration with single-retry fault tolerance: a
         transient engine exception re-primes the decode executable and
         retries once; only the SECOND consecutive error propagates (the
         server loop then fails the batch). Fatal errors (replica death)
         are never retried — they must reach the supervisor."""
         try:
-            return self.engine.decode_step()
+            return step_fn()
         except FatalEngineError:
             raise
         except Exception as e:
@@ -373,7 +434,7 @@ class ContinuousBatchScheduler:
             reprime = getattr(self.engine, "reprime", None)
             if reprime is not None:
                 reprime()
-            return self.engine.decode_step()
+            return step_fn()
 
     def drain(self, timeout=None):
         """Run step() until idle (graceful drain); True if fully drained."""
@@ -390,8 +451,35 @@ class ContinuousBatchScheduler:
         """Prefill `req` into `slot`. Returns False when admission hit
         pool pressure and the request was requeued (the caller must stop
         admitting this step — retrying immediately would spin); True for
-        every terminal outcome (admitted or failed)."""
+        every terminal outcome (admitted, chunk-admitted or failed)."""
         t_start = time.monotonic()
+        begin = getattr(self.engine, "begin_prefill", None)
+        if (self.prefill_chunk_tokens is not None and begin is not None
+                and req.kv_payload is None
+                and len(req.prompt_ids) > self.prefill_chunk_tokens):
+            # long prompt: chunked admission — blocks budgeted up front
+            # (identical to prefill), chunks land in step()'s phase (2b)
+            try:
+                begin(slot, req.prompt_ids, temperature=req.temperature,
+                      top_k=req.top_k, top_p=req.top_p, seed=req.seed,
+                      max_new_tokens=req.max_new_tokens,
+                      chunk_tokens=self.prefill_chunk_tokens)
+            except PagePoolExhausted:
+                _counters["pool_exhausted"] += 1
+                with self._lock:
+                    self._queue.appendleft(req)
+                return False
+            except Exception as e:
+                self._finish(req, RequestStatus.ERROR, error=str(e))
+                if not isinstance(e, (ValueError, TypeError)):
+                    raise
+                return True
+            req.slot = slot
+            req.status = RequestStatus.RUNNING
+            self._prefilling[slot] = req
+            _registry.timing("queue_wait", t_start - req.submit_ts,
+                             scope="serving")
+            return True
         try:
             first = None
             if req.kv_payload is not None:
@@ -452,14 +540,20 @@ class ContinuousBatchScheduler:
         self._append_token(req, first, now)
         return True
 
-    def _append_token(self, req, token, now):
+    def _append_token(self, req, token, now, slot_len=None):
+        # slot_len: the sequence length AS OF this token (the spec path
+        # appends a whole round at once, so the engine's cursor is past
+        # the intermediate tokens — the length stop must see each
+        # token's own position, exactly as plain decode would have)
         req.tokens.append(token)
+        if slot_len is None and req.slot is not None:
+            slot_len = self.engine.slot_len(req.slot)
         if req.eos_id is not None and token == req.eos_id:
             self._finish(req, RequestStatus.DONE, stop_reason="eos")
         elif len(req.tokens) >= req.max_new_tokens:
             self._finish(req, RequestStatus.DONE, stop_reason="max_tokens")
         elif req.slot is not None and \
-                self.engine.slot_len(req.slot) >= self.engine.max_seq_len:
+                slot_len >= self.engine.max_seq_len:
             self._finish(req, RequestStatus.DONE, stop_reason="length")
         elif req.deadline is not None and now > req.deadline:
             self._finish(req, RequestStatus.TIMEOUT)
@@ -468,6 +562,7 @@ class ContinuousBatchScheduler:
         if req.slot is not None:
             self.engine.release(req.slot)
             self._active.pop(req.slot, None)
+            self._prefilling.pop(req.slot, None)
             req.slot = None
         req.status = status
         req.stop_reason = stop_reason

@@ -129,19 +129,30 @@ class GenerationServer:
     def __init__(self, model=None, engine=None, max_batch_size=4,
                  buckets=None, max_seq_len=None, max_queue_size=16,
                  idle_wait_s=0.005, fail_fast_on_fatal=True,
-                 block_size=16, num_blocks=None, mesh=None):
+                 block_size=16, num_blocks=None, mesh=None,
+                 draft_model=None, draft_k=4, prefill_chunk_tokens=None):
         if engine is None:
             if model is None:
                 raise ValueError("GenerationServer needs a model or an "
                                  "engine")
-            engine = GenerationEngine(model, max_batch_size=max_batch_size,
-                                      buckets=buckets,
-                                      max_seq_len=max_seq_len,
-                                      block_size=block_size,
-                                      num_blocks=num_blocks, mesh=mesh)
+            ekw = dict(max_batch_size=max_batch_size, buckets=buckets,
+                       max_seq_len=max_seq_len, block_size=block_size,
+                       num_blocks=num_blocks, mesh=mesh)
+            if draft_model is not None:
+                # speculative decoding (ISSUE 12): a small drafter
+                # proposes draft_k tokens per iteration, the target
+                # verifies them in one fixed-shape forward — bitwise-
+                # equal tokens, fewer target forwards per token
+                from .spec_decode import DraftVerifyEngine
+
+                engine = DraftVerifyEngine(model, draft_model,
+                                           draft_k=draft_k, **ekw)
+            else:
+                engine = GenerationEngine(model, **ekw)
         self.engine = engine
         self.scheduler = ContinuousBatchScheduler(
-            engine, max_queue_size=max_queue_size)
+            engine, max_queue_size=max_queue_size,
+            prefill_chunk_tokens=prefill_chunk_tokens)
         self._idle_wait_s = float(idle_wait_s)
         self._work = threading.Condition()
         self._stop = threading.Event()      # hard stop at next boundary

@@ -1,0 +1,573 @@
+"""paddle_tpu.serving.spec_decode — draft-verify speculative decoding.
+
+The tentpole of ISSUE 12: cut per-output-token latency by letting a small
+DRAFTER model propose ``K`` tokens per iteration and having the target
+model check all of them in ONE fixed-shape ``[B, K+1]`` forward, instead
+of paying one full target forward per token.
+
+Why the acceptance rule is EXACT here (not the approximate
+accept/reject of Leviathan et al. 2023): this serving stack's sampler is
+the seeded Gumbel-max (``serving.sampling``) — the token a request emits
+at generated-token index ``i`` is a DETERMINISTIC function of (target
+logits at that position, request key, ``i``).  The verify step therefore
+replays the exact per-(key, index) Gumbel draw on the target's own
+logits at every drafted position and compares: a draft token is accepted
+iff it EQUALS what plain decode would have sampled there, at any
+temperature.  Accepted tokens are bitwise-identical to plain decode by
+construction; the first mismatch position yields the target's own sample
+as a free correction token, and an all-accept round yields a bonus
+(K+1)-th token.  A worst-case-wrong drafter (the ``draft_garbage`` fault)
+degrades THROUGHPUT to plain decode (one token per round) but can never
+change a single emitted token.
+
+Shapes and executables (the compile discipline):
+
+* drafter round — ONE executable: a fixed-trip ``lax.scan`` of K+1
+  ``[B, 1]`` drafter steps (cursors are data).  Scan steps 0..K-1
+  propose ``d_1..d_K`` (sampling with the SAME seeded Gumbel noise the
+  target will use at those indices, which is what makes acceptance
+  high at temperature > 0), and step K ingests ``d_K`` into the
+  drafter's KV so the drafter never falls behind the accepted sequence
+  — the round feeds the drafter exactly the token window
+  ``[last, d_1..d_K]`` that the verify step consumes.
+* target verify — ONE ``[B, K+1]`` executable per engine (per K): ids,
+  cursors, block tables, sampling knobs and the accept arithmetic are
+  all arrays inside the jit, so no acceptance pattern can retrace.  PR
+  8's replay fast path survives: the steady round is exactly TWO
+  executable calls (draft scan + verify) on a prebuilt device-side arg
+  tuple with zero per-op Python — host overhead independent of K.
+
+Rollback without bookkeeping: the verify step writes K+1 KV rows but a
+rejection only advances the cursors by the accepted count.  Rows past
+the new cursor hold rejected-draft garbage — they are masked out of
+every attention read (``jpos <= row`` caps at the query's own position)
+and the NEXT round's writes cover exactly that span (``new_len ..
+new_len+K`` ⊇ ``old_len+m .. old_len+K``), so stale rows are overwritten
+before any query can reach them.  No block is ever allocated for
+speculation (writes past the slot's budgeted blocks redirect to the
+reserved garbage block), so ``BlockPool.audit()`` stays clean at every
+boundary and rejected speculation can't leak memory by construction.
+
+The drafter's KV rides its OWN ``BlockPool`` + block tables (same block
+geometry, separate device pools — the drafter's head count differs),
+budgeted at admission exactly like the target's, so drafter memory obeys
+the same never-exhausts-mid-flight contract.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..core import autograd as _ag
+from ..core import lazy as _lazy
+from ..core.tensor import Tensor
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+from ..testing import faults as _faults
+from . import sampling as _sampling
+from .block_pool import BlockPool, PagePoolExhausted
+from .engine import GenerationEngine
+from .engine import _counters as _serving_counters
+from .engine import _fp_counters
+
+__all__ = ["DraftVerifyEngine"]
+
+# speculative-decode counters live in the shared "serving" scope so
+# stats_dump/bench read one table; verify_compiles/draft_compiles feed
+# the engine's signature radar (phases "verify" / "draft")
+_counters = _registry.scoped_counters("serving", {
+    "spec_rounds": 0, "spec_slot_rounds": 0, "spec_proposed": 0,
+    "spec_accepted": 0, "spec_emitted": 0, "draft_prefills": 0,
+    "verify_compiles": 0, "draft_compiles": 0,
+    "draft_kv_blocks_hwm": 0})
+
+
+class DraftVerifyEngine(GenerationEngine):
+    """A :class:`GenerationEngine` whose decode loop is draft-verify
+    speculative decoding.  Drop-in for the scheduler/server: admission,
+    paged-KV budgeting, prefix reuse, weight swaps and the handoff
+    protocol are inherited; only the per-iteration decode differs — the
+    scheduler discovers :meth:`decode_step_spec` and consumes a variable
+    number of tokens per slot per iteration.
+
+    ``draft_model`` must share the target's vocabulary (token ids are
+    compared for acceptance) and block geometry is shared by
+    construction; everything else (depth, width, heads) is free — the
+    canonical pairing is gpt2-tiny drafting for gpt2-medium.  The
+    drafter's weights are fixed for the engine's lifetime: a target
+    ``swap_weights`` keeps serving bitwise-correct (acceptance is
+    re-checked against the NEW target every round) at a possibly lower
+    acceptance rate until the drafter is rebuilt.
+    """
+
+    def __init__(self, model, draft_model, draft_k=4,
+                 draft_num_blocks=None, **kw):
+        if kw.get("mesh") is not None:
+            raise ValueError(
+                "DraftVerifyEngine does not support mesh-sharded decode "
+                "yet — shard the plain GenerationEngine, or serve the "
+                "spec engine single-chip")
+        super().__init__(model, **kw)
+        self.draft_k = int(draft_k)
+        if self.draft_k < 1:
+            raise ValueError("draft_k must be >= 1")
+        dgpt = getattr(draft_model, "gpt", draft_model)
+        if not hasattr(dgpt, "blocks") or not hasattr(dgpt, "embeddings"):
+            raise TypeError(
+                "draft_model needs a GPTModel-shaped decoder; got "
+                f"{type(draft_model).__name__}")
+        if dgpt.cfg.vocab_size != self._gpt.cfg.vocab_size:
+            raise ValueError(
+                f"drafter vocab {dgpt.cfg.vocab_size} != target vocab "
+                f"{self._gpt.cfg.vocab_size} — acceptance compares token "
+                "ids, the vocabularies must match")
+        if dgpt.cfg.seq_len < self.max_seq_len:
+            raise ValueError(
+                f"drafter position range {dgpt.cfg.seq_len} < engine "
+                f"max_seq_len {self.max_seq_len}")
+        if hasattr(draft_model, "eval"):
+            draft_model.eval()
+        self._draft_model = draft_model
+        self._dgpt = dgpt
+        self._dstate = dict(dgpt.state_dict())
+        self._dnames = list(self._dstate)
+        dwt = dgpt.embeddings.word_embeddings.weight
+        self._demb_idx = next(
+            i for i, n in enumerate(self._dnames)
+            if self._dstate[n] is dwt)
+        self._ddtype = dwt._data.dtype
+
+        # drafter paged KV: same block geometry as the target (tables
+        # share the row math), its own pool arrays (drafter head count
+        # differs) and its own host-side accounting
+        B = self.max_batch_size
+        if draft_num_blocks is None:
+            draft_num_blocks = 1 + B * self.blocks_per_slot
+        self.draft_pool = BlockPool(draft_num_blocks, name="draft")
+        Nb, bs = self.draft_pool.num_blocks, self.block_size
+        self._dkv_shapes = [(Nb, bs, blk.attn.n_head, blk.attn.head_dim)
+                            for blk in dgpt.blocks]
+        self._dk = [jnp.zeros(s, self._ddtype) for s in self._dkv_shapes]
+        self._dv = [jnp.zeros(s, self._ddtype) for s in self._dkv_shapes]
+        self._draft_tables = np.zeros((B, self.blocks_per_slot), np.int32)
+        self._draft_blocks = [[] for _ in range(B)]
+        # drafter ingest cursor per slot: how many prompt rows the
+        # drafter's KV holds (trails the target's chunk cursor when the
+        # target prefix-hits; advanced window by window)
+        self._draft_ingested = [0] * B
+        self._dstate_tuple = None
+
+        self._draft_prefill_jit = jax.jit(self._draft_prefill_pure,
+                                          donate_argnums=self._donate)
+        self._draft_round_jit = jax.jit(self._draft_round_pure,
+                                        donate_argnums=self._donate)
+        self._verify_jit = jax.jit(self._verify_pure,
+                                   donate_argnums=self._donate)
+        # draft_garbage fault: a constant worst-case-wrong proposal block
+        self._garbage_drafts = self._put(
+            np.zeros((self.draft_k, B), np.int32))
+
+    # ---------------------------------------------------- drafter state --
+    def _draft_arrays(self):
+        cached = self._dstate_tuple
+        if cached is None:
+            cached = self._dstate_tuple = tuple(
+                self._dstate[n]._data for n in self._dnames)
+        return cached
+
+    def _forward_draft(self, dstate_arrays, ids, positions, ks, vs,
+                       offsets, seq_lens, block_tables):
+        """The drafter's trace-time parameter rebinding — same
+        StaticFunction state-swap idiom as the target's
+        ``_forward_slot``, against the drafter's own module tree."""
+        old = {n: self._dstate[n]._data for n in self._dnames}
+        for n, arr in zip(self._dnames, dstate_arrays):
+            self._dstate[n]._data = arr
+        try:
+            with _ag.no_grad(), _lazy.lazy_guard(False):
+                caches = [(Tensor(k), Tensor(v))
+                          for k, v in zip(ks, vs)]
+                hidden, new_caches = self._dgpt(
+                    Tensor(ids), position_ids=Tensor(positions),
+                    caches=caches, cache_offsets=Tensor(offsets),
+                    seq_lens=Tensor(seq_lens),
+                    block_tables=Tensor(block_tables))
+            return (hidden._data,
+                    tuple(c[0]._data for c in new_caches),
+                    tuple(c[1]._data for c in new_caches))
+        finally:
+            for n in self._dnames:
+                self._dstate[n]._data = old[n]
+
+    # ----------------------------------------------------- pure step fns --
+    def _draft_prefill_pure(self, dstate, ks, vs, ids, start, end,
+                            block_table):
+        """Drafter prompt ingestion at bucket shape [1, L]: fills the
+        drafter's KV rows start..end-1 (start/end are data, so a full
+        prompt and a chunk window share one executable per bucket).  No
+        sampling — the target's prefill sample is the authoritative
+        first token; the drafter only needs the context."""
+        L = ids.shape[1]
+        positions = jnp.minimum(
+            start[:, None] + jnp.arange(L, dtype=jnp.int32)[None],
+            self.max_seq_len - 1)
+        _, nk, nv = self._forward_draft(
+            dstate, ids, positions, ks, vs, start, end, block_table)
+        return nk, nv
+
+    def _draft_round_pure(self, dstate, ks, vs, last_tokens, cur_lens,
+                          keys, gen_idx, temps, top_ks, top_ps,
+                          block_tables):
+        """The WHOLE drafting round as one executable: a fixed-trip
+        ``lax.scan`` of K+1 drafter [B, 1] steps.  Step j feeds each
+        slot's chained token at row cur_len+j, scatters its drafter-KV
+        row, and samples the proposal with the SAME seeded Gumbel draw
+        the target will replay at generated-token index gen_idx+j — at
+        temperature 0 this is greedy drafting, above it the drafter
+        mimics the exact noise realization, which is what keeps
+        acceptance high for sampled requests.  The final step ingests
+        d_K (proposal discarded) so the drafter's KV never trails the
+        accepted sequence after an all-accept round.  One scan = one
+        dispatch per round instead of K+1 — the drafter's host overhead
+        does not scale with K."""
+        w = dstate[self._demb_idx]
+
+        def step(carry, j):
+            feed, ks, vs = carry
+            rows = cur_lens + j
+            positions = jnp.minimum(rows, self.max_seq_len - 1)[:, None]
+            hidden, nk, nv = self._forward_draft(
+                dstate, feed[:, None], positions, ks, vs,
+                positions[:, 0], rows + 1, block_tables)
+            logits = (hidden[:, 0].astype(jnp.float32)
+                      @ w.T.astype(jnp.float32))
+            gum = _sampling.gumbel_rows(keys, gen_idx + j,
+                                        logits.shape[-1])
+            toks = _sampling.sample_tokens(logits, temps, top_ks,
+                                           top_ps, gum)
+            return (toks, nk, nv), toks
+
+        (_, nk, nv), props = jax.lax.scan(
+            step, (last_tokens, ks, vs),
+            jnp.arange(self.draft_k + 1, dtype=jnp.int32))
+        return props[:self.draft_k], nk, nv
+
+    def _verify_pure(self, state, ks, vs, last_tokens, drafts, cur_lens,
+                     keys, gen_idx, temps, top_ks, top_ps, active,
+                     block_tables):
+        """THE verify step: one [B, K+1] target forward over
+        [last, d_1..d_K] (``drafts`` is the draft round's [K, B]
+        proposal block), then an exact replay of the seeded Gumbel-max
+        draw at every position.  ``accepts[b]`` = number of leading
+        drafts equal to the target's own samples; ``emitted`` = accepts
+        + 1 (the correction/bonus token), capped at the sequence
+        ceiling.  Cursor state advances IN the step (masked by
+        ``active``) so the steady fast path keeps it on device."""
+        K = self.draft_k
+        ids = jnp.concatenate([last_tokens[:, None], drafts.T], axis=1)
+        offs = jnp.arange(K + 1, dtype=jnp.int32)
+        positions = jnp.minimum(cur_lens[:, None] + offs[None],
+                                self.max_seq_len - 1)
+        hidden, nk, nv = self._forward_slot(
+            state, ids, positions, ks, vs, cur_lens,
+            cur_lens + K + 1, block_tables)
+        w = state[self._emb_idx]
+        B = ids.shape[0]
+        flat = hidden.astype(jnp.float32).reshape(B * (K + 1), -1)
+        logits = flat @ w.T.astype(jnp.float32)
+        rep = lambda a: jnp.repeat(a, K + 1, axis=0)  # noqa: E731
+        idxs = (gen_idx[:, None] + offs[None]).reshape(-1)
+        gum = _sampling.gumbel_rows(rep(keys), idxs, logits.shape[-1])
+        toks = _sampling.sample_tokens(
+            logits, rep(temps), rep(top_ks), rep(top_ps), gum)
+        sampled = toks.reshape(B, K + 1)
+        matches = (sampled[:, :K] == ids[:, 1:]).astype(jnp.int32)
+        accepts = jnp.cumprod(matches, axis=1).sum(axis=1)
+        emitted = jnp.where(
+            active,
+            jnp.minimum(accepts + 1, self.max_seq_len - cur_lens),
+            0).astype(cur_lens.dtype)
+        last_idx = jnp.maximum(emitted - 1, 0)
+        new_last = jnp.where(
+            active & (emitted > 0),
+            jnp.take_along_axis(sampled, last_idx[:, None], axis=1)[:, 0],
+            last_tokens)
+        return (sampled, accepts, emitted, nk, nv, new_last,
+                cur_lens + emitted,
+                gen_idx + emitted.astype(gen_idx.dtype))
+
+    # --------------------------------------------------------- admission --
+    def can_admit(self, prompt_ids, max_new_tokens=None):
+        """Both pools must cover the worst case: the target's (prefix
+        discount counted, as before) AND the drafter's (no prefix
+        sharing — the drafter always ingests the full prompt)."""
+        if not super().can_admit(prompt_ids, max_new_tokens):
+            return False
+        return self.blocks_needed(len(prompt_ids), max_new_tokens) \
+            <= self.draft_pool.free_count()
+
+    def can_import(self, payload):
+        if not super().can_import(payload):
+            return False
+        # adopted slots budget the drafter's worst case (max_new unknown
+        # on this side → full ceiling), mirroring the conservative
+        # contract: True ⇒ the import cannot raise
+        return self.blocks_per_slot <= self.draft_pool.free_count()
+
+    def _reserve_extra(self, slot, prompt, max_new_tokens):
+        """Reserve the drafter's worst-case block budget at ADMISSION
+        time (``begin_prefill`` calls this before any chunk lands, so a
+        drafter-pool shortage is admission backpressure, never a
+        mid-flight failure; the scheduler's ``can_admit`` pre-check
+        makes it unreachable in normal operation).  The drafter skips
+        the prefix cache — it is cheap by design and shared blocks
+        would pin two pools together."""
+        if self._draft_blocks[slot]:
+            return  # already reserved (chunked admission)
+        need = self.blocks_needed(len(prompt), max_new_tokens)
+        fresh = self.draft_pool.alloc(need)
+        dt_row = np.zeros(self.blocks_per_slot, np.int32)
+        dt_row[:need] = fresh
+        self._draft_blocks[slot] = fresh
+        self._draft_tables[slot] = dt_row
+        self._draft_ingested[slot] = 0
+        used = self.draft_pool.in_use()
+        if used > _counters["draft_kv_blocks_hwm"]:
+            _counters["draft_kv_blocks_hwm"] = used
+
+    def _draft_ingest(self, slot, prompt, end):
+        """Feed drafter KV rows up to ``end``: one [1, L] window from
+        the drafter's own progress cursor (the drafter has no prefix
+        cache, so its cursor can trail the target's chunk start)."""
+        start = self._draft_ingested[slot]
+        if end <= start:
+            return
+        window = prompt[start:end]
+        L = self.bucket_for(len(window))
+        ids = np.zeros((1, L), np.int32)
+        ids[0, :len(window)] = window
+        args = (self._draft_arrays(), tuple(self._dk), tuple(self._dv),
+                self._put(ids),
+                self._put(np.asarray([start], np.int32)),
+                self._put(np.asarray([end], np.int32)),
+                self._put(self._draft_tables[slot][None]))
+        self._note_signature(
+            "draft", args[3:],
+            f"draft_prefill bucket_len={L}")
+        nk, nv = self._draft_prefill_jit(*args)
+        self._dk, self._dv = list(nk), list(nv)
+        self._draft_ingested[slot] = end
+        _counters["draft_prefills"] += 1
+
+    def _chunk_extra(self, slot, prompt, start, end):
+        """Per-chunk hook: the drafter ingests (at least) the same
+        window, so a chunked admission's drafter catch-up is bounded by
+        ~one chunk per step too — no whole-prompt drafter stall at
+        installation (the first chunk additionally covers the target's
+        prefix-cache hit span, which the drafter must compute)."""
+        self._draft_ingest(slot, prompt, end)
+
+    def _install_extra(self, slot, prompt, max_new_tokens):
+        """Admission hook: reserve (if the chunked path hasn't already)
+        and finish the drafter's prompt ingestion."""
+        self._reserve_extra(slot, prompt, max_new_tokens)
+        try:
+            self._draft_ingest(slot, prompt, len(prompt))
+        except Exception:
+            self.draft_pool.decref(self._draft_blocks[slot])
+            self._draft_blocks[slot] = []
+            self._draft_tables[slot] = 0
+            self._draft_ingested[slot] = 0
+            raise
+
+    def release(self, slot):
+        if self._draft_blocks[slot]:
+            self.draft_pool.decref(self._draft_blocks[slot])
+            self._draft_blocks[slot] = []
+        self._draft_tables[slot] = 0
+        self._draft_ingested[slot] = 0
+        super().release(slot)
+
+    def import_request_kv(self, slot, payload, prompt_ids=None):
+        """Adopt a prefill-pod handoff: the target KV arrives verbatim
+        (bitwise), the DRAFTER re-ingests the prompt locally — its KV
+        never crosses the wire (drafter geometries may differ pod to
+        pod, and drafter state is a throughput hint, never correctness).
+        Only fresh handoffs (cur_len == prompt length) are adoptable:
+        past that the drafter would be missing generated context."""
+        if prompt_ids is None:
+            raise ValueError(
+                "DraftVerifyEngine.import_request_kv needs prompt_ids "
+                "(the drafter re-ingests the prompt)")
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if int(payload["cur_len"]) != len(prompt):
+            raise ValueError(
+                "DraftVerifyEngine only adopts fresh prefill handoffs "
+                f"(payload cur_len {payload['cur_len']} != prompt length "
+                f"{len(prompt)}) — the drafter cannot reconstruct "
+                "mid-generation context")
+        first = super().import_request_kv(slot, payload,
+                                          prompt_ids=prompt_ids)
+        try:
+            self._install_extra(slot, prompt, None)
+        except Exception:
+            super().release(slot)
+            raise
+        return first
+
+    # ------------------------------------------------------------ decode --
+    def reprime(self):
+        """Transient-fault recovery: rebuild the verify + drafter
+        executables alongside the base decode path and forget their
+        radar signatures (the retry's recompiles must count)."""
+        super().reprime()
+        self._verify_jit = jax.jit(self._verify_pure,
+                                   donate_argnums=self._donate)
+        self._draft_round_jit = jax.jit(self._draft_round_pure,
+                                        donate_argnums=self._donate)
+        self._seen_sigs = {s for s in self._seen_sigs
+                           if s[0] not in ("verify", "draft")}
+
+    def decode_step_spec(self):
+        """One speculative iteration over all slots: K+1 drafter steps,
+        one [B, K+1] target verify, exact acceptance.  Returns a list of
+        per-slot emitted-token lists (empty for inactive lanes) — 1 to
+        K+1 tokens per active slot, each bitwise-equal to what
+        ``decode_step`` would have produced one at a time.
+
+        Steady fast path (PR 8 contract): between batch-boundary events
+        the round runs on a prebuilt device-side arg tuple — no host
+        uploads, no radar walk; a periodic audit cross-checks device
+        cursors against the host mirrors and demotes on mismatch."""
+        active = self._active
+        n_active = int(active.sum())
+        if n_active == 0:
+            raise RuntimeError("decode_step_spec with no active slots")
+        if _faults.ACTIVE:
+            _faults.fire("slow_decode")
+            _faults.fire("pod_slow")
+            _faults.fire("replica_kill")
+            _faults.fire("decode_error")
+        fast = self._fast
+        if fast is not None \
+                and self._decode_since_audit + 1 >= self._audit_every:
+            self._audit_fast(fast)
+            fast = self._fast
+        if fast is None:
+            fast = (self._put(self._last_tokens),
+                    self._put(self._cur_lens), self._put(self._keys),
+                    self._put(self._gen_idx), self._put(self._temps),
+                    self._put(self._top_ks), self._put(self._top_ps),
+                    self._put(active), self._put(self._block_tables),
+                    self._put(self._draft_tables))
+            # radar probe with the real call's avals (the proposal block
+            # is i32[K, B] like the garbage const) so a verify retrace
+            # is loud
+            probe = (self._state_arrays(), tuple(self._k),
+                     tuple(self._v), fast[0],
+                     self._garbage_drafts) + fast[1:9]
+            self._note_signature(
+                "verify", probe,
+                f"K={self.draft_k}, max_batch={self.max_batch_size}")
+            self._note_signature(
+                "draft", (fast[0], fast[1], fast[9]),
+                f"draft round K={self.draft_k}")
+            self._decode_since_audit = 0
+            _fp_counters["decode_rebuilds"] += 1
+        else:
+            self._decode_since_audit += 1
+            _fp_counters["decode_fast_steps"] += 1
+        return self._spec_round(fast, active, n_active)
+
+    def _spec_round(self, fast, active, n_active):
+        (last, lens, keys, gen, temps, tks, tps, act, bt, dbt) = fast
+        K = self.draft_k
+        dstate = self._draft_arrays()
+        with _registry.time_block("decode_step", scope="serving"):
+            drafts, ndk, ndv = self._draft_round_jit(
+                dstate, tuple(self._dk), tuple(self._dv), last, lens,
+                keys, gen, temps, tks, tps, dbt)
+            self._dk, self._dv = list(ndk), list(ndv)
+            if _faults.ACTIVE and _faults.fire("draft_garbage"):
+                # worst-case-wrong drafter: every proposal replaced by a
+                # constant.  Acceptance must reject them all and the
+                # emitted stream must stay bitwise-identical — the
+                # drafter's own (correct) KV ingests above are stale
+                # rows the next round overwrites either way.
+                drafts = self._garbage_drafts
+            (sampled_d, accepts_d, emitted_d, nk, nv, nlast, nlens,
+             ngen) = self._verify_jit(
+                self._state_arrays(), tuple(self._k), tuple(self._v),
+                last, drafts, lens, keys, gen, temps, tks, tps,
+                act, bt)
+            sampled = np.asarray(sampled_d)
+            accepts = np.asarray(accepts_d)
+            emitted = np.asarray(emitted_d)
+        self._k, self._v = list(nk), list(nv)
+        self._fast = (nlast, nlens, keys, ngen, temps, tks, tps, act,
+                      bt, dbt)
+        out = [[] for _ in range(self.max_batch_size)]
+        total = 0
+        c = _counters
+        for b in np.nonzero(active)[0]:
+            m = int(emitted[b])
+            toks = [int(t) for t in sampled[b, :m]]
+            out[b] = toks
+            total += m
+            self._cur_lens[b] += m
+            self._gen_idx[b] += m
+            if m:
+                self._last_tokens[b] = toks[-1]
+            c["spec_accepted"] += int(accepts[b])
+            c["spec_proposed"] += K
+            c["spec_emitted"] += m
+        c["spec_rounds"] += 1
+        c["spec_slot_rounds"] += n_active
+        sc = _serving_counters
+        sc["decode_steps"] += 1
+        sc["active_slot_steps"] += n_active
+        sc["tokens_generated"] += total
+        _registry.gauge_set("serving.batch_occupancy",
+                            n_active / self.max_batch_size)
+        return out
+
+    def _audit_fast(self, fast):
+        """Spec-round audit: base cursor checks plus the drafter's block
+        tables (index 9 of the spec fast tuple)."""
+        _fp_counters["decode_audit_runs"] += 1
+        self._decode_since_audit = 0
+        ok = (np.array_equal(np.asarray(fast[0]), self._last_tokens)
+              and np.array_equal(np.asarray(fast[1]), self._cur_lens)
+              and np.array_equal(np.asarray(fast[3]), self._gen_idx)
+              and np.array_equal(np.asarray(fast[7]), self._active)
+              and np.array_equal(np.asarray(fast[8]), self._block_tables)
+              and np.array_equal(np.asarray(fast[9]),
+                                 self._draft_tables))
+        if not ok:
+            _fp_counters["decode_demotions"] += 1
+            self._fast = None
+            _explain.record(
+                "fastpath_demoted", op="serving.spec_decode",
+                reason="decode_audit",
+                why="spec-decode audit: device-side slot state diverged "
+                    "from the host mirrors; rebuilding from host state")
+
+    # -------------------------------------------------------------- stats --
+    def acceptance_rate(self):
+        p = _counters["spec_proposed"]
+        return _counters["spec_accepted"] / p if p else 0.0
+
+    def accepted_len_mean(self):
+        """Mean tokens emitted per slot per speculative round (1.0 =
+        plain-decode speed, K+1 = perfect drafter)."""
+        r = _counters["spec_slot_rounds"]
+        return _counters["spec_emitted"] / r if r else 0.0
+
+    def stats(self):
+        return {**super().stats(),
+                "draft_k": self.draft_k,
+                "acceptance_rate": self.acceptance_rate(),
+                "accepted_len_mean": self.accepted_len_mean(),
+                "draft_kv_blocks_total": self.draft_pool.usable_blocks,
+                "draft_kv_blocks_in_use": self.draft_pool.in_use()}

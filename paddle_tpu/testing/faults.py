@@ -35,6 +35,12 @@ accepted by :func:`configure` directly::
                                          a silently-perturbed signature
     "mutate_signature:nth=3,mode=aval"   ... perturbing a recorded arg
                                          aval (fingerprint-visible)
+    "draft_garbage"                      every speculative-decode round's
+                                         drafter proposals are replaced
+                                         with garbage (worst-case-wrong
+                                         drafter; output must stay
+                                         bitwise)
+    "draft_garbage:rounds=3"             ... only the first 3 rounds
 
 Points (consumed by the named subsystems):
 
@@ -55,6 +61,7 @@ Points (consumed by the named subsystems):
     router_drop         serving/router.FleetRouter send path     nth
     page_pool_exhausted serving/engine.can_admit (admission)     times
     mutate_signature    core/lazy.ReplayStep._replay             nth, mode
+    draft_garbage       serving/spec_decode (drafting round)     rounds
     ==================  =======================================  ============
 
 Each firing bumps `fault.injected.<point>` in the telemetry registry and
@@ -287,6 +294,20 @@ def fire(point, step=None, rank=None, path=None, op=None):
         raise RuntimeError(
             f"injected transient decode failure "
             f"({ent['count']}/{int(p.get('fails', 1))})")
+
+    if point == "draft_garbage":
+        # fires per speculative-decode round: the DraftVerifyEngine
+        # replaces every drafter proposal with a constant garbage token.
+        # The exact acceptance rule must reject them all (throughput
+        # falls to plain decode) while the emitted stream stays bitwise
+        # — the worst-case-wrong-drafter correctness proof.
+        ent["count"] += 1
+        rounds = p.get("rounds")
+        if rounds is not None and ent["count"] > int(rounds):
+            return False
+        _record(point, f"drafter proposals replaced with garbage "
+                       f"(round #{ent['count']})")
+        return True
 
     if point == "mutate_signature":
         # fires on the nth zero-dispatch replay; the ReplayStep then

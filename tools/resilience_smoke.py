@@ -37,6 +37,11 @@ launch supervision conventions, fronted by the prefix-aware router):
     fleet-router-drop  a routed request lost before the pod's ack is
                        re-submitted by the router (idempotent by seed):
                        same tokens, nothing fails
+    spec-pod-kill      a SPECULATIVE-decode pod (DraftVerifyEngine,
+                       ISSUE 12) SIGKILLed mid-speculation respawns;
+                       orphan replay is bitwise vs a plain-decode
+                       reference — draft-verify acceptance is exact,
+                       zero failed requests
 
 The RUNNER is pure stdlib (no paddle_tpu/jax import in this process) so
 CI can invoke it anywhere; the scenarios import paddle_tpu in their child
@@ -493,6 +498,41 @@ print("FLEET-DROP-OK")
         return False, "scenario exited 0 without completing"
     return ok, why or ("dropped route re-submitted idempotently; tokens "
                        "unchanged")
+
+
+@scenario("spec-pod-kill", "speculative-decode pod SIGKILLed mid-flight: "
+                           "respawn + bitwise orphan replay vs plain "
+                           "decode, zero failed")
+def _spec_pod_kill(timeout):
+    code = _FLEET_PRELUDE + r"""
+# reference tokens from a PLAIN-decode server: the spec fleet's replayed
+# output must be bitwise-identical to non-speculative decode — the
+# exact-acceptance contract surviving a pod death mid-speculation
+want = reference_tokens()
+DRAFT_SPEC = {"kind": "gpt", "seed": 5,
+              "config": dict(vocab_size=VOCAB, n_layer=1, n_head=2,
+                             d_model=32, seq_len=64,
+                             initializer_range=0.35)}
+fleet = ServingFleet(MODEL_SPEC, pods=1, engine=ENGINE_KW,
+                     draft=DRAFT_SPEC, draft_k=3,
+                     restart_backoff=0.05,
+                     pod_faults={0: "pod_kill:at_request=2"}).start()
+reqs = [fleet.submit(p, **OPTS) for p in PROMPTS]
+got = [list(r.result(180).tokens) for r in reqs]
+assert [r.status for r in reqs] == ["done"] * 3, [r.status for r in reqs]
+assert got == want, "spec-decode replay not bitwise vs plain decode"
+st = fleet.stats()
+assert st["pods"][0]["restarts"] >= 1
+assert st["router"]["requests_failed"] == 0
+assert registry.counters("fleet")["orphans_replayed"] >= 1
+fleet.shutdown()
+print("SPEC-KILL-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "SPEC-KILL-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or ("spec pod respawned; orphans replayed bitwise vs "
+                       "plain decode, zero failed")
 
 
 def main(argv=None):

@@ -120,6 +120,38 @@ def _print_fleet(counters, gauges):
     _print_counters(fl)
 
 
+_SPEC_PREFIXES = ("serving.spec_", "serving.draft_")
+_SPEC_KEYS = frozenset(("serving.verify_compiles",
+                        "serving.chunked_prefills",
+                        "serving.prefill_chunks"))
+
+
+def _print_spec(counters, gauges):
+    """Speculative-decode + chunked-prefill health (ISSUE 12): the
+    acceptance rate and mean accepted length say how much the drafter is
+    actually buying (1.0 tokens/round = plain-decode speed, K+1 =
+    perfect drafter); verify_compiles must stay at one per engine, and
+    the chunk counters say whether long prompts really interleaved."""
+    sp = {k: counters.pop(k) for k in list(counters)
+          if k.startswith(_SPEC_PREFIXES) or k in _SPEC_KEYS}
+    sp.update({k: gauges.pop(k) for k in list(gauges)
+               if k.startswith(_SPEC_PREFIXES)})
+    if not sp:
+        return
+    print("speculative decode (draft-verify):")
+    proposed = sp.get("serving.spec_proposed", 0)
+    if proposed:
+        sp.setdefault("serving.spec_acceptance_rate",
+                      round(sp.get("serving.spec_accepted", 0)
+                            / proposed, 4))
+    rounds = sp.get("serving.spec_slot_rounds", 0)
+    if rounds:
+        sp.setdefault("serving.spec_accepted_len_mean",
+                      round(sp.get("serving.spec_emitted", 0)
+                            / rounds, 2))
+    _print_counters(sp)
+
+
 _KV_POOL_PREFIXES = ("serving.prefix_", "serving.kv_blocks")
 _KV_POOL_KEYS = frozenset(("serving.pool_exhausted",))
 
@@ -176,6 +208,10 @@ def _print_snapshot(snap):
     # pod restarts / orphan replays / routing hit rate are the
     # cross-process resilience story, read as one table
     _print_fleet(counters, gauges)
+    # speculative decode (ISSUE 12) claims its serving.* keys before
+    # the kv-pool/serving tables: acceptance rate and chunk counts are
+    # the draft-verify subsystem's health line
+    _print_spec(counters, gauges)
     # kv pool (ISSUE 10) claims its serving.* keys before the general
     # serving section so cache-memory health reads as one table
     _print_kv_pool(counters, gauges)
