@@ -1024,21 +1024,70 @@ def _run_child(preset, batch, seq, policy="full"):
     return 0
 
 
+# Structured accelerator-probe failure causes, newest last (ISSUE 13
+# satellite). The probe has failed SILENTLY since r03 — every round fell
+# to CPU with no recorded reason, so nobody could tell a dead tunnel
+# from a broken env from a slow init. Each failed probe now records the
+# exception text + the env that shaped it, and the emitted BENCH JSON
+# carries the cause (bench-probe-failure line + probe_failure on the
+# degraded/replayed record) so the next live window is diagnosable.
+_PROBE_FAILURES = []
+
+_PROBE_ENV_EXACT = ("JAX_PLATFORMS", "XLA_FLAGS", "PALLAS_AXON_POOL_IPS")
+_PROBE_ENV_PREFIXES = ("TPU_", "PJRT_", "LIBTPU", "JAX_")
+
+
+def _probe_env():
+    """The env slice that decides what the probe can see (platform
+    selection, PJRT plugin discovery, tunnel endpoints)."""
+    return {k: v for k, v in sorted(os.environ.items())
+            if k in _PROBE_ENV_EXACT or k.startswith(_PROBE_ENV_PREFIXES)}
+
+
 def _probe_platform(timeout):
     """Bounded default-platform check in a subprocess (a hung PJRT init
     cannot be interrupted in-process). Returns the platform string, or
-    None on timeout/failure."""
+    None on timeout/failure — with the structured cause appended to
+    ``_PROBE_FAILURES`` instead of swallowed."""
+    timeout = max(5.0, timeout)
+    cause = None
     try:
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax; print(jax.devices()[0].platform)"],
-            env=dict(os.environ), timeout=max(5.0, timeout),
+            env=dict(os.environ), timeout=timeout,
             capture_output=True, text=True)
         if r.returncode == 0 and r.stdout.strip():
             return r.stdout.strip()
+        cause = {
+            "stage": "nonzero_exit" if r.returncode else "empty_output",
+            "returncode": r.returncode,
+            "error": (r.stderr or r.stdout or "").strip()[-500:],
+        }
     except subprocess.TimeoutExpired:
-        pass
+        cause = {"stage": "timeout", "timeout_s": timeout,
+                 "error": f"jax.devices() probe exceeded {timeout:.0f}s "
+                          "(hung PJRT init / wedged tunnel)"}
+    except OSError as e:
+        cause = {"stage": "spawn", "error": f"{type(e).__name__}: {e}"}
+    cause["env"] = _probe_env()
+    cause["attempt"] = len(_PROBE_FAILURES) + 1
+    _PROBE_FAILURES.append(cause)
     return None
+
+
+def _probe_failure_line():
+    """Emit the structured probe post-mortem as its own BENCH JSON line
+    (stdout, so the driver banks it alongside the metric lines)."""
+    if not _PROBE_FAILURES:
+        return
+    print(json.dumps({
+        "metric": "bench-probe-failure", "value": 0, "unit": "",
+        "vs_baseline": 0,
+        "note": "accelerator probe failed; structured causes attached "
+                "(exception text + platform env per attempt)",
+        "probe_failures": _PROBE_FAILURES[-4:],
+    }), flush=True)
 
 
 def _probe_alive(timeout):
@@ -1176,6 +1225,8 @@ def main():
     # no accelerator, not a slow compile.)
     quick = _probe_platform(25.0)
     if quick is None:
+        # one escalated retry (longer watchdog covers a slow first
+        # init) before declaring the accelerator dead for the round
         quick = _probe_platform(2 * PROBE_TIMEOUT)
     if quick == "cpu":
         accel_dead = True
@@ -1185,6 +1236,7 @@ def main():
         _note("accelerator probe failed twice (incl. escalated retry); "
               "skipping the accelerator ladder instead of burning its "
               "watchdog")
+        _probe_failure_line()
 
     # ---- accelerator ladder: first rung doubles as the liveness probe ----
     for i, cfg in enumerate(TPU_CONFIGS):
@@ -1220,6 +1272,7 @@ def main():
                     accel_dead = True
                     _note("accelerator probe failed; CPU fallback for the "
                           "rest of the budget")
+                    _probe_failure_line()
 
     # ---- CPU fallback: bank a degraded line if no real one exists --------
     if not any(r.get("platform") != "cpu" for r in results):
@@ -1276,9 +1329,12 @@ def main():
     if not results:
         # every config failed (even the CPU fallback): surface the error
         # AND exit nonzero; a cached line may still follow for the record
-        print(json.dumps({"metric": "GPT train tokens/sec/chip", "value": 0,
-                          "unit": "tokens/s/chip", "vs_baseline": 0,
-                          "error": last_err[:300]}), flush=True)
+        fail = {"metric": "GPT train tokens/sec/chip", "value": 0,
+                "unit": "tokens/s/chip", "vs_baseline": 0,
+                "error": last_err[:300]}
+        if _PROBE_FAILURES:
+            fail["probe_failure"] = _PROBE_FAILURES[-1]
+        print(json.dumps(fail), flush=True)
         if history:
             print(json.dumps(_replay_line(
                 history, "run FAILED (see error line); replayed prior "
@@ -1295,8 +1351,11 @@ def main():
     pool = real_now or results
     best = max(pool, key=lambda r: r.get("mfu", 0))
     if not real_now and history:
-        print(json.dumps({**best, "fresh_degraded_best": True}),
-              flush=True)
+        degraded = {**best, "fresh_degraded_best": True}
+        if _PROBE_FAILURES:
+            # the degraded record names WHY the round ran CPU-only
+            degraded["probe_failure"] = _PROBE_FAILURES[-1]
+        print(json.dumps(degraded), flush=True)
         print(json.dumps(_replay_line(
             history, "accelerator dead this run; replayed from "
             ".bench_history.json (a REAL prior on-chip measurement, "

@@ -57,8 +57,8 @@ from ..profiler import timeline as _timeline
 
 __all__ = ["LazyArray", "enabled", "lazy_guard", "build", "force",
            "stats", "capture_guard", "donate_guard", "drop_plans",
-           "set_spmd_mesh", "spmd_mesh", "describe_plans", "ReplayStep",
-           "AUDIT_EVERY"]
+           "plans_alive", "set_spmd_mesh", "spmd_mesh", "describe_plans",
+           "ReplayStep", "AUDIT_EVERY"]
 
 _state = threading.local()
 
@@ -1082,6 +1082,17 @@ def drop_plans(why="external state change"):
         _counters["capture_invalidations"] += n
         _explain.record("capture_invalidate", why=why, n_plans=n)
     return n
+
+
+def plans_alive():
+    """Number of captured step plans THIS thread currently holds. The
+    elastic-resize tests pin the plan lifecycle with it: a resize
+    (mesh change / drop_plans) must take it to 0, and the steady state
+    after the resize must rebuild each plan exactly once — watching the
+    live count catches both a leaked stale plan and a re-capture storm
+    that counters alone can hide."""
+    plans = getattr(_state, "plans", None)
+    return len(plans) if plans else 0
 
 
 def _unregister_plan(plan):

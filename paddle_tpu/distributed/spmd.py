@@ -57,7 +57,7 @@ from ..profiler import registry as _registry
 __all__ = ["enable", "disable", "enabled", "current_mesh", "spmd_guard",
            "mesh_from_hcg", "serving_mesh", "param_pspec",
            "per_arg_specs", "is_single_spec", "shard_model",
-           "shard_batch", "describe_plans"]
+           "shard_batch", "describe_plans", "remesh_for_world"]
 
 # shared scope with core/lazy.py (step_compiles / python_collectives /
 # python_collectives_per_step are bumped there and in collective.py)
@@ -155,6 +155,36 @@ def enable(mesh: Mesh):
     distributed, so it is pushed in) — current_mesh/enabled read it
     back, so direct lazy.set_spmd_mesh callers cannot desync us."""
     _lazy.set_spmd_mesh(mesh)
+    return mesh
+
+
+def remesh_for_world(dp, mp=1, reshard_model=None):
+    """Rebuild + install the folded ``('dp','mp')`` mesh after an
+    elastic world resize (ISSUE 13): the surviving world has ``dp``
+    data-parallel slices (× the unchanged ``mp``), so the captured step
+    must re-lower against the new device subset. Installing through
+    :func:`enable` drops this thread's captured plans exactly once
+    (``set_spmd_mesh``'s contract) — the next step re-captures cleanly
+    instead of replaying an executable compiled for devices that left
+    the mesh. ``reshard_model`` (optional) re-places that model's
+    params on the new mesh in the same call. Returns the new mesh."""
+    dp, mp = int(dp), int(mp)
+    devs = jax.devices()
+    if dp * mp > len(devs) or dp < 1 or mp < 1:
+        raise ValueError(
+            f"remesh_for_world: dp={dp} x mp={mp} does not fit the "
+            f"{len(devs)} available devices")
+    mesh = Mesh(np.array(devs[: dp * mp]).reshape(dp, mp), ("dp", "mp"))
+    enable(mesh)
+    _registry.inc("remeshes", scope="spmd")
+    from ..profiler import explainer as _explain
+
+    _explain.record("elastic_remesh", op="remesh_for_world",
+                    why=f"elastic resize rebuilt the mesh as dp={dp} "
+                        f"mp={mp}; captured plans dropped for one clean "
+                        f"re-capture", dp=dp, mp=mp)
+    if reshard_model is not None:
+        shard_model(reshard_model, mesh)
     return mesh
 
 

@@ -223,7 +223,7 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            resume=False, max_ckpt_to_keep=5, **kwargs):
+            resume=False, max_ckpt_to_keep=5, elastic=None, **kwargs):
         """Train loop. Fault-tolerance additions (ISSUE 4):
 
         - ``resume=True``: restart from the newest VALID epoch checkpoint
@@ -234,6 +234,14 @@ class Model:
         - SIGTERM (TPU preemption grace): the handler requests an
           emergency checkpoint; it is written at the NEXT epoch/batch
           boundary into ``save_dir`` and fit() returns cleanly.
+
+        Elastic training (ISSUE 13): ``elastic`` takes a started
+        ``fleet.elastic.ElasticTrainContext``. Each batch boundary
+        re-arms its step watchdog (a hung ``train_batch`` dumps thread
+        stacks and escalates to the supervisor), a preemption announced
+        by ANY rank requests the emergency checkpoint here too, and the
+        generation fence runs before every checkpoint write — a rank the
+        world resized past stops training without touching ``save_dir``.
         """
         from .callbacks import CallbackList, ProgBarLogger
 
@@ -260,7 +268,8 @@ class Model:
         try:
             return self._fit_loop(loader, cbs, eval_data, batch_size,
                                   start_epoch, epochs, eval_freq, save_dir,
-                                  save_freq, max_ckpt_to_keep)
+                                  save_freq, max_ckpt_to_keep,
+                                  elastic=elastic)
         finally:
             # a raising batch/callback must not leave the process deaf to
             # SIGTERM — the preemption grace window depends on it
@@ -271,9 +280,12 @@ class Model:
                     pass
 
     def _fit_loop(self, loader, cbs, eval_data, batch_size, start_epoch,
-                  epochs, eval_freq, save_dir, save_freq, max_ckpt_to_keep):
+                  epochs, eval_freq, save_dir, save_freq, max_ckpt_to_keep,
+                  elastic=None):
         cbs.on_train_begin()
         history = []
+        fenced = False
+        global_step = 0
         for epoch in range(start_epoch, epochs):
             if self.stop_training:
                 break
@@ -303,13 +315,27 @@ class Model:
                     except Exception:
                         pass
                 cbs.on_train_batch_end(step, logs)
+                if elastic is not None:
+                    global_step += 1
+                    elastic.step_boundary(global_step)
+                    if elastic.preempt_requested:
+                        # a PEER announced preemption through the store
+                        self._preempt_requested = True
+                    if not elastic.fence_check("train loop"):
+                        fenced = True  # resized out: stop, write nothing
+                        break
                 if self._preempt_requested:
                     break
             history.append(dict(logs))
+            if fenced:
+                self.stop_training = True
+                cbs.on_epoch_end(epoch, logs)
+                break
             if self._preempt_requested:
                 # emergency checkpoint at the batch boundary we just
                 # closed, then a clean exit inside the preemption grace
-                if save_dir:
+                if save_dir and (elastic is None
+                                 or elastic.fence_check("emergency ckpt")):
                     self._save_epoch_ckpt(save_dir, epoch,
                                           max_ckpt_to_keep, emergency=True,
                                           step=step)
@@ -325,7 +351,9 @@ class Model:
                                     for k, v in eval_logs.items()
                                     if v is not None})
                 cbs.on_eval_end(eval_logs)
-            if save_dir and (epoch + 1) % save_freq == 0:
+            if save_dir and (epoch + 1) % save_freq == 0 and (
+                    elastic is None
+                    or elastic.fence_check("epoch checkpoint")):
                 self._save_epoch_ckpt(save_dir, epoch, max_ckpt_to_keep)
         cbs.on_train_end()
         return history

@@ -545,7 +545,8 @@ class CheckpointHook:
 
     def __init__(self, dir, network, optimizer=None, save_interval=100,
                  max_to_keep=3, async_save=True, rank=0, world_size=1,
-                 shard=False, reshard=False, install_sigterm=True):
+                 shard=False, reshard=False, install_sigterm=True,
+                 elastic=None):
         self.manager = CheckpointManager(dir, max_to_keep=max_to_keep,
                                          async_save=async_save, rank=rank,
                                          world_size=world_size, shard=shard)
@@ -553,6 +554,14 @@ class CheckpointHook:
         # a DIFFERENT world size (preemption resize): shards are merged
         # through the manifest, then re-sliced on this job's next save
         self.reshard = bool(reshard)
+        # elastic: a fleet.elastic.ElasticTrainContext (or anything with
+        # its shape). Wires the step loop into the elastic training loop
+        # (ISSUE 13): the step watchdog re-arms at each boundary, a
+        # SIGTERM is ANNOUNCED through the store so every rank saves its
+        # emergency shard at the SAME step (consistent manifest set for
+        # the resharder), and the generation fence runs before every
+        # save — a stale-generation zombie can never write a checkpoint.
+        self.elastic = elastic
         self._net = network
         self._opt = optimizer
         self.save_interval = max(1, int(save_interval))
@@ -605,23 +614,70 @@ class CheckpointHook:
 
     def on_step_end(self, step, epoch=None, user_meta=None):
         """Call once per completed step. Returns "preempted" after an
-        emergency save (caller should exit cleanly), else "saved" or
-        "ok"."""
+        emergency save (caller should exit cleanly), "fenced" when this
+        rank's elastic generation went stale (caller must exit WITHOUT
+        saving — the world was resized past it), else "saved" or "ok"."""
         if _faults.ACTIVE:
             _faults.fire("kill_at_step", step=step)
-        state = None
+            _faults.fire("rank_preempt", step=step)
+            # step_hang sleeps with the watchdog still armed for THIS
+            # step — it must fire before the boundary tick below
+            _faults.fire("step_hang", step=step)
+        el = self.elastic
+        coordinator = getattr(el, "coordinator", None) if el else None
+        if el is not None:
+            el.step_boundary(step)
+        if coordinator is not None:
+            if self._preempt.is_set() and not coordinator.triggered:
+                # a stale-generation rank must not publish preemption
+                # notices: the NEW world would take a spurious
+                # fleet-wide emergency checkpoint on a zombie's behalf
+                if el is not None and not el.fence_check(
+                        "preemption announce"):
+                    return "fenced"
+                # local SIGTERM: make the preemption FLEET-WIDE so every
+                # rank's emergency shard lands on one consistent step
+                coordinator.announce(step)
+            elif coordinator.triggered:
+                # another rank announced; adopt at this boundary
+                self._preempt.set()
         if self._preempt.is_set():
+            if coordinator is not None and not coordinator.should_save(step):
+                return "ok"  # fleet target is a later boundary
+            if el is not None and not el.fence_check("emergency save"):
+                return "fenced"
+            coordinated = None
+            if coordinator is not None:
+                # rendezvous under the fleet TARGET step (a rank that
+                # adopted the notice a boundary late still acks the same
+                # key); the manifest records the LOCAL step — it names
+                # the state actually saved, and fabricating the target
+                # step for a drifted rank would lie about the payload.
+                # In lockstep training (per-step collectives, the dp
+                # case) local == target and the manifest set is
+                # consistent by construction; a drifted rank's manifest
+                # carries preempt_target so the divergence is visible
+                # to the resharder/operator instead of silent.
+                coordinated = coordinator.barrier(
+                    coordinator.save_step(step))
             state = capture_training_state(self._net, self._opt)
+            meta = {"emergency": True, **(user_meta or {})}
+            if coordinated is not None:
+                meta["coordinated"] = coordinated
+                meta["preempt_target"] = coordinator.save_step(step)
             self.manager.save(state, step, epoch=epoch, block=True,
-                              user_meta={"emergency": True,
-                                         **(user_meta or {})})
+                              user_meta=meta)
             _counters["emergency_saves"] += 1
             _explain.record(
                 "checkpoint_save", op="emergency",
-                why=f"SIGTERM: emergency checkpoint at step boundary {step}",
+                why=f"SIGTERM: emergency checkpoint at step boundary {step}"
+                    + (f" ({coordinated} ranks coordinated)"
+                       if coordinated is not None else ""),
                 step=step)
             return "preempted"
         if (step + 1) % self.save_interval == 0:
+            if el is not None and not el.fence_check("periodic save"):
+                return "fenced"
             state = capture_training_state(self._net, self._opt)
             self.manager.save(state, step, epoch=epoch, user_meta=user_meta)
             return "saved"

@@ -41,6 +41,18 @@ accepted by :func:`configure` directly::
                                          drafter; output must stay
                                          bitwise)
     "draft_garbage:rounds=3"             ... only the first 3 rounds
+    "rank_preempt:step=4"                SIGTERM this process at step 4
+                                         (TPU preemption notice; the
+                                         hook must land a coordinated
+                                         emergency checkpoint)
+    "rank_preempt:step=4,rank=1"         ... only on trainer rank 1
+    "store_partition:secs=0.3"           the store is unreachable for
+                                         0.3 s from the first op in the
+                                         window (every op raises; the
+                                         retry/backoff must ride it out)
+    "step_hang:step=5,secs=30"           sleep 30 s inside the step-5
+                                         body — the step watchdog must
+                                         trip, dump stacks, escalate
 
 Points (consumed by the named subsystems):
 
@@ -62,6 +74,10 @@ Points (consumed by the named subsystems):
     page_pool_exhausted serving/engine.can_admit (admission)     times
     mutate_signature    core/lazy.ReplayStep._replay             nth, mode
     draft_garbage       serving/spec_decode (drafting round)     rounds
+    rank_preempt        checkpoint.CheckpointHook.on_step_end    step, rank
+    store_partition     distributed/store.py TCPStore ops        secs, op
+    step_hang           checkpoint.CheckpointHook.on_step_end    step, secs,
+                                                                 rank
     ==================  =======================================  ============
 
 Each firing bumps `fault.injected.<point>` in the telemetry registry and
@@ -188,6 +204,62 @@ def fire(point, step=None, rank=None, path=None, op=None):
         # die like a preempted/OOM-killed worker: no atexit, no flush of
         # pending async checkpoint writes, SIGKILL-style return code
         os._exit(137)
+
+    if point == "rank_preempt":
+        # TPU preemption notice, deterministically: SIGTERM OURSELVES at
+        # the named step. The CheckpointHook's handler sets its preempt
+        # flag (signal delivery is immediate for a same-process kill on
+        # the main thread), so the SAME on_step_end call proceeds into
+        # the coordinated emergency-checkpoint path — announce through
+        # the store, barrier, save, exit inside the grace window.
+        if step is None or int(step) != int(p.get("step", -1)):
+            return False
+        if ent["count"]:
+            return False  # one notice per process, like a real preemption
+        ent["count"] += 1
+        _record(point, f"SIGTERM (preemption notice) at step {step}",
+                step=step, rank=rank)
+        import signal as _signal
+
+        os.kill(os.getpid(), _signal.SIGTERM)
+        return True
+
+    if point == "step_hang":
+        # wedge the step body (a stuck collective / NFS write / PJRT
+        # call): sleeps while the step watchdog is still armed for this
+        # step, so the deadline trips mid-sleep, dumps stacks, and
+        # escalates with HANG_RC. The sleep is bounded so an unarmed
+        # process (no watchdog) eventually resumes instead of hanging
+        # the test suite.
+        if step is None or int(step) != int(p.get("step", -1)):
+            return False
+        if ent["count"]:
+            return False
+        ent["count"] += 1
+        secs = float(p.get("secs", 30.0))
+        _record(point, f"step {step} body wedged for {secs}s "
+                       f"(watchdog must trip)", step=step)
+        time.sleep(secs)
+        return True
+
+    if point == "store_partition":
+        # the store host drops off the network for a WINDOW (not a
+        # count): every op raises ConnectionError until `secs` elapse
+        # from the first op inside the window. Rides the production
+        # retry/backoff in distributed/store.py — a partition shorter
+        # than the cumulative backoff heals transparently; the elastic
+        # heartbeat counts misses and re-registers after longer ones.
+        start = ent.setdefault("window_start", time.monotonic())
+        remaining = float(p.get("secs", 0.3)) - (time.monotonic() - start)
+        if remaining <= 0:
+            return False
+        if ent["count"] == 0:
+            _record(point, f"store partitioned for {p.get('secs', 0.3)}s "
+                           f"(every op raises until it heals)",
+                    store_op=op)
+        ent["count"] += 1
+        raise ConnectionError(
+            f"injected store partition ({remaining:.2f}s remaining)")
 
     if point == "nan_loss":
         if step is None or int(step) != int(p.get("step", -1)):
@@ -347,7 +419,9 @@ def fire(point, step=None, rank=None, path=None, op=None):
 
 
 def store_op(op):
-    """Combined store_slow + store_flaky site for TCPStore methods (one
-    call per op keeps the store code to a single guarded line)."""
+    """Combined store_slow + store_flaky + store_partition site for
+    TCPStore methods (one call per op keeps the store code to a single
+    guarded line)."""
     fire("store_slow", op=op)
     fire("store_flaky", op=op)
+    fire("store_partition", op=op)

@@ -179,3 +179,134 @@ def test_claim_fulfilled_but_gen_not_bumped():
         time.sleep(0.1)
     assert status == ElasticStatus.RESTART, "bump never completed"
     assert m.gen == 1 and m.members == [1, 2]
+
+
+# -------------------------------------------------- N→M→N resize soak ------
+# ISSUE 13 satellite: the full elastic loop at the training-state layer,
+# in-process so the capture-plan lifecycle is assertable. Process-level
+# kills of the same loop run in tools/resilience_smoke.py
+# (elastic-shrink / elastic-grow) and the pod tests in
+# test_elastic_training.py; here the kill is its state-level equivalent
+# — training past the last commit, then reverting to it — which is
+# exactly what a SIGKILLed rank's resumed successor observes.
+
+def test_elastic_soak_resize_chain_bitwise_and_recapture_once(tmp_path):
+    """4→3→4 resize soak: each phase trains past its last committed
+    checkpoint and is 'killed' (uncommitted steps lost), the newest
+    checkpoint of the first phase is TORN (resume must fall back one
+    step and replay it — zero torn checkpoints consumed), every resume
+    merges the old world's shards via load_resharded, the captured lazy
+    plan is dropped once per resize (the drop_plans/remesh contract)
+    and re-captured EXACTLY once, and — start and end world sizes
+    matching — the final weights are BITWISE equal to an uninterrupted
+    run."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.core import lazy
+    from paddle_tpu.incubate import checkpoint as ckpt
+
+    STEPS = 18
+    rng = np.random.default_rng(11)
+    batches = [(rng.normal(size=(8, 6)).astype(np.float32),
+                rng.normal(size=(8, 2)).astype(np.float32))
+               for _ in range(STEPS)]
+
+    def mlp():
+        paddle.seed(5)
+        net = nn.Sequential(nn.Linear(6, 12), nn.Tanh(), nn.Linear(12, 2))
+        opt = optimizer.Adam(learning_rate=1e-2,
+                             parameters=net.parameters())
+        return net, opt
+
+    def lazy_step(net, opt, xy):
+        with paddle.incubate.lazy_eval():
+            x = paddle.to_tensor(xy[0])
+            y = paddle.to_tensor(xy[1])
+            loss = ((net(x) - y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return float(loss)
+
+    def save_all_ranks(d, net, opt, step, world):
+        state = ckpt.capture_training_state(net, opt)
+        for r in range(world):
+            ckpt.save_checkpoint(str(d), state, step=step, rank=r,
+                                 world_size=world, shard=True)
+
+    d = tmp_path / "elastic"
+    lazy.drop_plans("soak test boundary")
+
+    # ---- elastic run: 4 → 3 → 4 with a kill at every resize ----
+    net, opt = mlp()
+    # dp-replicated toy world: every rank computes the same update, so
+    # one model instance IS every rank's state; world size only changes
+    # how checkpoints shard. Phase = (world, first step, first step of
+    # the NEXT phase); each phase commits through hi-1 and then trains
+    # two more steps that the kill loses.
+    phases = [(4, 0, 6), (3, 6, 12), (4, 12, STEPS)]
+    promotions_per_resume = []
+    for idx, (world, lo, hi) in enumerate(phases):
+        if idx:
+            # resume after the kill: merge the previous world's shards
+            state, man = ckpt.load_resharded(str(d), world_size=world)
+            assert state is not None
+            if idx == 1:
+                # the torn step-5 checkpoint must have been skipped
+                assert man["step"] == 4, man["step"]
+            else:
+                assert man["step"] == 11, man["step"]
+            changed = ckpt.restore_training_state(net, opt, state)
+            assert changed == []  # in-place restore, same avals
+            # the resize path (remesh_for_world / fresh process) drops
+            # captured plans for one clean re-capture; mirror it here
+            lazy.drop_plans("elastic resize")
+            assert lazy.plans_alive() == 0
+            lo = man["step"] + 1  # replay the uncommitted tail
+        s0 = lazy.stats()
+        for step in range(lo, hi):
+            lazy_step(net, opt, batches[step])
+            save_all_ranks(d, net, opt, step, world)
+        if idx == 0:
+            # tear the NEWEST checkpoint: truncate one rank's payload
+            # of step 5 — the first resume must fall back to step 4
+            victim = os.path.join(str(d), "ckpt-00000005",
+                                  "data-rank00002.pkl")
+            with open(victim, "r+b") as f:
+                f.truncate(7)
+        # the kill: train past the last commit; these steps are LOST
+        # (state reverts to the checkpoint on resume, and the resumed
+        # phase replays them from the committed batches)
+        if idx < len(phases) - 1:
+            for step in range(hi, hi + 2):
+                lazy_step(net, opt, batches[step])
+        s1 = lazy.stats()
+        if idx:
+            promotions_per_resume.append(
+                s1["capture_promotions"] - s0["capture_promotions"])
+        assert s1["capture_fallbacks"] == s0["capture_fallbacks"]
+    # re-capture happened exactly once per resize, and exactly one live
+    # plan serves the steady state
+    assert promotions_per_resume == [1, 1], promotions_per_resume
+    assert lazy.plans_alive() == 1
+    got = {k: np.asarray(v.numpy()).copy()
+           for k, v in net.state_dict().items()}
+    got_opt = {k: (np.asarray(v.numpy()).copy()
+                   if hasattr(v, "numpy") else v)
+               for k, v in opt.state_dict().items()}
+
+    # ---- uninterrupted reference (same seed, same batches) ----
+    lazy.drop_plans("soak reference boundary")
+    ref_net, ref_opt = mlp()
+    for step in range(STEPS):
+        lazy_step(ref_net, ref_opt, batches[step])
+    for k, v in ref_net.state_dict().items():
+        np.testing.assert_array_equal(
+            np.asarray(v.numpy()), got[k],
+            err_msg=f"{k} diverged across the 4->3->4 resize chain")
+    for k, v in ref_opt.state_dict().items():
+        want = np.asarray(v.numpy()) if hasattr(v, "numpy") else v
+        np.testing.assert_array_equal(np.asarray(want), got_opt[k],
+                                      err_msg=f"optimizer {k}")

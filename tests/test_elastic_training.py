@@ -1,0 +1,431 @@
+"""Elastic preemption-tolerant training (ISSUE 13).
+
+Unit + integration coverage for the elastic training loop: heartbeat
+leases (expiry = declared dead, not just process-exit), the step
+watchdog (stack dump + HANG_RC escalation), store-coordinated emergency
+checkpoints (every rank saves the SAME step), world-epoch generation
+fencing (a zombie can never write a checkpoint or join a barrier), the
+new fault points (rank_preempt / store_partition / step_hang), and the
+supervisor-driven N→M resize in launch.Pod (shrink on exhausted restart
+budget, grow on operator request, lease-based liveness).
+
+Pod integration tests use STDLIB-only trainer children (no jax import in
+the grandchildren) so the process machinery is exercised without paying
+a jax init per rank; the full paddle-stack trainer path runs in
+tools/resilience_smoke.py's elastic-shrink / elastic-grow / train-hang
+scenarios and the soak test in test_elastic_resize.py.
+"""
+import io
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.distributed.fleet import elastic as E
+from paddle_tpu.distributed.launch.main import Pod
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.incubate import checkpoint as ckpt
+from paddle_tpu.profiler import explainer, registry
+from paddle_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.reset()
+
+
+@pytest.fixture()
+def store():
+    return TCPStore("127.0.0.1", 0, is_master=True, world_size=1,
+                    timeout=10.0)
+
+
+def _tiny(seed=3):
+    paddle.seed(seed)
+    net = nn.Linear(6, 2)
+    opt = optimizer.SGD(learning_rate=0.05, parameters=net.parameters())
+    return net, opt
+
+
+# ------------------------------------------------------------ heartbeats --
+
+def test_heartbeat_lease_renews_then_expires(store):
+    lease = E.HeartbeatLease(store, rank=2, interval=0.05, ttl=0.3).start()
+    try:
+        time.sleep(0.2)
+        age = E.HeartbeatLease.age(store, "elastic", 0, 2)
+        assert age is not None and age < 0.3
+    finally:
+        lease.stop()
+    time.sleep(0.45)
+    # renewals stopped: the lease goes stale — this is what the
+    # supervisor reads as "dead", independent of any process state
+    assert E.HeartbeatLease.age(store, "elastic", 0, 2) > 0.3
+    # a rank that never registered is NOT stale (no key = no verdict)
+    assert E.HeartbeatLease.age(store, "elastic", 0, 7) is None
+
+
+def test_heartbeat_misses_counted_not_raised():
+    class DeadStore:
+        def set(self, *a):
+            raise ConnectionError("injected dead store")
+
+    before = registry.counters("fault")["elastic.heartbeat_misses"]
+    lease = E.HeartbeatLease(DeadStore(), rank=0, interval=0.03).start()
+    time.sleep(0.15)
+    lease.stop()  # the beat thread must have survived every failure
+    assert registry.counters("fault")["elastic.heartbeat_misses"] > before
+
+
+# -------------------------------------------------------------- watchdog --
+
+def test_watchdog_trips_dumps_stacks_counts_and_explains():
+    sink = io.StringIO()
+    trips = []
+    before = registry.counters("fault")["elastic.hang"]
+    wd = E.StepWatchdog(deadline=0.15, escalate="report", sink=sink,
+                        on_trip=trips.append, poll=0.03).start()
+    try:
+        wd.arm(7)
+        time.sleep(0.5)
+    finally:
+        wd.stop()
+    assert wd.tripped
+    assert registry.counters("fault")["elastic.hang"] == before + 1
+    out = sink.getvalue()
+    assert "WATCHDOG" in out and "--- thread MainThread" in out
+    ev = trips[0]
+    assert ev["kind"] == "elastic_hang" and ev["step"] == 7
+    kinds = [e["kind"] for e in explainer.events(50)]
+    assert "elastic_hang" in kinds
+
+
+def test_watchdog_healthy_cadence_never_trips():
+    wd = E.StepWatchdog(deadline=0.3, escalate="report", poll=0.03,
+                        sink=io.StringIO()).start()
+    try:
+        wd.arm(0)
+        for step in range(6):
+            time.sleep(0.05)  # well inside the deadline
+            wd.tick(step)
+        wd.disarm()
+        time.sleep(0.4)  # disarmed: no deadline while not training
+    finally:
+        wd.stop()
+    assert not wd.tripped
+
+
+def test_watchdog_exit_escalation_is_hang_rc(tmp_path):
+    """escalate="exit": a wedged step ends the PROCESS with HANG_RC so
+    the supervisor can tell a hang from a crash; the stacks land on
+    stderr (= the worker log)."""
+    from proc_utils import proc_timeout
+
+    code = (
+        "import os, sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "from paddle_tpu.distributed.fleet.elastic import StepWatchdog\n"
+        "wd = StepWatchdog(deadline=0.3, escalate='exit', poll=0.05)\n"
+        "wd.start(); wd.arm(4)\n"
+        "time.sleep(60)\n"
+    )
+    r = subprocess.run([sys.executable, "-c", code], capture_output=True,
+                       text=True, timeout=proc_timeout(120))
+    assert r.returncode == E.HANG_RC, (r.returncode, r.stderr[-400:])
+    assert "WATCHDOG" in r.stderr and "--- thread" in r.stderr
+
+
+# -------------------------------------------- coordinated preemption -----
+
+def test_preemption_coordinator_fleet_saves_same_step(store):
+    c0 = E.PreemptionCoordinator(store, 0, 2, gen=3, poll=0.03).start()
+    c1 = E.PreemptionCoordinator(store, 1, 2, gen=3, poll=0.03).start()
+    try:
+        assert not c0.triggered and not c1.triggered
+        c0.announce(4)  # SIGTERM landed on rank 0 at step 4
+        deadline = time.time() + 5
+        while not c1.triggered and time.time() < deadline:
+            time.sleep(0.02)
+        assert c1.triggered, "peer never saw the store notice"
+        # both adopt the SAME target: the announcer's next boundary
+        assert not c0.should_save(4) and c0.should_save(5)
+        assert not c1.should_save(4) and c1.should_save(5)
+        res = []
+        t = threading.Thread(
+            target=lambda: res.append(c1.barrier(5, timeout=5)))
+        t.start()
+        n0 = c0.barrier(5, timeout=5)
+        t.join(10)
+        assert n0 == 2 and res == [2]
+    finally:
+        c0.stop()
+        c1.stop()
+
+
+def test_hook_coordinated_preemption_consistent_manifests(tmp_path, store):
+    """Two ranks stepping in lockstep; rank 0 gets the preemption notice.
+    BOTH hooks must write their emergency shard at the SAME step (the
+    announcer's next boundary), with the barrier count recorded — the
+    consistent cross-rank manifest set the resharder requires."""
+    results = {}
+
+    def run_rank(rank):
+        net, opt = _tiny(seed=rank)
+        ctx = E.ElasticTrainContext(store=store, rank=rank, world=2,
+                                    gen=0, preempt_poll=0.02)
+        ctx.coordinator.start()
+        hook = ckpt.CheckpointHook(str(tmp_path), net, opt,
+                                   save_interval=100, async_save=False,
+                                   rank=rank, world_size=2, shard=True,
+                                   reshard=True, install_sigterm=False,
+                                   elastic=ctx)
+        statuses = []
+        for step in range(6):
+            if rank == 0 and step == 2:
+                hook.request_preempt()  # the SIGTERM handler's effect
+            # the per-step collective stand-in keeps the ranks in
+            # lockstep, as real dp training would
+            ctx.barrier(f"step{step}", timeout=30)
+            st = hook.on_step_end(step)
+            statuses.append(st)
+            if st == "preempted":
+                break
+        ctx.stop()
+        results[rank] = statuses
+
+    threads = [threading.Thread(target=run_rank, args=(r,))
+               for r in (0, 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert results[0][-1] == "preempted" and results[1][-1] == "preempted"
+    # the announcer noticed at step 2, so the fleet target is step 3 —
+    # both ranks' LAST status index is 3 (steps 0..3)
+    assert len(results[0]) == len(results[1]) == 4, results
+    d = os.path.join(str(tmp_path), "ckpt-00000003")
+    import json
+
+    with open(os.path.join(d, "MANIFEST.json")) as f:
+        m0 = json.load(f)
+    with open(os.path.join(d, "MANIFEST-rank00001.json")) as f:
+        m1 = json.load(f)
+    assert m0["step"] == m1["step"] == 3
+    assert m0["user"]["emergency"] and m1["user"]["emergency"]
+    assert m0["user"]["coordinated"] == m1["user"]["coordinated"] == 2
+
+
+# ------------------------------------------------------ generation fence --
+
+def test_fence_restart_bump_does_not_fence_resize_does(store):
+    fence = E.GenerationFence(store, rank=1)
+    assert fence.check("warmup")
+    # an in-place restart bumps elastic/gen (PR 4 re-rendezvous) but the
+    # membership did not change: survivors must NOT read as zombies
+    assert E.publish_generation(store, 4)
+    assert fence.check("after in-place restart")
+    # a resize advances the world epoch: NOW the old rank is a zombie
+    E.bump_world_epoch(store)
+    before = registry.counters("fault")["elastic.fenced_zombies"]
+    assert not fence.check("checkpoint write")
+    assert registry.counters("fault")["elastic.fenced_zombies"] == before + 1
+    assert not fence.check("again")  # one count per zombie, not per probe
+    assert registry.counters("fault")["elastic.fenced_zombies"] == before + 1
+    with pytest.raises(E.StaleGenerationError):
+        fence.barrier("step9", 2)
+    # a rank spawned AFTER the resize reads the post-bump epoch: current
+    assert E.GenerationFence(store, rank=0).check()
+
+
+def test_fence_releases_waiters_mid_barrier(store):
+    """A resize landing while ranks wait in a barrier must fence the
+    waiters out (StaleGenerationError), not leave them to the timeout."""
+    fence = E.GenerationFence(store, rank=0)
+    err = []
+
+    def wait():
+        try:
+            fence.barrier("stepX", 3, timeout=30)
+        except E.StaleGenerationError as e:
+            err.append(e)
+
+    t = threading.Thread(target=wait)
+    t.start()
+    time.sleep(0.15)
+    E.bump_world_epoch(store)
+    t.join(10)
+    assert err, "waiter survived the resize (or is still blocked)"
+
+
+def test_hook_fenced_zombie_never_writes(tmp_path, store):
+    net, opt = _tiny()
+    ctx = E.ElasticTrainContext(store=store, rank=0, world=1, gen=0)
+    hook = ckpt.CheckpointHook(str(tmp_path / "ck"), net, opt,
+                               save_interval=1, async_save=False,
+                               install_sigterm=False, elastic=ctx)
+    assert hook.on_step_end(0) == "saved"
+    E.bump_world_epoch(store)  # the world resized past this rank
+    assert hook.on_step_end(1) == "fenced"
+    hook.request_preempt()
+    assert hook.on_step_end(2) == "fenced"  # even the emergency path
+    steps = ckpt.list_steps(str(tmp_path / "ck"))
+    assert steps == [0], f"zombie wrote checkpoints: {steps}"
+
+
+# ----------------------------------------------------------- fault points --
+
+def test_rank_preempt_fault_lands_emergency_ckpt(tmp_path):
+    net, opt = _tiny()
+    hook = ckpt.CheckpointHook(str(tmp_path), net, opt, save_interval=100,
+                               async_save=False, install_sigterm=True)
+    try:
+        faults.configure("rank_preempt:step=2")
+        assert hook.on_step_end(0) == "ok"
+        assert hook.on_step_end(1) == "ok"
+        # the injected SIGTERM is delivered inside this call, the
+        # handler sets the preempt flag, and the SAME boundary writes
+        # the emergency checkpoint — one call, whole preemption path
+        assert hook.on_step_end(2) == "preempted"
+    finally:
+        hook.close()
+    assert registry.counters("fault")["injected.rank_preempt"] >= 1
+    _, man = ckpt.load_latest(str(tmp_path))
+    assert man["step"] == 2 and man["user"]["emergency"]
+
+
+def test_store_partition_rides_retry_backoff(store):
+    before = registry.counters("fault")["store.retries"]
+    faults.configure("store_partition:secs=0.15")
+    # cumulative retry backoff (0.05 + 0.1 + 0.2) outlives the 0.15 s
+    # partition: the op heals transparently, no error escapes
+    store.set("part/key", "survived")
+    faults.reset()
+    assert store.get("part/key") == b"survived"
+    assert registry.counters("fault")["store.retries"] > before
+    assert registry.counters("fault")["injected.store_partition"] >= 1
+
+
+def test_step_hang_fault_trips_watchdog(tmp_path):
+    net, opt = _tiny()
+    sink = io.StringIO()
+    ctx = E.ElasticTrainContext(store=None, rank=0, world=1,
+                                step_deadline=0.2,
+                                watchdog_escalate="report",
+                                watchdog_sink=sink)
+    ctx.watchdog._poll = 0.03
+    ctx.start(first_step=0)
+    hook = ckpt.CheckpointHook(str(tmp_path), net, opt, save_interval=100,
+                               async_save=False, install_sigterm=False,
+                               elastic=ctx)
+    try:
+        faults.configure("step_hang:step=1,secs=0.8")
+        assert hook.on_step_end(0) == "ok"
+        hook.on_step_end(1)  # wedges for 0.8 s with the deadline at 0.2
+    finally:
+        ctx.stop()
+    assert ctx.watchdog.tripped
+    assert "--- thread MainThread" in sink.getvalue()
+    assert registry.counters("fault")["injected.step_hang"] == 1
+
+
+# ------------------------------------------------------- supervisor resize --
+
+_STUB_TRAINER = r"""
+import os, sys, time
+rank = os.environ["PADDLE_TRAINER_ID"]
+world = os.environ["PADDLE_TRAINERS_NUM"]
+gen = os.environ.get("PADDLE_ELASTIC_GEN", "0")
+epoch = os.environ.get("PADDLE_WORLD_EPOCH", "0")
+def log(line):
+    with open(os.path.join(sys.argv[1], "ev.log"), "a") as f:
+        f.write(line + "\n")
+log(f"start rank={rank} world={world} gen={gen} epoch={epoch}")
+mode = sys.argv[2]
+if mode == "shrink":
+    if rank == "2" and world == "3":
+        sys.exit(9)  # this rank is lost for good at world 3
+    for _ in range(15):
+        time.sleep(0.1)
+elif mode == "grow":
+    if world != "3":
+        time.sleep(60)  # hold until the supervisor resizes us away
+elif mode == "sleep":
+    time.sleep(60)
+log(f"done rank={rank} world={world} gen={gen} epoch={epoch}")
+"""
+
+
+def _spawn_stub_world(pod, tmp_path, n, mode):
+    trainer = tmp_path / "stub_trainer.py"
+    trainer.write_text(_STUB_TRAINER)
+    for r in range(n):
+        env = dict(os.environ)
+        env.update({"PADDLE_TRAINER_ID": str(r),
+                    "PADDLE_TRAINERS_NUM": str(n),
+                    "PADDLE_ELASTIC_GEN": "0"})
+        pod.spawn([sys.executable, str(trainer), str(tmp_path), mode],
+                  env, str(tmp_path / f"wl.{r}"))
+
+
+def test_pod_shrinks_when_budget_exhausted(tmp_path, store):
+    from proc_utils import proc_timeout
+
+    pod = Pod(max_restarts=1, restart_backoff=0.1, terminate_grace=1.0,
+              store=store, elastic=True, log=lambda m: None)
+    _spawn_stub_world(pod, tmp_path, 3, "shrink")
+    t0 = time.time()
+    rc = pod.watch()
+    assert rc == 0, f"pod rc={rc} after {time.time() - t0:.1f}s"
+    assert time.time() - t0 < proc_timeout(120)
+    ev = (tmp_path / "ev.log").read_text()
+    starts2 = [ln for ln in ev.splitlines()
+               if ln.startswith("start") and "world=2" in ln]
+    assert len(starts2) == 2, ev
+    # the resize advanced BOTH counters: gen (re-rendezvous) and the
+    # world epoch (membership change → fencing)
+    assert all("epoch=1" in ln for ln in starts2), starts2
+    assert ev.count("done") == 2
+    assert int(store.add("elastic/world_epoch", 0)) == 1
+
+
+def test_pod_grows_on_resize_request(tmp_path, store):
+    pod = Pod(max_restarts=2, restart_backoff=0.1, terminate_grace=1.0,
+              store=store, elastic=True, log=lambda m: None)
+    _spawn_stub_world(pod, tmp_path, 2, "grow")
+    # the request must be filed AFTER watch() begins (it snapshots the
+    # request sequence at entry so stale requests are not replayed)
+    threading.Timer(0.8, lambda: E.request_resize(store, 3)).start()
+    rc = pod.watch()
+    assert rc == 0
+    ev = (tmp_path / "ev.log").read_text()
+    done3 = [ln for ln in ev.splitlines()
+             if ln.startswith("done") and "world=3" in ln]
+    ranks = sorted(ln.split("rank=")[1].split()[0] for ln in done3)
+    assert ranks == ["0", "1", "2"], ev
+
+
+def test_pod_lease_expiry_declares_live_process_dead(tmp_path, store):
+    """Liveness is the LEASE, not the OS process: a rank whose heartbeat
+    went stale is SIGKILLed and treated as crashed even though it was
+    happily sleeping — a wedged trainer cannot hold the job hostage."""
+    pod = Pod(max_restarts=0, restart_backoff=0.1, terminate_grace=1.0,
+              store=store, elastic=True, lease_ttl=0.4, lease_grace=0.6,
+              log=lambda m: None)
+    _spawn_stub_world(pod, tmp_path, 1, "sleep")
+    # the rank "registered" once and then its heartbeat thread died
+    store.set("elastic/lease/0/0", str(time.time() - 60.0))
+    before = registry.counters("fault")["elastic.lease_expiries"]
+    rc = pod.watch()
+    # world of 1 cannot shrink: budget 0 → the pod reports the failure
+    assert rc == -9, rc
+    assert registry.counters("fault")["elastic.lease_expiries"] == before + 1

@@ -43,6 +43,27 @@ launch supervision conventions, fronted by the prefix-aware router):
                        reference — draft-verify acceptance is exact,
                        zero failed requests
 
+Elastic-training scenarios (ISSUE 13 — a real launch.Pod supervising
+real trainer grandchildren over a real TCPStore, sharded per-step
+checkpoints through the production CheckpointHook):
+
+    elastic-shrink     a rank that exhausts its restart budget is
+                       removed: the pod publishes the next elastic
+                       generation and respawns the survivors as a
+                       3-rank world that resumes from the resharded
+                       4-rank checkpoint — no human intervention,
+                       survivor weights bitwise-identical to each other
+    elastic-grow       an operator resize request (fleet.elastic.
+                       request_resize) grows the world 2->3 mid-run;
+                       the running ranks land a coordinated emergency
+                       checkpoint in the SIGTERM grace and the grown
+                       world resumes from it via load_resharded
+    train-hang         a wedged step body (step_hang fault) trips the
+                       step watchdog: thread stacks land in the worker
+                       log, the trainer exits HANG_RC, the supervisor
+                       logs the hang distinctly, restarts it, and the
+                       resumed run completes from checkpoint
+
 The RUNNER is pure stdlib (no paddle_tpu/jax import in this process) so
 CI can invoke it anywhere; the scenarios import paddle_tpu in their child
 processes on JAX_PLATFORMS=cpu (fleet scenarios additionally spawn pod
@@ -533,6 +554,251 @@ print("SPEC-KILL-OK")
         return False, "scenario exited 0 without completing"
     return ok, why or ("spec pod respawned; orphans replayed bitwise vs "
                        "plain decode, zero failed")
+
+
+# Elastic-training scenarios (ISSUE 13): a real launch.Pod supervising
+# real trainer grandchildren over a real TCPStore. The trainer below is
+# the shared rig — a deterministic dp-replicated toy step (every rank
+# computes the SAME update from the SAME per-step batch, so any rank's
+# weights are THE weights and a resharded resume is bitwise-checkable
+# across world sizes), sharded per-step checkpoints through the real
+# CheckpointHook, a generation-fenced store barrier standing in for the
+# per-step collective, and the full ElasticTrainContext (heartbeat
+# lease, preemption coordinator, fence, optional step watchdog).
+_ELASTIC_TRAINER = r"""
+import os, sys, time
+# the trainer runs as a FILE from the scenario tempdir, so sys.path[0]
+# is that dir, not the repo — the driver hands the repo root down
+sys.path.insert(0, os.environ["PADDLE_TPU_REPO"])
+if int(os.environ.get("PADDLE_RESTART_COUNT", "0")) > 0:
+    # respawned trainers disarm one-shot lethal faults (pod_worker
+    # convention) — a hang/kill fault must not re-fire every restart
+    os.environ.pop("FLAGS_fault_inject", None)
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import (ElasticTrainContext,
+                                                  StaleGenerationError)
+from paddle_tpu.incubate import checkpoint as ckpt
+
+work, port, total = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+rank = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+world = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+gen = int(os.environ.get("PADDLE_ELASTIC_GEN", "0"))
+step_sleep = float(os.environ.get("ELASTIC_STEP_SLEEP", "0"))
+deadline = float(os.environ.get("ELASTIC_STEP_DEADLINE", "0")) or None
+
+def logline(s):
+    with open(os.path.join(work, "events.log"), "a") as f:
+        f.write(s + "\n")
+
+store = TCPStore("127.0.0.1", port, is_master=False,
+                 world_size=world) if port else None
+ctx = ElasticTrainContext(store=store, step_deadline=deadline,
+                          watchdog_sink=sys.stderr)
+paddle.seed(7)
+net = paddle.nn.Linear(8, 4)
+opt = paddle.optimizer.SGD(learning_rate=0.05,
+                           parameters=net.parameters())
+hook = ckpt.CheckpointHook(os.path.join(work, "ckpt"), net, opt,
+                           save_interval=1, async_save=False, rank=rank,
+                           world_size=world, shard=world > 1,
+                           reshard=True, elastic=ctx)
+start = hook.restore()
+ctx.start(first_step=start)
+logline(f"start rank={rank} world={world} gen={gen} step={start}")
+cursed = (os.environ.get("ELASTIC_CURSED_RANK") == str(rank)
+          and os.environ.get("ELASTIC_CURSED_WORLD") == str(world))
+for step in range(start, total):
+    if cursed and step >= 3:
+        os._exit(137)  # this rank is lost for good at this world size
+    r = np.random.default_rng(1000 + step)
+    x = paddle.to_tensor(r.normal(size=(4, 8)).astype(np.float32))
+    y = paddle.to_tensor(r.normal(size=(4, 4)).astype(np.float32))
+    loss = ((net(x) - y) ** 2).mean()
+    loss.backward(); opt.step(); opt.clear_grad()
+    if step_sleep:
+        time.sleep(step_sleep)
+    try:
+        ctx.barrier(f"step{step}", timeout=600)
+    except StaleGenerationError:
+        logline(f"fenced rank={rank} world={world} gen={gen} step={step}")
+        ctx.stop(); sys.exit(0)
+    status = hook.on_step_end(step)
+    logline(f"step rank={rank} world={world} gen={gen} step={step} "
+            f"status={status}")
+    if status in ("preempted", "fenced"):
+        hook.wait(); ctx.stop(); sys.exit(0)
+hook.wait()
+blob = b"".join(np.asarray(v.numpy()).tobytes()
+                for v in net.state_dict().values())
+logline(f"final rank={rank} world={world} gen={gen} hex={blob.hex()}")
+ctx.stop()
+"""
+
+_ELASTIC_DRIVER_PRELUDE = r"""
+import os, sys, time
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import paddle_tpu
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.launch.main import Pod
+
+os.environ["PADDLE_TPU_REPO"] = os.path.dirname(
+    os.path.dirname(os.path.abspath(paddle_tpu.__file__)))
+work = sys.argv[1]
+trainer = os.path.join(work, "trainer.py")
+
+def read_events():
+    try:
+        return open(os.path.join(work, "events.log")).read()
+    except OSError:
+        return ""
+
+def spawn_world(pod, n, port, total, extra_env=()):
+    for r in range(n):
+        env = dict(os.environ)
+        env.update({"PADDLE_TRAINER_ID": str(r),
+                    "PADDLE_TRAINERS_NUM": str(n),
+                    "PADDLE_ELASTIC_GEN": "0"})
+        env.update(dict(extra_env))
+        pod.spawn([sys.executable, trainer, work, str(port), str(total)],
+                  env, os.path.join(work, f"workerlog.{r}"))
+"""
+
+
+@scenario("elastic-shrink", "rank exhausts its restart budget: the pod "
+                            "shrinks 4->3 and training completes from "
+                            "the resharded checkpoint")
+def _elastic_shrink(timeout):
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "trainer.py"), "w") as f:
+            f.write(_ELASTIC_TRAINER)
+        code = _ELASTIC_DRIVER_PRELUDE + r"""
+store = TCPStore("127.0.0.1", 0, is_master=True, world_size=4)
+pod = Pod(max_restarts=1, restart_backoff=0.2, terminate_grace=2.0,
+          store=store, elastic=True,
+          log=lambda m: print("[pod]", m, flush=True))
+spawn_world(pod, 4, store.port, 8,
+            extra_env={"ELASTIC_CURSED_RANK": "3",
+                       "ELASTIC_CURSED_WORLD": "4"})
+rc = pod.watch()
+assert rc == 0, f"pod rc={rc}"
+ev = read_events()
+starts3 = [ln for ln in ev.splitlines()
+           if ln.startswith("start") and "world=3" in ln]
+assert len(starts3) >= 3, f"no 3-rank world came up:\n{ev}"
+assert all("gen=0" not in ln for ln in starts3), \
+    "resized world kept generation 0 (no fence bump)"
+resumed = [ln for ln in starts3 if int(ln.rsplit("step=", 1)[1]) > 0]
+assert resumed, "world-3 ranks restarted from scratch, not from the " \
+                "resharded checkpoint"
+finals = [ln for ln in ev.splitlines()
+          if ln.startswith("final") and "world=3" in ln]
+ranks = sorted(ln.split("rank=")[1].split()[0] for ln in finals)
+assert ranks == ["0", "1", "2"], f"finals: {finals}"
+hexes = {ln.rsplit("hex=", 1)[1] for ln in finals}
+assert len(hexes) == 1, "survivor weights diverged after the resize"
+print("SHRINK-OK")
+"""
+        ok, why, out = _run_child(code, timeout, argv=(d,))
+        if ok and "SHRINK-OK" not in out:
+            return False, "scenario exited 0 without completing"
+        return ok, why or ("budget-exhausted rank removed; survivors "
+                           "resumed as a 3-rank world from the "
+                           "resharded checkpoint")
+
+
+@scenario("elastic-grow", "operator resize request grows the world 2->3 "
+                          "mid-run; the grown rank joins from the "
+                          "resharded checkpoint")
+def _elastic_grow(timeout):
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "trainer.py"), "w") as f:
+            f.write(_ELASTIC_TRAINER)
+        code = _ELASTIC_DRIVER_PRELUDE + r"""
+import threading
+from paddle_tpu.distributed.fleet.elastic import request_resize
+
+store = TCPStore("127.0.0.1", 0, is_master=True, world_size=3)
+pod = Pod(max_restarts=2, restart_backoff=0.2, terminate_grace=2.0,
+          store=store, elastic=True,
+          log=lambda m: print("[pod]", m, flush=True))
+spawn_world(pod, 2, store.port, 24,
+            extra_env={"ELASTIC_STEP_SLEEP": "0.15"})
+
+def grow_when_warm():
+    deadline = time.time() + 600
+    while time.time() < deadline:
+        if sum(1 for ln in read_events().splitlines()
+               if ln.startswith("step")) >= 4:
+            request_resize(store, 3)
+            return
+        time.sleep(0.2)
+
+t = threading.Thread(target=grow_when_warm, daemon=True)
+t.start()
+rc = pod.watch()
+assert rc == 0, f"pod rc={rc}"
+ev = read_events()
+starts3 = [ln for ln in ev.splitlines()
+           if ln.startswith("start") and "world=3" in ln]
+assert len(starts3) >= 3, f"no 3-rank world came up:\n{ev}"
+resumed = [ln for ln in starts3 if int(ln.rsplit("step=", 1)[1]) > 0]
+assert resumed, "grown world restarted from scratch, not from the " \
+                "resharded checkpoint"
+finals = [ln for ln in ev.splitlines()
+          if ln.startswith("final") and "world=3" in ln]
+ranks = sorted(ln.split("rank=")[1].split()[0] for ln in finals)
+assert ranks == ["0", "1", "2"], f"finals: {finals}"
+assert len({ln.rsplit("hex=", 1)[1] for ln in finals}) == 1, \
+    "ranks diverged after the grow"
+print("GROW-OK")
+"""
+        ok, why, out = _run_child(code, timeout, argv=(d,))
+        if ok and "GROW-OK" not in out:
+            return False, "scenario exited 0 without completing"
+        return ok, why or ("requested 2->3 grow landed; all three ranks "
+                           "finished bitwise-identical from the "
+                           "resharded checkpoint")
+
+
+@scenario("train-hang", "wedged step body trips the watchdog: stacks "
+                        "dumped, HANG_RC escalation, supervisor restart, "
+                        "training completes")
+def _train_hang(timeout):
+    with tempfile.TemporaryDirectory() as d:
+        with open(os.path.join(d, "trainer.py"), "w") as f:
+            f.write(_ELASTIC_TRAINER)
+        code = _ELASTIC_DRIVER_PRELUDE + r"""
+pod = Pod(max_restarts=2, restart_backoff=0.2, terminate_grace=2.0,
+          log=lambda m: print("[pod]", m, flush=True))
+spawn_world(pod, 1, 0, 8,
+            extra_env={"ELASTIC_STEP_DEADLINE": "15.0",
+                       "FLAGS_fault_inject": "step_hang:step=4,secs=600"})
+rc = pod.watch()
+assert rc == 0, f"pod rc={rc}"
+log = open(os.path.join(work, "workerlog.0")).read()
+assert "WATCHDOG" in log, "watchdog never tripped"
+assert "--- thread" in log, "no thread stacks in the worker log"
+ev = read_events()
+finals = [ln for ln in ev.splitlines() if ln.startswith("final")]
+assert finals, f"training never completed:\n{ev}"
+resumed = [ln for ln in ev.splitlines() if ln.startswith("start")
+           and int(ln.rsplit("step=", 1)[1]) > 0]
+assert resumed, "post-hang restart did not resume from checkpoint"
+print("HANG-OK")
+"""
+        ok, why, out = _run_child(code, timeout, argv=(d,))
+        if not ok:
+            return False, why
+        if "HANG-OK" not in out:
+            return False, "scenario exited 0 without completing"
+        if "hung: step watchdog escalated" not in out:
+            return False, "supervisor never saw the HANG_RC escalation"
+        return True, ("watchdog dumped stacks + escalated rc 98; "
+                      "supervisor restarted the rank; resumed run "
+                      "completed")
 
 
 def main(argv=None):

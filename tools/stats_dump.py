@@ -96,6 +96,27 @@ def _print_fastpath(counters, gauges):
         _print_counters(causes, indent="    ")
 
 
+# elastic training loop (ISSUE 13): heartbeat misses, hang trips,
+# resizes and fenced zombies are the preemption-survival story — one
+# table answers "did the job stay up, and what did it cost"
+_ELASTIC_KEYS_PREFIX = "fault.elastic."
+
+
+def _print_elastic(counters, gauges):
+    keys = [k for k in counters if k.startswith(_ELASTIC_KEYS_PREFIX)
+            and k != "fault.elastic.generation_bumps"]
+    if not any(counters[k] for k in keys):
+        # an un-elastic run keeps its zero-initialized keys in the
+        # fault-tolerance table below (a dedicated all-zero section
+        # would imply the loop ran); any non-zero activity claims the
+        # whole group — the remaining zeros ARE the story then (e.g.
+        # resizes>0 with fenced_zombies=0 means no zombie ever formed)
+        return
+    el = {k: counters.pop(k) for k in keys}
+    print("elastic training:")
+    _print_counters(el)
+
+
 _FLEET_PREFIXES = ("fleet.",)
 _FLEET_HANDOFF_KEYS = frozenset(("serving.handoff_exports",
                                  "serving.handoff_imports"))
@@ -204,6 +225,10 @@ def _print_snapshot(snap):
         print("train->serve loop:")
         _print_counters(ts_counters)
         _print_counters(ts_gauges)
+    # elastic training loop (ISSUE 13) claims its fault.elastic.* keys
+    # before the fault-tolerance table: heartbeat misses / hang trips /
+    # resizes / fenced zombies read as one preemption-survival story
+    _print_elastic(counters, gauges)
     # serving fleet (ISSUE 11) before the per-subsystem serving tables:
     # pod restarts / orphan replays / routing hit rate are the
     # cross-process resilience story, read as one table
