@@ -544,10 +544,24 @@ def _run_serve_child():
     the plain baseline, and the phase's own 0-verify-recompile and
     0-failed gates ride the existing envelope.
 
+    Fifth phase (ISSUE 14) — PAGED KERNEL: paired single-slot decode on
+    identical weights, XLA gather path vs the fused Pallas paged-
+    attention kernel (compiled on TPU; the same kernel body through the
+    Pallas interpreter on CPU, so the greedy-parity gate runs every
+    round instead of silently skipping off-chip). Emits a dedicated
+    {"metric": "serving-kernel"} line with selection, parity, tokens/s
+    and p50 step-time fields.
+
     Convention matches --ratio: the telemetry line prints first, the
     {"metric": "serving"} result line stays last."""
-    os.environ["JAX_PLATFORMS"] = "cpu"
+    # CPU by DEFAULT (this is the calibrated microbench config), but an
+    # explicit JAX_PLATFORMS=tpu wins: that's how a live-window run
+    # banks the kernel phase's real on-chip pallas-vs-xla numbers
+    # (ISSUE 14) instead of interpreter ones
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
     import time as _t
+
+    import jax
 
     import paddle_tpu as paddle
     from paddle_tpu.models.gpt import (GPTConfig, GPTForPretraining,
@@ -555,6 +569,7 @@ def _run_serve_child():
     from paddle_tpu.profiler import registry as _reg
     from paddle_tpu.serving import GenerationServer
 
+    _plat = jax.default_backend()
     paddle.seed(0)
     cfg = GPTConfig(vocab_size=128, n_layer=2, n_head=2, d_model=64,
                     seq_len=64, initializer_range=0.3)
@@ -753,6 +768,63 @@ def _run_serve_child():
     # measured window added zero
     spec_compiles = c5["verify_compiles"] - c4["verify_compiles"]
 
+    # ---- paged-kernel phase (ISSUE 14) -------------------------------
+    # Paired decode on IDENTICAL weights: the PR 9 XLA gather path vs
+    # the fused Pallas paged-attention kernel. On TPU the fused engine
+    # runs the compiled kernel (real tokens/s comparison); on CPU it
+    # runs the SAME kernel body through the Pallas interpreter, so the
+    # greedy-parity gate executes on every round instead of silently
+    # skipping off-chip (the interpreter's tokens/s is reported but
+    # meaningless as a speed number). Both engines are single-slot so
+    # the step-time split is pure attention-path delta. The phase model
+    # is TILEABLE on purpose (n_head=1 -> head_dim 64): the main serve
+    # model's head_dim 32 would silently demote the on-chip pallas leg
+    # to xla and make the TPU comparison vacuous.
+    paddle.seed(2)
+    kcfg = GPTConfig(vocab_size=128, n_layer=2, n_head=1, d_model=64,
+                     seq_len=64, initializer_range=0.3)
+    kmodel = GPTForPretraining(GPTModel(kcfg))
+
+    def _kernel_run(kind, n=14):
+        eng = GenerationEngine(kmodel, max_batch_size=1, buckets=(16,),
+                               rng_seed=5, block_size=8,
+                               paged_kernel=kind)
+        kprompt = [7, 3, 11, 42, 9, 23, 5]
+        eng.prefill(0, kprompt, seed=2)       # warmup compile
+        for _ in range(3):
+            eng.decode_step()
+        eng.release(0)
+        out = [eng.prefill(0, kprompt, seed=2)]
+        times = []
+        for _ in range(n - 1):
+            t0 = _t.perf_counter()
+            out.append(int(eng.decode_step()[0]))
+            times.append(_t.perf_counter() - t0)
+        eng.release(0)
+        times.sort()
+        return (out, eng.paged_kernel,
+                round((n - 1) / max(sum(times), 1e-9), 1),
+                round(times[len(times) // 2] * 1e3, 3))
+
+    kx_toks, _, kx_tps, kx_p50 = _kernel_run("xla")
+    kf_toks, fused_kind, kf_tps, kf_p50 = _kernel_run("pallas")
+    kernel_parity = kx_toks == kf_toks
+    krec = {
+        "metric": "serving-kernel",
+        # selection: what the MAIN serving engine above resolved to
+        # (auto policy), and what the fused leg of this phase ran
+        "paged_kernel": server.engine.paged_kernel,
+        "fused_kernel": fused_kind,
+        # parity: greedy tokens must be IDENTICAL across kernels
+        "kernel_parity": kernel_parity,
+        "xla_tokens_per_s": kx_tps,
+        "fused_tokens_per_s": kf_tps,
+        "xla_p50_step_ms": kx_p50,
+        "fused_p50_step_ms": kf_p50,
+        "platform": _plat,
+    }
+    print(json.dumps(krec), flush=True)
+
     failed = len([r for r in reqs + preqs + itl_reqs
                   if r.status != "done"])
     tokens = sum(len(r.tokens) for r in reqs)
@@ -837,7 +909,12 @@ def _run_serve_child():
             / max(1, c4s["spec_proposed"] - c4["spec_proposed"]), 4),
         "spec_draft_k": 4,
         "spec_verify_compiles": spec_compiles,
-        "platform": "cpu",
+        # paged-kernel phase (ISSUE 14): the active kernel + the paired
+        # parity gate also ride the headline record (full detail in the
+        # {"metric": "serving-kernel"} line above)
+        "paged_kernel": server.engine.paged_kernel,
+        "kernel_parity": kernel_parity,
+        "platform": _plat,
     }
     print(json.dumps(rec), flush=True)
     # ISSUE 12 envelope: zero failed, zero post-warmup decode compiles,
@@ -847,7 +924,8 @@ def _run_serve_child():
     gates_ok = (failed == 0 and spec_bitwise and spec_compiles == 1
                 and rec["decode_compiles_after_warmup"] == 0
                 and rec["spec_speedup_x"] > 1.0
-                and rec["itl_flatten_x"] > 1.5)
+                and rec["itl_flatten_x"] > 1.5
+                and kernel_parity)
     return 0 if gates_ok else 1
 
 

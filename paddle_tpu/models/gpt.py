@@ -131,7 +131,7 @@ class GPTAttention(nn.Layer):
         self.out_proj.weight.sharding_spec = ("mp", None)
 
     def forward(self, x, cache=None, cache_offset=None, seq_lens=None,
-                block_tables=None):
+                block_tables=None, paged_kernel=None):
         B, T, D = x.shape
         qkv = self.qkv_proj(x).reshape([B, T, 3, self.n_head, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
@@ -170,6 +170,21 @@ class GPTAttention(nn.Layer):
             v_flat = ops.put_along_axis(
                 v_flat, widx,
                 v.reshape([B * T, self.n_head, self.head_dim]), axis=0)
+            if paged_kernel in ("pallas", "interpret"):
+                # Fused read path (ISSUE 14): the Pallas kernel walks the
+                # block table inside the kernel, so the gathered
+                # [B, M*bs, H, Dh] view below never materializes. The
+                # scatter above is unchanged (T rows, garbage-block-0
+                # redirect intact); only the O(M*bs) gather is fused.
+                # `paged_kernel` is a static per-engine choice
+                # (pallas_ops.select_paged_kernel) — never data.
+                new_k = k_flat.reshape(k_pool.shape)
+                new_v = v_flat.reshape(v_pool.shape)
+                out = F.paged_attention(q, new_k, new_v, block_tables,
+                                        seq_lens, cache_offset,
+                                        kernel=paged_kernel)
+                out = self.out_proj(out.reshape([B, T, D]))
+                return out, (new_k, new_v)
             slot_rows = ((block_tables * bs).unsqueeze(-1)
                          + ops.arange(0, bs, dtype="int32")).reshape([B, S])
             k_view = ops.gather(k_flat, slot_rows.reshape([-1]),
@@ -267,12 +282,13 @@ class GPTBlock(nn.Layer):
         return x + self.mlp(self.ln2(x))
 
     def forward(self, x, cache=None, cache_offset=None, seq_lens=None,
-                block_tables=None):
+                block_tables=None, paged_kernel=None):
         if cache is not None:
             a, new_cache = self.attn(self.ln1(x), cache=cache,
                                      cache_offset=cache_offset,
                                      seq_lens=seq_lens,
-                                     block_tables=block_tables)
+                                     block_tables=block_tables,
+                                     paged_kernel=paged_kernel)
             x = x + self.dropout(a)
             return x + self.mlp(self.ln2(x)), new_cache
         if self._recompute and self.training:
@@ -317,7 +333,8 @@ class GPTModel(nn.Layer):
             self.to(dtype=cfg.dtype)
 
     def forward(self, input_ids, position_ids=None, caches=None,
-                cache_offsets=None, seq_lens=None, block_tables=None):
+                cache_offsets=None, seq_lens=None, block_tables=None,
+                paged_kernel=None):
         if caches is not None and cache_offsets is None:
             _warn_legacy_cache()
         x = self.embeddings(input_ids, position_ids)
@@ -325,7 +342,8 @@ class GPTModel(nn.Layer):
             new_caches = []
             for blk, c in zip(self.blocks, caches):
                 x, nc = blk(x, cache=c, cache_offset=cache_offsets,
-                            seq_lens=seq_lens, block_tables=block_tables)
+                            seq_lens=seq_lens, block_tables=block_tables,
+                            paged_kernel=paged_kernel)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
         for blk in self.blocks:

@@ -43,8 +43,8 @@ __all__ = [
     "cosine_similarity", "cosine_embedding_loss", "label_smooth",
     "log_loss", "square_error_cost", "sigmoid_focal_loss", "dice_loss",
     "ctc_loss", "triplet_margin_loss", "pairwise_distance", "npair_loss",
-    "scaled_dot_product_attention", "sequence_mask", "temporal_shift",
-    "channel_shuffle",
+    "scaled_dot_product_attention", "paged_attention", "sequence_mask",
+    "temporal_shift", "channel_shuffle",
 ]
 
 
@@ -1100,6 +1100,26 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     if dropout_p > 0.0 and training:
         out = dropout(out, dropout_p)
     return out
+
+
+def paged_attention(query, k_pool, v_pool, block_tables, seq_lens,
+                    q_offsets, kernel="xla", name=None):
+    """Fused paged-KV attention (ISSUE 14): ``query`` [B, T, H, Dh] reads
+    each slot's logical KV view straight out of the shared block pool
+    [num_blocks, block_size, H, Dh] through its ``block_tables`` [B, M]
+    row — no gathered [B, M*bs, H, Dh] view is ever materialized on the
+    Pallas routes. ``kernel`` is a STATIC choice ("pallas" | "interpret"
+    | "xla"), resolved once per engine by
+    ``pallas_ops.select_paged_kernel``. Inference-only (nondiff): the
+    decode/verify hot path never backpropagates."""
+    from . import pallas_ops
+
+    def f(q, kp, vp, bt, sl, qo):
+        return pallas_ops.paged_attention(q, kp, vp, bt, sl, qo,
+                                          kernel=kernel)
+
+    return forward(f, (query, k_pool, v_pool, block_tables, seq_lens,
+                       q_offsets), name="paged_attention", nondiff=True)
 
 
 def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):

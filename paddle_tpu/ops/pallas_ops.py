@@ -18,6 +18,7 @@ kernels play for its CUDA ops.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -28,12 +29,40 @@ try:  # pltpu only importable when libtpu present; guard for CPU CI
 except ImportError:  # pragma: no cover
     pltpu = None
 
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+
+# Kernel-selection telemetry (ISSUE 14): every resolution of a hot-path
+# kernel family bumps exactly one counter, so an operator can read which
+# implementation actually serves from one table. The paged family's
+# selection happens ONCE per engine build (serving.kernel.*); the flash
+# family's happens per trace of the attention op (kernel.flash.*) —
+# trace-time only, the replay fast path never re-enters these bodies.
+_paged_counters = _registry.scoped_counters("serving", {
+    "kernel.pallas": 0, "kernel.xla": 0, "kernel.interpret": 0,
+    "kernel.fallbacks": 0})
+_flash_counters = _registry.scoped_counters("kernel", {
+    "flash.pallas": 0, "flash.stock": 0, "flash.xla": 0,
+    "flash.fallbacks": 0})
+
+
+def _note_kernel_fallback(family, reason, **detail):
+    """A Pallas-eligible call resolved to the XLA path: name the shape or
+    platform reason in the explainer ring so the slowdown is loud. Each
+    family bumps its OWN fallback counter — serving.kernel.fallbacks is
+    the paged decode/verify family's serving-health signal and must not
+    be inflated by training flash traces."""
+    if family.startswith("flash"):
+        _flash_counters["flash.fallbacks"] += 1
+    else:
+        _paged_counters["kernel.fallbacks"] += 1
+    _explain.record(
+        "kernel_fallback", op=family, why=reason, **detail)
+
 
 def _env_flag(name: str) -> bool:
     """Truthy env flag: unset, empty, or \"0\" mean OFF (consistent with
     PADDLE_TPU_X64 parsing in paddle_tpu/__init__.py)."""
-    import os
-
     return os.environ.get(name, "0") not in ("", "0")
 
 
@@ -336,6 +365,7 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None):
     if use_pallas:
         fa = _stock_flash()
         if fa is not None:
+            _flash_counters["flash.stock"] += 1
             sm_scale = float(scale) if scale is not None else H ** -0.5
             # library kernel layout is [B, N, T, H]
             qt = q.transpose(0, 2, 1, 3)
@@ -345,8 +375,9 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None):
                                      sm_scale=sm_scale)
             out = out.transpose(0, 2, 1, 3)
         else:
-            import os
             import warnings
+
+            _flash_counters["flash.pallas"] += 1
 
             blk = 256 if T % 256 == 0 else 128
 
@@ -373,6 +404,23 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None):
             out = _flash_attention_tpu(q, k, v, causal=causal, scale=scale,
                                        block_q=bq, block_k=bk)
     else:
+        # record the fallback REASON when the platform was eligible but a
+        # shape/dtype constraint forced the XLA path (satellite: the flash
+        # selection rides the same counters/explainer as the paged family)
+        _flash_counters["flash.xla"] += 1
+        if _on_tpu():
+            if mask is not None:
+                why = "explicit attn_mask (flash kernel is mask-free)"
+            elif k.shape[1] != T:
+                why = f"cross-length kv (T={T}, S={k.shape[1]})"
+            elif T % 128:
+                why = f"seq_len {T} not a multiple of 128"
+            elif H not in (64, 96, 128, 256):
+                why = f"head_dim {H} not in (64, 96, 128, 256)"
+            else:
+                why = f"dtype {q.dtype} not in (float32, bfloat16)"
+            _note_kernel_fallback("flash_attention", why,
+                                  shape=str(tuple(q.shape)))
         out = _attention_xla(q, k, v, mask=mask, causal=causal, scale=scale)
     # tag for remat policies: attention is the most expensive op to
     # rematerialize (profiled ~57% of gpt2-medium step time), so the
@@ -380,6 +428,286 @@ def flash_attention(q, k, v, mask=None, causal=False, scale=None):
     from jax.ad_checkpoint import checkpoint_name
 
     return checkpoint_name(out, "attn_out")
+
+
+# =========================== paged attention =================================
+#
+# Decode-path fused paged attention (ISSUE 14). The serving engine's paged
+# KV cache (PR 9) stores every slot's KV in a shared fixed-shape block pool
+# [num_blocks, block_size, H, Dh] addressed through per-slot int32 block
+# tables. The XLA path materializes a gathered [B, M*bs, H, Dh] view of the
+# pool and runs masked attention over it — two HBM round-trips XLA cannot
+# fuse. The Pallas kernel below walks the block table INSIDE the kernel
+# (vLLM PagedAttention / jax TPU paged_attention reference style): the
+# tables, lengths and query offsets ride scalar prefetch
+# (pltpu.PrefetchScalarGridSpec), so each grid step's BlockSpec index map
+# picks the one physical KV block that program needs and the pipeline DMAs
+# exactly that block HBM->VMEM. No gathered view ever exists.
+#
+# One kernel serves both consumers:
+#   * decode:      q is a [B, 1, H, Dh] span (T=1), q_offsets = cursors;
+#   * spec verify: q is the [B, K+1, H, Dh] verify span — the causal
+#     intra-span mask falls out of the position mask (row t admits key
+#     positions <= q_offsets+t, and span row u>t lives at position
+#     q_offsets+u), so no extra mask plumbing exists to get wrong.
+#
+# Semantics are pinned to the PR 9 gather path: key position j is valid for
+# query row t iff  j <= q_offsets[b] + t  AND  j < seq_lens[b].  Inactive
+# lanes (zeroed table rows, seq_lens=1) read the reserved garbage block 0
+# and produce finite garbage the host discards — masked lanes contribute
+# zero and can never corrupt live blocks, exactly like the gather path.
+#
+# Numerics: fp32 online-softmax accumulation in VMEM scratch. The XLA
+# oracle reduces in a different order (full-softmax over the gathered
+# view, probabilities cast back to the compute dtype before the PV
+# matmul), so fused-vs-XLA parity is a TOLERANCE contract, not bitwise:
+# PAGED_PARITY_TOL pins the per-dtype bounds the tests and the bench
+# parity gate use. Greedy token streams ARE required to be identical
+# across kernels at the served model sizes (the argmax margin dwarfs the
+# accumulation-order delta).
+
+# per-dtype |fused - xla| bounds (atol, rtol): fp32 differs only by
+# f32 reduction order; bf16 additionally keeps probabilities in f32
+# where the XLA path rounds them to bf16 before the PV matmul
+PAGED_PARITY_TOL = {"float32": (3e-5, 3e-5), "bfloat16": (0.05, 0.05)}
+
+
+def _paged_attn_kernel(bt_ref, sl_ref, qo_ref, q_ref, k_ref, v_ref, o_ref,
+                       m_scr, l_scr, acc_scr, *, scale, block_size):
+    """Grid (B, M): program (b, j) folds logical block j of slot b into
+    the slot's online-softmax state. Scratch (m/l/acc) persists across
+    the M dimension; the output block is written once, at the last j."""
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    T, H = q_ref.shape[0], q_ref.shape[1]
+    bs = jnp.int32(block_size)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    sl = sl_ref[b]
+    qo = qo_ref[b]
+    # highest key position any span row may read, exclusive
+    limit = jnp.minimum(qo + jnp.int32(T), sl)
+
+    @pl.when(j * bs < limit)
+    def _fold():
+        pos = j * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (T, block_size), 1)
+        row = qo + jax.lax.broadcasted_iota(
+            jnp.int32, (T, block_size), 0)
+        mask = (pos <= row) & (pos < sl)
+        for h in range(H):  # static unroll: per-head [T, bs] MXU dots
+            qh = q_ref[:, h, :].astype(jnp.float32) * scale
+            kh = k_ref[:, h, :].astype(jnp.float32)
+            vh = v_ref[:, h, :].astype(jnp.float32)
+            s = jnp.dot(qh, kh.T, preferred_element_type=jnp.float32)
+            s = jnp.where(mask, s, -jnp.inf)
+            m_new = jnp.maximum(m_scr[h], s.max(axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            corr = jnp.exp(m_scr[h] - m_new)
+            l_scr[h] = l_scr[h] * corr + p.sum(axis=-1, keepdims=True)
+            acc_scr[h] = acc_scr[h] * corr + jnp.dot(
+                p, vh, preferred_element_type=jnp.float32)
+            m_scr[h] = m_new
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finalize():
+        l = jnp.maximum(l_scr[...], 1e-30)
+        o_ref[...] = (acc_scr[...] / l).transpose(1, 0, 2).astype(
+            o_ref.dtype)
+
+
+def _paged_attention_fused(q, k_pool, v_pool, block_tables, seq_lens,
+                           q_offsets, scale, interpret):
+    B, T, H, Dh = q.shape
+    bs = int(k_pool.shape[1])
+    M = int(block_tables.shape[1])
+
+    def q_map(b, j, bt, sl, qo):
+        return (b, _i0(), _i0(), _i0())
+
+    def kv_map(b, j, bt, sl, qo):
+        # clamp the dead tail (blocks past the slot's live length) to the
+        # last LIVE block: the pipeline skips the DMA when consecutive
+        # grid steps map to the same physical block, so padded table rows
+        # cost no HBM traffic — and the fold body is @pl.when-ed off for
+        # them anyway
+        limit = jnp.minimum(qo[b] + jnp.int32(T), sl[b])
+        last = jnp.maximum(pl.cdiv(limit, jnp.int32(bs)) - 1, _i0())
+        return (bt[b, jnp.minimum(j, last)], _i0(), _i0(), _i0())
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, M),
+        in_specs=[
+            pl.BlockSpec((None, T, H, Dh), q_map),
+            pl.BlockSpec((None, bs, H, Dh), kv_map),
+            pl.BlockSpec((None, bs, H, Dh), kv_map),
+        ],
+        out_specs=pl.BlockSpec((None, T, H, Dh), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((H, T, 1), jnp.float32),   # running max
+            pltpu.VMEM((H, T, 1), jnp.float32),   # running denom
+            pltpu.VMEM((H, T, Dh), jnp.float32),  # fp32 accumulator
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_paged_attn_kernel, scale=scale, block_size=bs),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, H, Dh), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), seq_lens.astype(jnp.int32),
+      q_offsets.astype(jnp.int32), q, k_pool, v_pool)
+
+
+def paged_attention_xla(q, k_pool, v_pool, block_tables, seq_lens,
+                        q_offsets, scale=None):
+    """The gather-path reference: materialize each slot's logical
+    [M*bs] view of the pool and run masked attention over it. Same
+    semantics as GPTAttention's PR 9 paged branch; serves as the parity
+    oracle for the fused kernel and as the ``kernel="xla"`` route."""
+    B, T, H, Dh = q.shape
+    scale = float(scale) if scale is not None else Dh ** -0.5
+    Nb, bs = int(k_pool.shape[0]), int(k_pool.shape[1])
+    M = int(block_tables.shape[1])
+    S = M * bs
+    flat_k = k_pool.reshape(Nb * bs, H, Dh)
+    flat_v = v_pool.reshape(Nb * bs, H, Dh)
+    rows = ((block_tables.astype(jnp.int32) * bs)[:, :, None]
+            + jnp.arange(bs, dtype=jnp.int32)[None, None]).reshape(B, S)
+    k_view = jnp.take(flat_k, rows.reshape(-1), axis=0).reshape(
+        B, S, H, Dh)
+    v_view = jnp.take(flat_v, rows.reshape(-1), axis=0).reshape(
+        B, S, H, Dh)
+    jpos = jnp.arange(S, dtype=jnp.int32)
+    qrow = (q_offsets.astype(jnp.int32)[:, None]
+            + jnp.arange(T, dtype=jnp.int32)[None])
+    mask = ((jpos[None, None, :] <= qrow[:, :, None])
+            & (jpos[None, None, :]
+               < seq_lens.astype(jnp.int32)[:, None, None]))
+    return _attention_xla(q, k_view, v_view, mask=mask[:, None],
+                          scale=scale)
+
+
+def paged_attention(q, k_pool, v_pool, block_tables, seq_lens, q_offsets,
+                    kernel="xla", scale=None):
+    """Paged-KV attention: ``q`` [B, T, H, Dh] over pools
+    [num_blocks, block_size, H, Dh] addressed by ``block_tables`` [B, M].
+    ``seq_lens`` [B] counts each slot's valid rows INCLUDING the span's
+    own freshly-scattered rows; ``q_offsets`` [B] is the absolute
+    position of span row 0. ``kernel``: "pallas" (compiled TPU),
+    "interpret" (the same kernel body through the Pallas interpreter —
+    the CPU-CI parity route) or "xla" (gather reference). Resolve the
+    choice ONCE per engine with :func:`select_paged_kernel` — it must
+    never vary per step or the serving replay fast path retraces."""
+    scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
+    if kernel == "xla":
+        return paged_attention_xla(q, k_pool, v_pool, block_tables,
+                                   seq_lens, q_offsets, scale=scale)
+    if kernel not in ("pallas", "interpret"):
+        raise ValueError(
+            f"unknown paged-attention kernel {kernel!r} "
+            "(expected pallas | interpret | xla)")
+    out = _paged_attention_fused(q, k_pool, v_pool, block_tables,
+                                 seq_lens, q_offsets, scale,
+                                 interpret=(kernel == "interpret"))
+    # kernel_mismatch fault (testing/faults.py): perturb ONE element of
+    # the fused output so parity gates provably trip. Trace-time firing:
+    # the perturbation is baked into whichever executable traces while
+    # the point is armed (tests build throwaway engines/calls).
+    from ..testing import faults as _faults
+
+    if _faults.ACTIVE and _faults.fire("kernel_mismatch"):
+        out = out.at[(0,) * out.ndim].add(jnp.asarray(1.0, jnp.float32)
+                                          .astype(out.dtype))
+    return out
+
+
+def paged_tileable(head_dim, block_size, dtype):
+    """Can the COMPILED kernel tile these shapes on a real TPU? (The
+    interpreter route has no tiling constraints.) Returns (ok, reason)."""
+    dt = jnp.dtype(dtype)
+    if dt not in (jnp.dtype(jnp.float32), jnp.dtype(jnp.bfloat16)):
+        return False, f"pool dtype {dt.name} not in (float32, bfloat16)"
+    if head_dim % 64:
+        return False, (f"head_dim {head_dim} not a multiple of 64 "
+                       "(VPU lane alignment)")
+    sub = 8 if dt == jnp.dtype(jnp.float32) else 16
+    if block_size % sub:
+        return False, (f"block_size {block_size} not a multiple of the "
+                       f"{dt.name} sublane tile {sub}")
+    return True, "tileable"
+
+
+def select_paged_kernel(requested=None, *, head_dim, block_size, dtype,
+                        mesh=None, family="paged_attention"):
+    """Resolve the paged-attention kernel for one engine build.
+
+    ``requested``: "pallas" | "xla" | "auto" | None (None reads env
+    ``PADDLE_TPU_PAGED_KERNEL``, default "auto"). Resolution:
+
+      * auto   -> "pallas" on TPU when :func:`paged_tileable` passes,
+                  else "xla" (with a ``kernel_fallback`` explainer event
+                  naming the reason when a TPU was eligible);
+      * pallas -> "pallas" on TPU, "interpret" off-chip (the kernel BODY
+                  still runs — CPU CI's parity route); untileable shapes
+                  fall back to "xla" loudly;
+      * xla    -> "xla", always.
+
+    Mesh-sharded engines always take the XLA path (the kernel is not
+    GSPMD-partitionable yet); the fallback event names it. Returns
+    ``(kind, reason)`` and bumps ``serving.kernel.<kind>`` — call once
+    at engine build, never per step."""
+    env = os.environ.get("PADDLE_TPU_PAGED_KERNEL", "")
+    req = (requested or env or "auto").strip().lower()
+    if req not in ("pallas", "xla", "auto"):
+        source = ("paged_kernel argument" if requested
+                  else "env PADDLE_TPU_PAGED_KERNEL")
+        raise ValueError(
+            f"{source} = {req!r} (expected pallas | xla | auto; "
+            "\"interpret\" is a RESOLVED kind, not a request — ask for "
+            "pallas and off-chip engines run the interpreter)")
+    on_tpu = _on_tpu()
+    ok, why = paged_tileable(head_dim, block_size, dtype)
+    if req == "xla":
+        kind, reason = "xla", "requested"
+    elif pltpu is None:  # pragma: no cover — jaxlib without pallas-tpu
+        kind, reason = "xla", "jax.experimental.pallas.tpu unavailable"
+        if req == "pallas":
+            _note_kernel_fallback(family, reason)
+    elif mesh is not None:
+        kind, reason = "xla", ("mesh-sharded decode is GSPMD-partitioned; "
+                               "the paged kernel is single-chip only")
+        if req == "pallas" or on_tpu:
+            _note_kernel_fallback(family, reason)
+    elif req == "pallas":
+        if on_tpu and not ok:
+            kind, reason = "xla", why
+            _note_kernel_fallback(family, reason,
+                                  head_dim=head_dim,
+                                  block_size=block_size)
+        elif on_tpu:
+            kind, reason = "pallas", "requested"
+        else:
+            kind = "interpret"
+            reason = ("requested pallas off-chip: kernel body runs "
+                      "through the Pallas interpreter")
+    else:  # auto
+        if on_tpu and ok:
+            kind, reason = "pallas", "auto: tpu + tileable shapes"
+        elif on_tpu:
+            kind, reason = "xla", why
+            _note_kernel_fallback(family, reason,
+                                  head_dim=head_dim,
+                                  block_size=block_size)
+        else:
+            kind, reason = "xla", "auto: platform is not tpu"
+    _paged_counters[f"kernel.{kind}"] += 1
+    return kind, reason
 
 
 # =========================== fused softmax mask ==============================

@@ -142,7 +142,7 @@ class GenerationEngine:
 
     def __init__(self, model, max_batch_size=4, buckets=None,
                  max_seq_len=None, rng_seed=None, block_size=16,
-                 num_blocks=None, mesh=None):
+                 num_blocks=None, mesh=None, paged_kernel=None):
         gpt = getattr(model, "gpt", model)
         if not hasattr(gpt, "blocks") or not hasattr(gpt, "embeddings"):
             raise TypeError(
@@ -227,6 +227,22 @@ class GenerationEngine:
             kv_sharding = NamedSharding(
                 mesh, PartitionSpec(None, None, "mp", None) if heads_ok
                 else PartitionSpec())
+
+        # paged-attention kernel choice (ISSUE 14): resolved ONCE here —
+        # "pallas" (compiled TPU kernel), "interpret" (same kernel body
+        # through the Pallas interpreter: CPU CI's parity route) or
+        # "xla" (PR 9 gather path). A static per-engine constant closed
+        # over by the jitted steps, so the replay fast path sees ONE
+        # stable executable per (bucket, kernel) and a mid-flight kernel
+        # flip is impossible by construction. Decode + spec verify ride
+        # it; prefill stays on the XLA gather path (compute-bound, and
+        # its [1, L] spans amortize the gather anyway).
+        from ..ops import pallas_ops as _pallas_ops
+
+        self._paged_kernel, self._paged_kernel_reason = \
+            _pallas_ops.select_paged_kernel(
+                paged_kernel, head_dim=gpt.blocks[0].attn.head_dim,
+                block_size=self.block_size, dtype=self._dtype, mesh=mesh)
 
         Nb, bs = self.pool.num_blocks, self.block_size
         self._kv_shapes = [(Nb, bs, blk.attn.n_head, blk.attn.head_dim)
@@ -405,11 +421,13 @@ class GenerationEngine:
         return cached
 
     def _forward_slot(self, state_arrays, ids, positions, ks, vs, offsets,
-                      seq_lens, block_tables):
+                      seq_lens, block_tables, kernel=None):
         """Run the model's paged-cache forward path on traced arrays by
         temporarily binding them into the layer parameters (the
         jit.StaticFunction state-swap idiom). Trace-time only — the jitted
-        executables never re-enter Python."""
+        executables never re-enter Python. ``kernel`` selects the paged-
+        attention read path (None = XLA gather): a static string, fixed
+        per compiled step."""
         old = {n: self._state[n]._data for n in self._names}
         for n, arr in zip(self._names, state_arrays):
             self._state[n]._data = arr
@@ -420,7 +438,8 @@ class GenerationEngine:
                     Tensor(ids), position_ids=Tensor(positions),
                     caches=caches, cache_offsets=Tensor(offsets),
                     seq_lens=Tensor(seq_lens),
-                    block_tables=Tensor(block_tables))
+                    block_tables=Tensor(block_tables),
+                    paged_kernel=kernel)
             return (hidden._data,
                     tuple(c[0]._data for c in new_caches),
                     tuple(c[1]._data for c in new_caches))
@@ -475,7 +494,8 @@ class GenerationEngine:
         positions = jnp.minimum(cur_lens, self.max_seq_len - 1)[:, None]
         hidden, nk, nv = self._forward_slot(
             state_arrays, ids, positions, ks, vs,
-            positions[:, 0], cur_lens + 1, block_tables)
+            positions[:, 0], cur_lens + 1, block_tables,
+            kernel=self._paged_kernel)
         w = state_arrays[self._emb_idx]
         logits = (hidden[:, 0].astype(jnp.float32)
                   @ w.T.astype(jnp.float32))
@@ -1095,6 +1115,12 @@ class GenerationEngine:
                     "the host mirrors; rebuilding from host state")
 
     # -------------------------------------------------------------- stats --
+    @property
+    def paged_kernel(self):
+        """The resolved paged-attention kernel for decode/verify:
+        "pallas" | "interpret" | "xla". Fixed at engine build."""
+        return self._paged_kernel
+
     def mean_occupancy(self):
         steps = _counters["decode_steps"]
         if not steps:
@@ -1109,6 +1135,8 @@ class GenerationEngine:
 
     def stats(self):
         return {**_registry.counters("serving"),
+                "paged_kernel": self._paged_kernel,
+                "paged_kernel_reason": self._paged_kernel_reason,
                 "mean_occupancy": self.mean_occupancy(),
                 "prefix_hit_rate": self.prefix_hit_rate(),
                 "kv_blocks_total": self.pool.usable_blocks,

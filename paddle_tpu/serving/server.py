@@ -130,14 +130,16 @@ class GenerationServer:
                  buckets=None, max_seq_len=None, max_queue_size=16,
                  idle_wait_s=0.005, fail_fast_on_fatal=True,
                  block_size=16, num_blocks=None, mesh=None,
-                 draft_model=None, draft_k=4, prefill_chunk_tokens=None):
+                 draft_model=None, draft_k=4, prefill_chunk_tokens=None,
+                 paged_kernel=None):
         if engine is None:
             if model is None:
                 raise ValueError("GenerationServer needs a model or an "
                                  "engine")
             ekw = dict(max_batch_size=max_batch_size, buckets=buckets,
                        max_seq_len=max_seq_len, block_size=block_size,
-                       num_blocks=num_blocks, mesh=mesh)
+                       num_blocks=num_blocks, mesh=mesh,
+                       paged_kernel=paged_kernel)
             if draft_model is not None:
                 # speculative decoding (ISSUE 12): a small drafter
                 # proposes draft_k tokens per iteration, the target

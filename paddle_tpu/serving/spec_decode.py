@@ -138,6 +138,19 @@ class DraftVerifyEngine(GenerationEngine):
             if self._dstate[n] is dwt)
         self._ddtype = dwt._data.dtype
 
+        # the drafter's paged kernel resolves SEPARATELY against its own
+        # shapes (head_dim/dtype may differ from the target's); same
+        # requested policy, same build-time-only contract. The verify
+        # span rides the target's kernel resolved by super().__init__.
+        from ..ops import pallas_ops as _pallas_ops
+
+        self._draft_kernel, self._draft_kernel_reason = \
+            _pallas_ops.select_paged_kernel(
+                kw.get("paged_kernel"),
+                head_dim=dgpt.blocks[0].attn.head_dim,
+                block_size=self.block_size, dtype=self._ddtype,
+                family="paged_attention.draft")
+
         # drafter paged KV: same block geometry as the target (tables
         # share the row math), its own pool arrays (drafter head count
         # differs) and its own host-side accounting
@@ -177,7 +190,7 @@ class DraftVerifyEngine(GenerationEngine):
         return cached
 
     def _forward_draft(self, dstate_arrays, ids, positions, ks, vs,
-                       offsets, seq_lens, block_tables):
+                       offsets, seq_lens, block_tables, kernel=None):
         """The drafter's trace-time parameter rebinding — same
         StaticFunction state-swap idiom as the target's
         ``_forward_slot``, against the drafter's own module tree."""
@@ -192,7 +205,8 @@ class DraftVerifyEngine(GenerationEngine):
                     Tensor(ids), position_ids=Tensor(positions),
                     caches=caches, cache_offsets=Tensor(offsets),
                     seq_lens=Tensor(seq_lens),
-                    block_tables=Tensor(block_tables))
+                    block_tables=Tensor(block_tables),
+                    paged_kernel=kernel)
             return (hidden._data,
                     tuple(c[0]._data for c in new_caches),
                     tuple(c[1]._data for c in new_caches))
@@ -239,7 +253,8 @@ class DraftVerifyEngine(GenerationEngine):
             positions = jnp.minimum(rows, self.max_seq_len - 1)[:, None]
             hidden, nk, nv = self._forward_draft(
                 dstate, feed[:, None], positions, ks, vs,
-                positions[:, 0], rows + 1, block_tables)
+                positions[:, 0], rows + 1, block_tables,
+                kernel=self._draft_kernel)
             logits = (hidden[:, 0].astype(jnp.float32)
                       @ w.T.astype(jnp.float32))
             gum = _sampling.gumbel_rows(keys, gen_idx + j,
@@ -269,9 +284,13 @@ class DraftVerifyEngine(GenerationEngine):
         offs = jnp.arange(K + 1, dtype=jnp.int32)
         positions = jnp.minimum(cur_lens[:, None] + offs[None],
                                 self.max_seq_len - 1)
+        # verify-span variant of the fused kernel (ISSUE 14): the [B,
+        # K+1] span reads its slot's blocks through the same kernel —
+        # the causal intra-span mask falls out of the position mask
         hidden, nk, nv = self._forward_slot(
             state, ids, positions, ks, vs, cur_lens,
-            cur_lens + K + 1, block_tables)
+            cur_lens + K + 1, block_tables,
+            kernel=self._paged_kernel)
         w = state[self._emb_idx]
         B = ids.shape[0]
         flat = hidden.astype(jnp.float32).reshape(B * (K + 1), -1)
@@ -566,6 +585,7 @@ class DraftVerifyEngine(GenerationEngine):
 
     def stats(self):
         return {**super().stats(),
+                "draft_paged_kernel": self._draft_kernel,
                 "draft_k": self.draft_k,
                 "acceptance_rate": self.acceptance_rate(),
                 "accepted_len_mean": self.accepted_len_mean(),

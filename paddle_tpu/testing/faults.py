@@ -41,6 +41,11 @@ accepted by :func:`configure` directly::
                                          drafter; output must stay
                                          bitwise)
     "draft_garbage:rounds=3"             ... only the first 3 rounds
+    "kernel_mismatch"                    the next fused paged-attention
+                                         trace perturbs ONE output
+                                         element — the kernel-parity
+                                         gate must trip on it
+    "kernel_mismatch:nth=2"              ... the 2nd fused trace instead
     "rank_preempt:step=4"                SIGTERM this process at step 4
                                          (TPU preemption notice; the
                                          hook must land a coordinated
@@ -74,6 +79,7 @@ Points (consumed by the named subsystems):
     page_pool_exhausted serving/engine.can_admit (admission)     times
     mutate_signature    core/lazy.ReplayStep._replay             nth, mode
     draft_garbage       serving/spec_decode (drafting round)     rounds
+    kernel_mismatch     ops/pallas_ops.paged_attention (fused)   nth
     rank_preempt        checkpoint.CheckpointHook.on_step_end    step, rank
     store_partition     distributed/store.py TCPStore ops        secs, op
     step_hang           checkpoint.CheckpointHook.on_step_end    step, secs,
@@ -379,6 +385,19 @@ def fire(point, step=None, rank=None, path=None, op=None):
             return False
         _record(point, f"drafter proposals replaced with garbage "
                        f"(round #{ent['count']})")
+        return True
+
+    if point == "kernel_mismatch":
+        # fires at TRACE time in ops/pallas_ops.paged_attention's fused
+        # route: one output element gets +1 baked into the traced graph,
+        # so the fused-vs-XLA parity gate (tests, bench --serve kernel
+        # phase) provably trips instead of silently passing on a broken
+        # comparison
+        ent["count"] += 1
+        if ent["count"] != int(p.get("nth", 1)):
+            return False
+        _record(point, f"fused paged-attention trace #{ent['count']} "
+                       "perturbed by one output element")
         return True
 
     if point == "mutate_signature":

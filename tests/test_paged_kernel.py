@@ -1,0 +1,327 @@
+"""Pallas paged-attention kernel family (ISSUE 14).
+
+Interpreter-mode parity on CPU: the REAL kernel body (scalar-prefetched
+block tables, per-block online-softmax folding, garbage-block-0
+semantics) runs through ``pl.pallas_call(interpret=True)`` and must match
+the PR 9 XLA gather oracle within the pinned per-dtype tolerance
+(``pallas_ops.PAGED_PARITY_TOL`` — fp32 differs by reduction order only,
+bf16 additionally by where probabilities are rounded). Covers:
+
+  * seq_lens straddling block boundaries (bs-1 / bs / bs+1 / mid-block);
+  * inactive lanes aimed at reserved garbage block 0 (finite output,
+    live lanes unperturbed);
+  * the verify-span variant's causal intra-span masking (row t provably
+    independent of keys at positions > q_offset + t);
+  * ragged batches sharing physical blocks (prefix-style aliasing);
+  * end-to-end greedy/sampled serving-token parity across kernel
+    choices, including the spec-decode verify span;
+  * the zero-post-warmup-compile gate with the kernel layer active (the
+    PR 8 replay fingerprint is stable under kernel selection);
+  * the ``kernel_mismatch`` fault provably trips the parity gate.
+
+Compiled-kernel tests are marked ``tpu`` (conftest skips them on CPU).
+"""
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.ops import pallas_ops
+from paddle_tpu.profiler import explainer, registry
+from paddle_tpu.testing import faults
+
+VOCAB = 96
+
+
+def _build_model(seed=11):
+    from paddle_tpu.models.gpt import (GPTConfig, GPTForPretraining,
+                                       GPTModel)
+
+    paddle.seed(seed)
+    cfg = GPTConfig(vocab_size=VOCAB, n_layer=2, n_head=2, d_model=48,
+                    seq_len=64, initializer_range=0.35)
+    return GPTForPretraining(GPTModel(cfg))
+
+
+def _case(B, T, H, Dh, Nb, bs, M, dtype=jnp.float32, seed=0):
+    """Random pools + per-lane tables over distinct nonzero blocks."""
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.standard_normal((B, T, H, Dh)), dtype)
+    kp = jnp.asarray(rng.standard_normal((Nb, bs, H, Dh)), dtype)
+    vp = jnp.asarray(rng.standard_normal((Nb, bs, H, Dh)), dtype)
+    ids = rng.permutation(np.arange(1, Nb))[:B * M].reshape(B, M)
+    bt = jnp.asarray(ids, jnp.int32)
+    return q, kp, vp, bt
+
+
+def _parity(q, kp, vp, bt, sl, qo):
+    sl = jnp.asarray(sl, jnp.int32)
+    qo = jnp.asarray(qo, jnp.int32)
+    fused = pallas_ops.paged_attention(q, kp, vp, bt, sl, qo,
+                                       kernel="interpret")
+    ref = pallas_ops.paged_attention(q, kp, vp, bt, sl, qo, kernel="xla")
+    atol, rtol = pallas_ops.PAGED_PARITY_TOL[jnp.dtype(q.dtype).name]
+    np.testing.assert_allclose(
+        np.asarray(fused, np.float32), np.asarray(ref, np.float32),
+        atol=atol, rtol=rtol)
+    return fused
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_decode_straddles_block_boundaries(self, dtype):
+        # bs=4: valid lengths 3 / 4 / 5 / 10 sit just under, exactly on,
+        # just over and mid-way across block boundaries; T=1 decode rows
+        # at the cursor (the engine's q_offset = seq_len - 1)
+        q, kp, vp, bt = _case(4, 1, 2, 16, 16, 4, 3, dtype=dtype)
+        sl = [3, 4, 5, 10]
+        qo = [s - 1 for s in sl]
+        _parity(q, kp, vp, bt, sl, qo)
+
+    def test_inactive_lane_on_garbage_block0(self):
+        # lane 1 is released: zeroed table row, seq_len 1, cursor 0 —
+        # every read lands in reserved block 0. Output must be finite
+        # (denominator never 0), parity must hold, and the dead lane
+        # must not perturb the live lanes' rows.
+        q, kp, vp, bt = _case(3, 1, 2, 16, 12, 4, 3)
+        bt = bt.at[1].set(0)
+        sl, qo = [9, 1, 6], [8, 0, 5]
+        out = _parity(q, kp, vp, bt, sl, qo)
+        assert bool(jnp.isfinite(out).all())
+        solo = pallas_ops.paged_attention(
+            q[::2], kp, vp, bt[::2], jnp.asarray(sl[::2], jnp.int32),
+            jnp.asarray(qo[::2], jnp.int32), kernel="interpret")
+        np.testing.assert_array_equal(np.asarray(out[::2], np.float32),
+                                      np.asarray(solo, np.float32))
+
+    @pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+    def test_verify_span_causal_mask(self, dtype):
+        # the [B, K+1] verify span: row t may read positions <= qo + t.
+        # Parity first; then perturb the pool rows holding positions
+        # BEYOND qo + 1 — span rows 0 and 1 must be bitwise unchanged
+        # (causality), while some later row must change (the probe is
+        # live, not vacuous).
+        B, T, bs, M = 2, 4, 4, 4
+        q, kp, vp, bt = _case(B, T, 2, 16, 16, bs, M, dtype=dtype)
+        cur = [5, 9]
+        sl = [c + T for c in cur]
+        _parity(q, kp, vp, bt, sl, cur)
+        base = pallas_ops.paged_attention(
+            q, kp, vp, bt, jnp.asarray(sl, jnp.int32),
+            jnp.asarray(cur, jnp.int32), kernel="interpret")
+        kp2, vp2 = kp, vp
+        for b in range(B):
+            for posn in range(cur[b] + 2, sl[b]):
+                blk = int(bt[b, posn // bs])
+                kp2 = kp2.at[blk, posn % bs].add(jnp.asarray(3.0, dtype))
+                vp2 = vp2.at[blk, posn % bs].add(jnp.asarray(3.0, dtype))
+        bumped = pallas_ops.paged_attention(
+            q, kp2, vp2, bt, jnp.asarray(sl, jnp.int32),
+            jnp.asarray(cur, jnp.int32), kernel="interpret")
+        np.testing.assert_array_equal(
+            np.asarray(base[:, :2], np.float32),
+            np.asarray(bumped[:, :2], np.float32))
+        assert not np.array_equal(np.asarray(base[:, 3], np.float32),
+                                  np.asarray(bumped[:, 3], np.float32))
+
+    def test_ragged_batch_with_shared_blocks(self):
+        # prefix-style aliasing: every lane's FIRST logical block is the
+        # same physical block (a shared system prompt), lengths ragged
+        # across the batch; parity must hold with the aliased reads
+        q, kp, vp, bt = _case(4, 1, 2, 16, 20, 4, 4)
+        bt = bt.at[:, 0].set(int(bt[0, 0]))
+        sl = [2, 6, 11, 16]
+        qo = [s - 1 for s in sl]
+        _parity(q, kp, vp, bt, sl, qo)
+
+    @pytest.mark.tpu
+    def test_compiled_kernel_parity_on_tpu(self):
+        # the COMPILED kernel (tileable shapes: Dh 128, bs 16) — the
+        # CPU suite runs the same body through the interpreter; this is
+        # the on-chip proof, banked at live TPU windows
+        q, kp, vp, bt = _case(2, 1, 4, 128, 12, 16, 3)
+        sl, qo = [17, 40], [16, 39]
+        fused = pallas_ops.paged_attention(
+            q, kp, vp, bt, jnp.asarray(sl, jnp.int32),
+            jnp.asarray(qo, jnp.int32), kernel="pallas")
+        ref = pallas_ops.paged_attention(
+            q, kp, vp, bt, jnp.asarray(sl, jnp.int32),
+            jnp.asarray(qo, jnp.int32), kernel="xla")
+        atol, rtol = pallas_ops.PAGED_PARITY_TOL["float32"]
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(ref),
+                                   atol=atol, rtol=rtol)
+
+
+class TestKernelSelection:
+    def test_auto_resolves_xla_off_chip(self):
+        kind, reason = pallas_ops.select_paged_kernel(
+            "auto", head_dim=64, block_size=16, dtype=jnp.float32)
+        assert kind == "xla" and "not tpu" in reason
+
+    def test_forced_pallas_off_chip_runs_interpreter(self):
+        c0 = dict(registry.counters("serving"))
+        kind, _ = pallas_ops.select_paged_kernel(
+            "pallas", head_dim=48, block_size=4, dtype=jnp.float32)
+        assert kind == "interpret"
+        c1 = registry.counters("serving")
+        assert c1["kernel.interpret"] == c0["kernel.interpret"] + 1
+
+    def test_env_knob_and_bad_value(self, monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_PAGED_KERNEL", "xla")
+        kind, reason = pallas_ops.select_paged_kernel(
+            None, head_dim=64, block_size=16, dtype=jnp.float32)
+        assert (kind, reason) == ("xla", "requested")
+        monkeypatch.setenv("PADDLE_TPU_PAGED_KERNEL", "mosaic")
+        with pytest.raises(ValueError, match="PADDLE_TPU_PAGED_KERNEL"):
+            pallas_ops.select_paged_kernel(
+                None, head_dim=64, block_size=16, dtype=jnp.float32)
+
+    def test_mesh_forces_xla_with_loud_fallback(self):
+        c0 = dict(registry.counters("serving"))
+        kind, reason = pallas_ops.select_paged_kernel(
+            "pallas", head_dim=64, block_size=16, dtype=jnp.float32,
+            mesh=object())
+        assert kind == "xla" and "mesh" in reason
+        c1 = registry.counters("serving")
+        assert c1["kernel.fallbacks"] == c0["kernel.fallbacks"] + 1
+        ev = [e for e in explainer.events(kind="kernel_fallback")
+              if "mesh" in (e.get("why") or "")]
+        assert ev, "mesh fallback must land a kernel_fallback event"
+
+    def test_tileability_reasons(self):
+        ok, _ = pallas_ops.paged_tileable(128, 16, jnp.bfloat16)
+        assert ok
+        ok, why = pallas_ops.paged_tileable(48, 16, jnp.float32)
+        assert not ok and "head_dim" in why
+        ok, why = pallas_ops.paged_tileable(128, 12, jnp.bfloat16)
+        assert not ok and "block_size" in why
+        ok, why = pallas_ops.paged_tileable(128, 16, jnp.int8)
+        assert not ok and "dtype" in why
+
+
+def _run_one(eng, prompt, n, step=None, **kw):
+    out = [eng.prefill(0, prompt, **kw)]
+    if step is None:
+        for _ in range(n - 1):
+            out.append(int(eng.decode_step()[0]))
+    else:
+        while len(out) < n:
+            out.extend(step()[0])
+    eng.release(0)
+    return out[:n]
+
+
+class TestEngineTokenParity:
+    """Greedy serving tokens must be IDENTICAL across kernel choices on
+    the test model (the acceptance contract); sampled tokens too — the
+    seeded Gumbel-max argmax margin dwarfs the accumulation-order
+    delta at these scales."""
+
+    @pytest.fixture(scope="class")
+    def engines(self):
+        from paddle_tpu.serving import GenerationEngine
+
+        ekw = dict(max_batch_size=2, buckets=(8, 16), rng_seed=9,
+                   block_size=4)
+        return (GenerationEngine(_build_model(71), paged_kernel="xla",
+                                 **ekw),
+                GenerationEngine(_build_model(71), paged_kernel="pallas",
+                                 **ekw))
+
+    def test_greedy_and_sampled_tokens_identical(self, engines):
+        e_xla, e_pal = engines
+        assert e_xla.paged_kernel == "xla"
+        assert e_pal.paged_kernel == "interpret"  # cpu: kernel body
+        rng = np.random.default_rng(5)
+        for i, (pl_, kw) in enumerate([
+                (6, dict(temperature=0.0)),
+                (9, dict(temperature=0.9, top_k=25)),
+                (13, dict(temperature=0.0))]):  # second bucket
+            prompt = list(rng.integers(1, VOCAB, pl_))
+            want = _run_one(e_xla, prompt, 10, seed=i, **kw)
+            got = _run_one(e_pal, prompt, 10, seed=i, **kw)
+            assert got == want
+
+    def test_prefix_hit_tokens_identical_across_kernels(self, engines):
+        # the fused read path composes with radix prefix sharing: a
+        # prefix-hit admission decodes the same tokens either way
+        e_xla, e_pal = engines
+        rng = np.random.default_rng(7)
+        shared = list(rng.integers(1, VOCAB, 8))
+        outs = []
+        for eng in (e_xla, e_pal):
+            _run_one(eng, shared + [3, 4], 6, seed=40)   # publish prefix
+            outs.append(_run_one(eng, shared + [5, 6], 6, seed=41))
+        assert outs[0] == outs[1]
+
+    def test_spec_verify_span_tokens_identical(self):
+        from paddle_tpu.serving import (DraftVerifyEngine,
+                                        GenerationEngine)
+
+        ekw = dict(max_batch_size=1, buckets=(8, 16), rng_seed=9,
+                   block_size=4)
+        plain = GenerationEngine(_build_model(73), paged_kernel="xla",
+                                 **ekw)
+        spec = DraftVerifyEngine(_build_model(73), _build_model(74),
+                                 draft_k=3, paged_kernel="pallas", **ekw)
+        assert spec.paged_kernel == "interpret"
+        rng = np.random.default_rng(3)
+        prompt = list(rng.integers(1, VOCAB, 7))
+        want = _run_one(plain, prompt, 9, seed=0)
+        got = _run_one(spec, prompt, 9, step=spec.decode_step_spec,
+                       seed=0)
+        assert got == want
+        spec.pool.audit()
+        spec.draft_pool.audit()
+
+    def test_zero_post_warmup_compiles_under_kernel_layer(self):
+        # the replay fingerprint must be stable under kernel selection:
+        # with the fused kernel active, a steady decode window adds ZERO
+        # decode compiles, zero fast-path demotions and zero rebuilds
+        # (PR 8 contract intact — kernel choice is resolved at build,
+        # so no executable churn is even possible)
+        from paddle_tpu.serving import GenerationEngine
+
+        eng = GenerationEngine(_build_model(75), max_batch_size=2,
+                               buckets=(8,), rng_seed=9, block_size=4,
+                               paged_kernel="pallas")
+        eng.prefill(0, [5, 9, 2, 7], seed=0)
+        eng.prefill(1, [8, 1, 3], seed=1)
+        for _ in range(3):
+            eng.decode_step()  # warmup: radar has seen the signature
+        c0 = dict(registry.counters("serving"))
+        f0 = dict(registry.counters("fastpath"))
+        for _ in range(2 * eng._audit_every):
+            eng.decode_step()
+        c1 = registry.counters("serving")
+        f1 = registry.counters("fastpath")
+        assert c1["decode_compiles"] == c0["decode_compiles"]
+        assert f1["decode_demotions"] == f0["decode_demotions"]
+        assert f1["decode_rebuilds"] == f0["decode_rebuilds"]
+        assert f1["decode_audit_runs"] > f0["decode_audit_runs"]
+        eng.reset()
+        eng.pool.audit()
+
+
+class TestKernelMismatchFault:
+    def test_fault_trips_parity_gate(self):
+        q, kp, vp, bt = _case(2, 1, 2, 16, 8, 4, 2, seed=3)
+        sl = jnp.asarray([5, 7], jnp.int32)
+        qo = jnp.asarray([4, 6], jnp.int32)
+        ref = pallas_ops.paged_attention(q, kp, vp, bt, sl, qo,
+                                         kernel="xla")
+        faults.configure("kernel_mismatch")
+        try:
+            bad = pallas_ops.paged_attention(q, kp, vp, bt, sl, qo,
+                                             kernel="interpret")
+        finally:
+            faults.reset()
+        atol, rtol = pallas_ops.PAGED_PARITY_TOL["float32"]
+        assert not np.allclose(np.asarray(bad), np.asarray(ref),
+                               atol=atol, rtol=rtol)
+        # disarmed: a fresh fused call is clean again
+        good = pallas_ops.paged_attention(q, kp, vp, bt, sl, qo,
+                                          kernel="interpret")
+        np.testing.assert_allclose(np.asarray(good), np.asarray(ref),
+                                   atol=atol, rtol=rtol)

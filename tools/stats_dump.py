@@ -173,6 +173,26 @@ def _print_spec(counters, gauges):
     _print_counters(sp)
 
 
+_KERNEL_PREFIXES = ("serving.kernel.", "kernel.")
+
+
+def _print_kernels(counters, gauges):
+    """Hot-path kernel selection (ISSUE 14): which implementation each
+    family resolved to — serving.kernel.{pallas,xla,interpret} for the
+    paged decode/verify family (one bump per engine build), kernel.flash.*
+    for the training flash family (one per trace) — plus the fallback
+    count; any nonzero serving.kernel.fallbacks means a Pallas-eligible
+    call dropped to the gather path (profiler.explain() names why)."""
+    kn = {k: counters.pop(k) for k in list(counters)
+          if k.startswith(_KERNEL_PREFIXES)}
+    kn.update({k: gauges.pop(k) for k in list(gauges)
+               if k.startswith(_KERNEL_PREFIXES)})
+    if not any(kn.values()):
+        return
+    print("kernels:")
+    _print_counters(kn)
+
+
 _KV_POOL_PREFIXES = ("serving.prefix_", "serving.kv_blocks")
 _KV_POOL_KEYS = frozenset(("serving.pool_exhausted",))
 
@@ -233,6 +253,10 @@ def _print_snapshot(snap):
     # pod restarts / orphan replays / routing hit rate are the
     # cross-process resilience story, read as one table
     _print_fleet(counters, gauges)
+    # kernel selection (ISSUE 14) claims serving.kernel.* / kernel.*
+    # before the serving table: which paged/flash implementation is
+    # actually running, and whether anything fell back to the slow path
+    _print_kernels(counters, gauges)
     # speculative decode (ISSUE 12) claims its serving.* keys before
     # the kv-pool/serving tables: acceptance rate and chunk counts are
     # the draft-verify subsystem's health line
