@@ -484,7 +484,111 @@ def _run_spmd_child():
         "platform": "cpu",
     }
     print(json.dumps(rec), flush=True)
-    return 0 if steady_ok else 1
+    pp_ok = _run_spmd_pp_leg(slint)
+    return 0 if (steady_ok and pp_ok) else 1
+
+
+def _run_spmd_pp_leg(slint):
+    """dp2 x mp2 x pp2 gate (ISSUE 15): a gpt2-tiny pipeline trains
+    through the one-compilation pp path (distributed.pp_spmd); the
+    steady window must replay with ZERO new compiles, ZERO
+    Python-dispatched collectives and ZERO dispatched ops (ReplayStep
+    armed), with trajectory parity vs a dense single-chip oracle
+    (identical seed/init/data — the engine oracle's shard_map needs a
+    newer jaxlib at dp/mp>1, tests/test_spmd_pp.py covers it at pp-only).
+    Emits the {"metric": "spmd-pp"} line; False fails the --spmd child."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import lazy
+    from paddle_tpu.distributed import fleet, pp_spmd, spmd
+    from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
+                                   GPTPretrainingCriterion)
+    from paddle_tpu.profiler import registry as _reg
+
+    V, T, B, M = 64, 16, 16, 2
+
+    def make_model():
+        cfg = GPTConfig.preset("gpt2-tiny", vocab_size=V, n_layer=2,
+                               seq_len=T, dropout=0.0, n_head=2,
+                               d_model=32)
+        paddle.seed(123)
+        model = GPTForPretraining(GPTModel(cfg))
+        opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=model.parameters())
+        return model, opt, GPTPretrainingCriterion()
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, V, (B, T)).astype(np.int64)
+    labels = np.roll(toks, -1, 1)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+        "sharding_degree": 1, "use_spmd": True}
+    strategy.pipeline_configs = {"accumulate_steps": M}
+    fleet.init(is_collective=True, strategy=strategy)
+    model, opt, crit = make_model()
+    model = fleet.distributed_model(model)
+    step = pp_spmd.PipelineSpmdStep(model, opt, criterion=crit,
+                                    accumulate_steps=M)
+    losses = [float(step.train_batch([toks, labels])) for _ in range(8)]
+    c0, s0 = dict(_reg.counters("spmd")), lazy.stats()
+    f0 = dict(_reg.counters("fastpath"))
+    losses += [float(step.train_batch([toks, labels])) for _ in range(4)]
+    c1, s1 = dict(_reg.counters("spmd")), lazy.stats()
+    f1 = dict(_reg.counters("fastpath"))
+    desc = spmd.describe_plans()
+    problems = slint.lint(desc)
+    donation = step.refresh_pipeline_stats()
+
+    # dense single-chip oracle: same seed/init/data, capture off
+    spmd.disable()
+    model2, opt2, crit2 = make_model()
+    tt2, lt2 = paddle.to_tensor(toks), paddle.to_tensor(labels)
+
+    def dense_step():
+        with lazy.capture_guard(False), paddle.incubate.lazy_eval():
+            loss = crit2(model2(tt2), lt2)
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+            return float(loss)
+
+    oracle = [dense_step() for _ in range(len(losses))]
+    parity = max(abs(a - b) for a, b in zip(losses, oracle))
+    window = 4
+    hits = f1["hits"] - f0["hits"]
+    misses = f1["misses"] - f0["misses"]
+    pp_ok = (
+        c1["step_compiles"] == c0["step_compiles"]
+        and c1["python_collectives"] == c0["python_collectives"]
+        and c1["python_collectives_per_step"] == 0
+        and s1["captured_steps"] - s0["captured_steps"] == window
+        and s1["nodes_built"] == s0["nodes_built"]
+        and hits == window
+        and f1["replay_ops_dispatched"] == f0["replay_ops_dispatched"]
+        and parity < 1e-4
+        and not problems)
+    rec = {
+        "metric": "spmd-pp",
+        "value": c1["python_collectives_per_step"],
+        "unit": "python collectives/step",
+        "vs_baseline": 1.0 if pp_ok else 0.0,
+        "mesh": "dp2xmp2xpp2",
+        "microbatches": M,
+        "steady_new_compiles": c1["step_compiles"] - c0["step_compiles"],
+        "captured_steps": s1["captured_steps"] - s0["captured_steps"],
+        "donated_steps": s1["donated_steps"] - s0["donated_steps"],
+        "fastpath_hit_rate": round(hits / max(hits + misses, 1), 4),
+        "fastpath_ops_dispatched":
+            f1["replay_ops_dispatched"] - f0["replay_ops_dispatched"],
+        "stage_classes_carried": donation["carried"],
+        "stage_classes_donated": donation["donated"],
+        "parity_max_abs_vs_dense": round(parity, 8),
+        "lint_warnings": problems,
+        "platform": "cpu",
+    }
+    print(json.dumps(rec), flush=True)
+    return pp_ok
 
 
 def _spmd_line():
@@ -500,7 +604,7 @@ def _spmd_line():
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__), "--spmd"],
-            env=env, timeout=180.0, capture_output=True, text=True)
+            env=env, timeout=360.0, capture_output=True, text=True)
     except subprocess.TimeoutExpired:
         _note("spmd gate: watchdog timeout")
         return

@@ -62,14 +62,33 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
             "PADDLE_TPU_SPMD", "0").lower() in ("1", "true", "on")
     mesh = hcg.spmd_mesh() if use_spmd else None
     if use_spmd and mesh is None:
+        # mesh_from_hcg already recorded the structured spmd_pp_refused
+        # event naming the reason; the warning stays for interactive
+        # visibility (sharding>1 with pp>1 is the only refused topology)
         import warnings
 
         warnings.warn(
-            "use_spmd requested but pp_degree > 1: pipeline parallelism "
-            "stays on the HybridParallelEngine path; SPMD lowering "
-            "disabled", stacklevel=2)
+            "use_spmd requested but this topology (pp_degree > 1 with "
+            "sharding_degree > 1) cannot fold onto an SPMD mesh: "
+            "pipeline parallelism stays on the HybridParallelEngine "
+            "path; SPMD lowering disabled (see the spmd_pp_refused "
+            "explainer event)", stacklevel=2)
     if mesh is not None:
         spmd.enable(mesh)
+        if hcg.get_pipe_parallel_world_size() > 1:
+            # pp>1 rides the one-compilation path (ISSUE 15): hapi
+            # Model.train_batch / distributed.pp_spmd.PipelineSpmdStep
+            # express the microbatch schedule inside the captured step
+            from ...profiler import explainer as _explain
+
+            _explain.record(
+                "spmd_pp_selected", op="fleet.init",
+                why=("pp-folded ('dp','pp','mp') SPMD mesh installed: "
+                     "pipeline trains through the one-compilation "
+                     "captured step (pp_spmd), not the engine path"),
+                dp=hcg.get_data_parallel_world_size(),
+                pp=hcg.get_pipe_parallel_world_size(),
+                mp=hcg.get_model_parallel_world_size())
     else:
         spmd.disable()
     return

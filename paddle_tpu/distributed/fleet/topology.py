@@ -21,6 +21,11 @@ from .. import collective
 
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
 
+# spmd_mesh cache sentinel: None is a VALID cached result (a refused
+# topology) and must not re-run the fold — which would re-record its
+# spmd_pp_refused explainer event on every read
+_MESH_UNSET = object()
+
 
 class CommunicateTopology:
     def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
@@ -105,7 +110,7 @@ class HybridCommunicateGroup:
             self._dp_degree, self._pp_degree, self._sharding_degree,
             self._mp_degree)
         self.mesh = Mesh(dev_array, ("dp", "pp", "sharding", "mp"))
-        self._spmd_mesh = None
+        self._spmd_mesh = _MESH_UNSET
         collective.set_global_mesh(self.mesh)
 
         self._dp_group = collective.split_group_mesh(self.mesh, "dp")
@@ -155,12 +160,15 @@ class HybridCommunicateGroup:
         return self._sharding_group
 
     def spmd_mesh(self):
-        """Folded 2-axis ('dp', 'mp') mesh for the one-compilation SPMD
-        path: 'sharding' folds into 'dp' (ZeRO param/slot specs shard
-        over the batch axis). None when pp > 1 — pipeline stays on the
-        HybridParallelEngine 1F1B path. Device order matches self.mesh
-        at pp=1, so shardings over either mesh may coexist."""
-        if self._spmd_mesh is None:
+        """Folded mesh for the one-compilation SPMD path: 2-axis
+        ('dp', 'mp') at pp=1 ('sharding' folds into 'dp' — ZeRO
+        param/slot specs shard over the batch axis), 3-axis
+        ('dp', 'pp', 'mp') at pp>1 (ISSUE 15: the pp_spmd pipeline
+        step). None only for pp>1 combined with sharding>1, which stays
+        on the HybridParallelEngine path (structured spmd_pp_refused
+        event). Device order matches self.mesh for every folded case,
+        so shardings over either mesh may coexist."""
+        if self._spmd_mesh is _MESH_UNSET:
             from .. import spmd
 
             self._spmd_mesh = spmd.mesh_from_hcg(self)

@@ -13,7 +13,17 @@ from __future__ import annotations
 
 from ... import nn
 
-__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineStageError"]
+
+
+class PipelineStageError(ValueError):
+    """Structured stage-assignment refusal: a model/topology combination
+    the pipeline paths cannot segment (non-divisible layer count, no
+    uniform trunk, indivisible batch). Raised by
+    `PipelineLayer.segment_for_pipeline` and `distributed.pp_spmd`; every
+    raise is paired with an `spmd_pp_refused` explainer event naming the
+    reason, so refusals are diagnosable from telemetry alone."""
 
 
 class LayerDesc:
@@ -128,7 +138,16 @@ class PipelineLayer(nn.Layer):
             i = j
         usable = (length // pp) * pp
         if usable < pp:
-            raise ValueError(
+            from ...profiler import explainer as _explain
+
+            _explain.record(
+                "spmd_pp_refused", op="PipelineLayer.segment_for_pipeline",
+                reason="no_uniform_trunk",
+                why=(f"no structurally-uniform run of at least pp={pp} "
+                     f"layers to shard over the pipe axis (longest run: "
+                     f"{length})"),
+                pp=pp, longest_run=length)
+            raise PipelineStageError(
                 f"PipelineLayer: found no structurally-uniform run of at "
                 f"least pp={pp} layers to shard over the pipe axis "
                 f"(longest run: {length}). The compiled SPMD 1F1B schedule "

@@ -20,10 +20,20 @@ Mesh mapping (Fleet `HybridCommunicateGroup` topology → named mesh):
     data         dp_degree       'dp'
     sharding     sharding_deg    'dp'   (folded: ZeRO param/slot specs
                                          shard over the same axis the
-                                         batch is split on)
+                                         batch is split on; pp=1 only —
+                                         'sharding' and 'data' are not
+                                         adjacent in the 4-axis device
+                                         order once 'pipe' > 1)
     model        mp_degree       'mp'
-    pipe         pp_degree       (unsupported — pp>1 keeps the
-                                  HybridParallelEngine 1F1B path)
+    pipe         pp_degree       'pp'   (ISSUE 15: pp>1 folds to a
+                                         3-axis ('dp','pp','mp') mesh;
+                                         distributed/pp_spmd.py stacks
+                                         the trunk over 'pp' and runs
+                                         the microbatch schedule inside
+                                         the captured step. pp>1 with
+                                         sharding>1 is refused — engine
+                                         path — with a structured
+                                         spmd_pp_refused event)
 
 Spec derivation (per-leaf PartitionSpec from `mp_layers` annotations,
 carried on `param.sharding_spec`):
@@ -115,13 +125,35 @@ def param_pspec(spec, mesh, shape=None):
 # ------------------------------- mesh lifecycle ------------------------------
 
 def mesh_from_hcg(hcg):
-    """Folded 2-axis ('dp', 'mp') mesh from a HybridCommunicateGroup, or
-    None when the topology needs the engine path (pp > 1)."""
-    if hcg.get_pipe_parallel_world_size() > 1:
-        return None
-    dp = (hcg.get_data_parallel_world_size()
-          * hcg.get_sharding_parallel_world_size())
+    """Folded SPMD mesh from a HybridCommunicateGroup: 2-axis
+    ('dp', 'mp') at pp=1 (ZeRO 'sharding' folds into 'dp'), 3-axis
+    ('dp', 'pp', 'mp') at pp>1 (ISSUE 15 — the pp_spmd pipeline step).
+    None when the topology still needs the engine path (pp>1 combined
+    with sharding>1: 'data' and 'sharding' are separated by 'pipe' in
+    the 4-axis device order, so the ZeRO fold cannot preserve device
+    order), with a structured spmd_pp_refused explainer event."""
+    pp = hcg.get_pipe_parallel_world_size()
+    sh = hcg.get_sharding_parallel_world_size()
+    dp = hcg.get_data_parallel_world_size()
     mp = hcg.get_model_parallel_world_size()
+    if pp > 1:
+        if sh > 1:
+            from ..profiler import explainer as _explain
+
+            _explain.record(
+                "spmd_pp_refused", op="mesh_from_hcg",
+                reason="sharding_with_pp",
+                why=(f"pp_degree={pp} with sharding_degree={sh}: the "
+                     f"ZeRO 'sharding'->'dp' fold cannot preserve the "
+                     f"(data, pipe, sharding, model) device order; this "
+                     f"topology stays on the HybridParallelEngine path"),
+                pp=pp, sharding=sh)
+            return None
+        # same flat order as hcg.mesh at sharding=1: (d, p, m) flattens
+        # identically, so shardings over either mesh may coexist
+        devs = np.array(jax.devices()[: dp * pp * mp]).reshape(dp, pp, mp)
+        return Mesh(devs, ("dp", "pp", "mp"))
+    dp *= sh
     # same flat device order as hcg.mesh at pp=1: (d, s, m) flattens to
     # (d*sh + s)*mp + m either way, so the two meshes may coexist
     devs = np.array(jax.devices()[: dp * mp]).reshape(dp, mp)
@@ -280,15 +312,35 @@ def shard_batch(data, mesh=None, batch_axis=0):
 
 # ------------------------------ introspection --------------------------------
 
+def _spec_has_axis(spec, axis):
+    """True when a describe_plans leaf spec (list of axis-name entries,
+    possibly nested lists) mentions `axis`."""
+    if not isinstance(spec, list):
+        return False
+    return any(s == axis or (isinstance(s, list) and axis in s)
+               for s in spec)
+
+
 def describe_plans():
     """JSON-able description of this thread's captured plans' in/out
     specs and donation state — the input contract of
     tools/sharding_lint.py (stdlib-only: it consumes this dict, never
     jax objects). See core/lazy.py describe_plans for the per-leaf
-    fields."""
+    fields. On a pipeline mesh (a 'pp' axis with >1 devices) each leaf
+    also reports `stage_membership`: 'sharded' when its spec splits the
+    leaf over 'pp' (each stage holds its own slice — the stacked trunk
+    and its optimizer slots) vs 'all' (replicated across stages —
+    embeddings, head, scalars)."""
     mesh = current_mesh()
     desc = {"mesh": None, "plans": _lazy.describe_plans()}
     if mesh is not None:
-        desc["mesh"] = {"axes": {n: int(s) for n, s in
-                                 zip(mesh.axis_names, mesh.devices.shape)}}
+        axes = {n: int(s) for n, s in zip(mesh.axis_names,
+                                          mesh.devices.shape)}
+        desc["mesh"] = {"axes": axes}
+        if axes.get("pp", 1) > 1:
+            for plan in desc["plans"]:
+                for lf in plan.get("leaves", ()):
+                    lf["stage_membership"] = (
+                        "sharded" if _spec_has_axis(lf.get("spec"), "pp")
+                        else "all")
     return desc

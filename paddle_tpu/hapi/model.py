@@ -122,7 +122,16 @@ class Model:
         if (self._train_step is not None
                 and getattr(self, "_train_step_mesh", None)
                 is not _spmd.current_mesh()):
+            # a live pp step holds the trained trunk in STACKED params:
+            # release it — sync back to the per-layer tensors (else the
+            # rebuilt step would re-stack stale step-0 weights) AND
+            # return the optimizer to the per-layer parameter list (else
+            # a dense/spmd rebuild silently updates nothing)
+            pp_old = getattr(self, "_pp_step", None)
+            if pp_old is not None:
+                pp_old.release()
             self._train_step = None
+            self._pp_step = None
         if self._train_step is None:
             self._train_step_mesh = _spmd.current_mesh()
             from .. import jit
@@ -155,7 +164,48 @@ class Model:
 
             inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
             self._n_inputs = len(inputs_l)
-            if _spmd.enabled():
+            _mesh = _spmd.current_mesh()
+            _axes = dict(zip(_mesh.axis_names, _mesh.devices.shape)) \
+                if _mesh is not None else {}
+            if _spmd.enabled() and int(_axes.get("pp", 1)) > 1:
+                # pp-folded mesh (ISSUE 15): the pipeline schedule lives
+                # inside the captured step — PipelineSpmdStep stacks the
+                # trunk over 'pp', swaps the stacked params into the
+                # optimizer and rides ReplayStep; save() syncs the
+                # stacks back into the per-layer tensors
+                from ..distributed import pp_spmd
+
+                if self._n_inputs != 1:
+                    raise ValueError(
+                        "the SPMD pipeline step takes exactly one input "
+                        "and one label tensor (tokens, labels); got "
+                        f"{self._n_inputs} inputs")
+                if getattr(self, "_amp_level", None):
+                    # silent-fp32 would be worse than a refusal: the pp
+                    # kernel does not apply the auto_cast plan (the
+                    # dispatch-level AMP hook is bypassed inside the
+                    # captured pipeline op)
+                    raise ValueError(
+                        "amp_configs is not supported on the SPMD "
+                        "pipeline path yet — drop amp_configs, or set "
+                        "the model dtype to 'bfloat16' directly "
+                        "(GPTConfig(dtype='bfloat16'))")
+                pp_step = pp_spmd.PipelineSpmdStep(
+                    self.network, self._optimizer, criterion=self._loss)
+                self._pp_step = pp_step
+
+                def lazy_pp_step(*args):
+                    if len(args) != 2:
+                        raise ValueError(
+                            "the SPMD pipeline step supports exactly "
+                            "(tokens, labels); got "
+                            f"{len(args)} tensors — multi-label batches "
+                            "(e.g. loss_mask) need the engine path or a "
+                            "criterion closed over the extra inputs")
+                    return pp_step.train_batch(list(args))
+
+                self._train_step = lazy_pp_step
+            elif _spmd.enabled():
                 # One-compilation SPMD path (fleet.init use_spmd): the
                 # eager step body runs under lazy capture — after K
                 # identical steps it replays ONE mesh-compiled
@@ -201,6 +251,11 @@ class Model:
 
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
+        pp_step = getattr(self, "_pp_step", None)
+        if pp_step is not None:
+            # pp training lives in stacked params; eval runs the plain
+            # network — sync (no-op unless a step ran since last sync)
+            pp_step.sync_params_to_model()
         from ..core.autograd import no_grad
 
         inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -213,6 +268,9 @@ class Model:
 
     def predict_batch(self, inputs):
         self.network.eval()
+        pp_step = getattr(self, "_pp_step", None)
+        if pp_step is not None:
+            pp_step.sync_params_to_model()
         from ..core.autograd import no_grad
 
         inputs_l = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -465,9 +523,20 @@ class Model:
     def save(self, path, training=True):
         from ..framework import save
 
+        # the pipeline step trains STACKED trunk params; write the
+        # checkpoint in the canonical per-layer layout — params synced
+        # back into the per-layer tensors AND the optimizer state
+        # serialized against the original parameter list — so a pp
+        # checkpoint restores on every path (dense, engine, pp)
+        pp_step = getattr(self, "_pp_step", None)
+        if pp_step is not None:
+            pp_step.sync_params_to_model()
         save(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
-            save(self._optimizer.state_dict(), path + ".pdopt")
+            if pp_step is not None:
+                save(pp_step.export_optimizer_state(), path + ".pdopt")
+            else:
+                save(self._optimizer.state_dict(), path + ".pdopt")
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         """Restore params (always) and optimizer state (when a
@@ -478,21 +547,47 @@ class Model:
         not inherit stale Adam moments (ISSUE 4 satellite #2)."""
         from ..framework import load
 
+        # retire a live pp step FIRST: release() returns the optimizer
+        # to the per-layer list and evicts the stacked slots; its param
+        # sync is harmless — the restore below overwrites the values —
+        # and the next train_batch re-stacks from the restored tensors
+        if getattr(self, "_pp_step", None) is not None:
+            self._pp_step.release()
+            self._train_step = None
+            self._pp_step = None
         sd = load(path + ".pdparams")
         self.network.set_state_dict(sd)
         if self._optimizer is None:
             return
+        # every optimizer mutation below must land on the REAL optimizer:
+        # a fleet.distributed_optimizer facade delegates attribute READS
+        # only, so a bare write would shadow on the wrapper
+        opt = getattr(self._optimizer, "inner_opt", self._optimizer)
+        # if a previous pp step restructured the parameter list onto
+        # stacked 'pp_stack.*' params, repoint it at the model's
+        # original per-layer list UNCONDITIONALLY (a params-only load or
+        # reset_optimizer must not leave step() iterating orphaned
+        # stacks whose .grad is never set — silent update skips)
+        if any(str(getattr(p, "name", "") or "").startswith("pp_stack.")
+               for p in opt._parameter_list):
+            opt._parameter_list = list(self.network.parameters())
+            for p in opt._parameter_list:
+                if p is not None:
+                    p._donatable = True
         if reset_optimizer:
-            self._optimizer._accumulators = {}
-            self._optimizer._opt_step = 0
+            opt._accumulators = {}
+            opt._opt_step = 0
             # a compiled TrainStep holds refs to the dropped slot
             # tensors; rebuild it on the next train_batch
             self._train_step = None
         elif os.path.exists(path + ".pdopt"):
+            # checkpoints are canonically PER-LAYER (a pp run's save()
+            # de-stacks through export_optimizer_state); the next
+            # PipelineSpmdStep re-adopts the slots into stacks.
             # materialize slots first: set_state_dict only fills slots
             # that exist, and a freshly-built optimizer has none yet
-            self._optimizer._ensure_accumulators()
-            self._optimizer.set_state_dict(load(path + ".pdopt"))
+            opt._ensure_accumulators()
+            opt.set_state_dict(load(path + ".pdopt"))
 
     def parameters(self, *args, **kwargs):
         return self.network.parameters(*args, **kwargs)

@@ -358,10 +358,50 @@ class GPTForPretraining(nn.Layer):
         super().__init__()
         self.gpt = model
 
-    def forward(self, input_ids, position_ids=None):
-        x = self.gpt(input_ids, position_ids)
+    def _lm_logits(self, x):
+        """Tied LM head over final hidden states — the ONE definition
+        shared by forward() and the pipeline head, so a head change
+        (untying, scaling) cannot diverge the two paths."""
         w = self.gpt.embeddings.word_embeddings.weight
         return ops.matmul(x, w, transpose_y=True)
+
+    def forward(self, input_ids, position_ids=None):
+        return self._lm_logits(self.gpt(input_ids, position_ids))
+
+    def pipeline_parts(self, pp):
+        """Stage slicing for the one-compilation SPMD pipeline
+        (`distributed.pp_spmd.PipelineSpmdStep`): embeddings ride stage
+        0, the uniform block trunk layer-shards over the 'pp' mesh axis,
+        and final LN + tied LM head ride the last stage. Returns
+        (embed, blocks, head) where embed/head are Tensor->Tensor
+        callables producing the stage-boundary activation / the logits.
+        Raises PipelineStageError (with a structured spmd_pp_refused
+        explainer event) when n_layer does not divide into pp equal
+        stage slices."""
+        L = len(self.gpt.blocks)
+        if pp < 1 or L % pp != 0:
+            from ..distributed.meta_parallel.pp_layers import \
+                PipelineStageError
+            from ..profiler import explainer as _explain
+
+            _explain.record(
+                "spmd_pp_refused", op="gpt.pipeline_parts",
+                reason="stage_indivisible",
+                why=(f"GPT n_layer={L} is not divisible by pp={pp}: "
+                     f"each pipeline stage must own an equal slice of "
+                     f"the block trunk"),
+                n_layers=L, pp=pp)
+            raise PipelineStageError(
+                f"GPT n_layer={L} is not divisible by pp={pp}: each "
+                f"pipeline stage must own an equal slice of the block "
+                f"trunk (choose n_layer a multiple of pp_degree)")
+
+        def head(x):
+            # the trunk output is pre-ln_f (GPTModel applies ln_f after
+            # the blocks); the stage head finishes norm + tied logits
+            return self._lm_logits(self.gpt.ln_f(x))
+
+        return self.gpt.embeddings, list(self.gpt.blocks), head
 
 
 class GPTPretrainingCriterion(nn.Layer):

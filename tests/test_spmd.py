@@ -147,12 +147,22 @@ class TestSpecDerivation:
         assert spmd.param_pspec(("sharding", None),
                                 hcg.mesh) == P("sharding", None)
 
-    def test_pp_topology_refuses_spmd_mesh(self):
+    def test_pp_topology_selects_spmd_mesh(self):
+        # ISSUE 15: pp>1 is a first-class SPMD citizen — the folded mesh
+        # gains a 'pp' axis (tests/test_spmd_pp.py drives the pipeline
+        # step itself); only pp>1 with sharding>1 still refuses, with a
+        # structured spmd_pp_refused event
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = {
             "dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
             "sharding_degree": 1, "use_spmd": True}
-        with pytest.warns(UserWarning, match="pp_degree"):
+        fleet.init(is_collective=True, strategy=strategy)
+        mesh = fleet.get_hybrid_communicate_group().spmd_mesh()
+        assert mesh is not None and mesh.axis_names == ("dp", "pp", "mp")
+        assert spmd.enabled()
+        strategy.hybrid_configs["sharding_degree"] = 2
+        strategy.hybrid_configs["dp_degree"] = 1
+        with pytest.warns(UserWarning, match="sharding_degree"):
             fleet.init(is_collective=True, strategy=strategy)
         assert fleet.get_hybrid_communicate_group().spmd_mesh() is None
         assert not spmd.enabled()

@@ -1,9 +1,14 @@
 #!/bin/bash
-# Priority-ordered use of a live TPU window (round 5, VERDICT items 1-3).
+# Priority-ordered use of a live TPU window (round 5, VERDICT items 1-3;
+# re-bank checklist re-anchored by ISSUE 15 — every round since r03 ran
+# CPU-only, so PRs 6-14 have no on-chip numbers yet).
 # Run the moment a probe succeeds; each stage is independently useful and
 # the order banks the highest-value artifact first:
-#   1. bench.py            — fresh driver-format lines; money rung first,
-#                            margin repeats + flash-block sweep, large tail
+#   1. bench.py            — fresh driver-format lines; money rung first
+#                            (gpt2-medium train/MFU), then the --spmd gate
+#                            subprocess (now TWO lines: "spmd" dp×mp and
+#                            "spmd-pp" dp×mp×pp one-executable pipeline),
+#                            --serve, margin repeats + flash-block sweep
 #   2. tpu_validate.py     — Pallas flash A/B, int8 numerics + timed
 #                            contraction, lazy round trips, hybrid step
 #   3. bench.py (2nd pass) — more variance-lottery draws; every real line

@@ -13,7 +13,14 @@ Checks:
     bandwidth left on the table;
   * missing donation: a confirmed loop-carried optimizer slot the
     donating executable does not consume — the step allocates a fresh
-    buffer for an in-place update.
+    buffer for an in-place update. Stage-sharded ('pp' in the spec)
+    leaves get the pipeline-specific wording: an undonated stage param
+    costs a fresh copy of every stage's layer slice per microbatch
+    round;
+  * pipeline coverage (ISSUE 15): a captured pp_pipeline step on a mesh
+    whose 'pp' axis has > 1 devices must carry at least one
+    stage-sharded leaf — none means the trunk stacking silently
+    replicated every stage's params (pp memory scaling lost).
 
 Pure stdlib on purpose — no paddle_tpu / jax import, so it lints a
 dumped JSON anywhere (CI box, laptop). bench.py --spmd calls `lint()`
@@ -56,17 +63,26 @@ def _shardable(leaf, axes):
     return False
 
 
+def _spec_has_axis(spec, axis):
+    return isinstance(spec, list) and any(
+        s == axis or (isinstance(s, list) and axis in s) for s in spec)
+
+
 def lint_plan(plan, axes, min_bytes=MIN_SHARDABLE_BYTES):
     """Problem strings for one plan description (empty list = clean)."""
     problems = []
     if not plan.get("spmd"):
         return problems  # not lowered: nothing to check specs against
+    is_pipeline = str(plan.get("first_op", "")).startswith("pp_pipeline")
+    saw_stage_sharded = False
     for leaf in plan.get("leaves", ()):
         tag = (f"leaf class {leaf.get('class')} "
                f"{leaf.get('shape')}/{leaf.get('dtype')}")
         spec = leaf.get("spec")
         if spec == "opaque":
             continue  # GSPMD-inferred layout: can't judge from the spec
+        stage_sharded = _spec_has_axis(spec, "pp")
+        saw_stage_sharded |= stage_sharded
         if leaf.get("slot_flagged") and axes and _is_replicated(spec) \
                 and leaf.get("bytes", 0) >= min_bytes \
                 and _shardable(leaf, axes):
@@ -76,11 +92,25 @@ def lint_plan(plan, axes, min_bytes=MIN_SHARDABLE_BYTES):
                 f"'sharding' annotation) so GSPMD shards it")
         if leaf.get("carried") and plan.get("donate_confirmed") \
                 and not leaf.get("donated"):
-            problems.append(
-                f"{tag}: loop-carried optimizer slot is not donated — "
-                f"the captured step allocates a fresh buffer every "
-                f"iteration (check for a live Tensor holding the old "
-                f"payload)")
+            if stage_sharded:
+                problems.append(
+                    f"{tag}: stage-sharded (pp) param/slot is "
+                    f"loop-carried but not donated — every step "
+                    f"allocates a fresh copy of each stage's layer "
+                    f"slice (check for a live Tensor holding the old "
+                    f"stacked payload)")
+            else:
+                problems.append(
+                    f"{tag}: loop-carried optimizer slot is not donated "
+                    f"— the captured step allocates a fresh buffer "
+                    f"every iteration (check for a live Tensor holding "
+                    f"the old payload)")
+    if is_pipeline and axes.get("pp", 0) > 1 and not saw_stage_sharded:
+        problems.append(
+            "pipeline step has no stage-sharded leaf: the stacked trunk "
+            "replicated over 'pp' instead of layer-sharding — per-stage "
+            "param memory does not shrink with pp (check the stacked "
+            "params' ('pp', ...) sharding_spec and dim-0 divisibility)")
     return problems
 
 

@@ -173,6 +173,30 @@ def _print_spec(counters, gauges):
     _print_counters(sp)
 
 
+_PP_PREFIXES = ("pp.",)
+
+
+def _print_pipeline(counters, gauges):
+    """Pipeline-in-one-executable health (ISSUE 15): stages x
+    layers-per-stage topology, microbatch count, the static
+    stage-transfer (collective-permute) traffic estimate, and per-stage
+    donation — stage_classes_donated < stage_classes_carried means some
+    stacked stage param re-allocates every step."""
+    pl = {k: counters.pop(k) for k in list(counters)
+          if k.startswith(_PP_PREFIXES)}
+    pl.update({k: gauges.pop(k) for k in list(gauges)
+               if k.startswith(_PP_PREFIXES)})
+    if not any(pl.values()):
+        return
+    print("pipeline (spmd pp):")
+    carried = pl.get("pp.stage_classes_carried", 0)
+    donated = pl.get("pp.stage_classes_donated", 0)
+    if carried:
+        pl.setdefault("pp.stage_donation_rate",
+                      round(donated / carried, 4))
+    _print_counters(pl)
+
+
 _KERNEL_PREFIXES = ("serving.kernel.", "kernel.")
 
 
@@ -234,6 +258,10 @@ def _print_snapshot(snap):
     if sp_counters:
         print("sharding (spmd):")
         _print_counters(sp_counters)
+    # pipeline (ISSUE 15) right after the spmd section: the pp step IS a
+    # captured spmd plan, so its topology/donation line reads best next
+    # to step_compiles / python_collectives_per_step
+    _print_pipeline(counters, gauges)
     # train→serve loop (ISSUE 7) before the per-subsystem sections: these
     # keys are claimed here so serving/fault-tolerance below show pure
     # steady-state health and this section shows pure resilience events
