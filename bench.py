@@ -485,7 +485,8 @@ def _run_spmd_child():
     }
     print(json.dumps(rec), flush=True)
     pp_ok = _run_spmd_pp_leg(slint)
-    return 0 if (steady_ok and pp_ok) else 1
+    ppz_ok = _run_spmd_pp_zero_leg(slint)
+    return 0 if (steady_ok and pp_ok and ppz_ok) else 1
 
 
 def _run_spmd_pp_leg(slint):
@@ -591,6 +592,118 @@ def _run_spmd_pp_leg(slint):
     return pp_ok
 
 
+def _run_spmd_pp_zero_leg(slint):
+    """pp=2 x sharding=2 (x mp=2) gate (ISSUE 16): the topology PR 14
+    refused now FOLDS onto the 3-axis mesh ('sharding' collapses into
+    'dp' with a device-order-preserving transpose) and a ZeRO-annotated
+    (group_sharded_parallel 'p_g_os') gpt2-tiny pipeline trains through
+    the SAME one-compilation path: zero new compiles, zero Python
+    collectives, zero dispatched ops in the steady window, dense-oracle
+    loss parity. Emits the {"metric": "spmd-pp-zero"} line; False fails
+    the --spmd child."""
+    import paddle_tpu as paddle
+    from paddle_tpu.core import lazy
+    from paddle_tpu.distributed import fleet, pp_spmd, spmd
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+    from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
+                                   GPTPretrainingCriterion)
+    from paddle_tpu.profiler import registry as _reg
+
+    V, T, B, M = 64, 16, 16, 2
+
+    def make_model():
+        cfg = GPTConfig.preset("gpt2-tiny", vocab_size=V, n_layer=2,
+                               seq_len=T, dropout=0.0, n_head=2,
+                               d_model=32)
+        paddle.seed(123)
+        model = GPTForPretraining(GPTModel(cfg))
+        opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=model.parameters())
+        return model, opt, GPTPretrainingCriterion()
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, V, (B, T)).astype(np.int64)
+    labels = np.roll(toks, -1, 1)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {
+        "dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+        "sharding_degree": 2, "use_spmd": True}
+    strategy.pipeline_configs = {"accumulate_steps": M}
+    fleet.init(is_collective=True, strategy=strategy)
+    model, opt, crit = make_model()
+    model, opt, _ = group_sharded_parallel(model, opt, "p_g_os")
+    model = fleet.distributed_model(model)
+    step = pp_spmd.PipelineSpmdStep(model, opt, criterion=crit,
+                                    accumulate_steps=M)
+    losses = [float(step.train_batch([toks, labels])) for _ in range(8)]
+    c0, s0 = dict(_reg.counters("spmd")), lazy.stats()
+    f0 = dict(_reg.counters("fastpath"))
+    losses += [float(step.train_batch([toks, labels])) for _ in range(4)]
+    c1, s1 = dict(_reg.counters("spmd")), lazy.stats()
+    f1 = dict(_reg.counters("fastpath"))
+    desc = spmd.describe_plans()
+    problems = slint.lint(desc)
+
+    # ZeRO really folded: some plan leaf is sharded over the folded
+    # 'dp' axis (degree 2 = the sharding group — dp_degree is 1 here)
+    plan = next((p for p in desc["plans"]
+                 if p.get("first_op") == "pp_pipeline_step"), None)
+    zero_folded = plan is not None and any(
+        "'dp'" in str(lf.get("spec")) for lf in plan["leaves"])
+
+    # dense single-chip oracle: same seed/init/data, capture off
+    spmd.disable()
+    model2, opt2, crit2 = make_model()
+    tt2, lt2 = paddle.to_tensor(toks), paddle.to_tensor(labels)
+
+    def dense_step():
+        with lazy.capture_guard(False), paddle.incubate.lazy_eval():
+            loss = crit2(model2(tt2), lt2)
+            loss.backward()
+            opt2.step()
+            opt2.clear_grad()
+            return float(loss)
+
+    oracle = [dense_step() for _ in range(len(losses))]
+    parity = max(abs(a - b) for a, b in zip(losses, oracle))
+    window = 4
+    hits = f1["hits"] - f0["hits"]
+    misses = f1["misses"] - f0["misses"]
+    ppz_ok = (
+        c1["step_compiles"] == c0["step_compiles"]
+        and c1["python_collectives"] == c0["python_collectives"]
+        and c1["python_collectives_per_step"] == 0
+        and s1["captured_steps"] - s0["captured_steps"] == window
+        and s1["nodes_built"] == s0["nodes_built"]
+        and hits == window
+        and f1["replay_ops_dispatched"] == f0["replay_ops_dispatched"]
+        and zero_folded
+        and parity < 1e-4
+        and not problems)
+    rec = {
+        "metric": "spmd-pp-zero",
+        "value": c1["python_collectives_per_step"],
+        "unit": "python collectives/step",
+        "vs_baseline": 1.0 if ppz_ok else 0.0,
+        "mesh": "dp1xsh2xpp2xmp2 -> (dp2,pp2,mp2)",
+        "zero_level": "p_g_os",
+        "zero_folded_to_dp": zero_folded,
+        "microbatches": M,
+        "steady_new_compiles": c1["step_compiles"] - c0["step_compiles"],
+        "captured_steps": s1["captured_steps"] - s0["captured_steps"],
+        "donated_steps": s1["donated_steps"] - s0["donated_steps"],
+        "fastpath_hit_rate": round(hits / max(hits + misses, 1), 4),
+        "fastpath_ops_dispatched":
+            f1["replay_ops_dispatched"] - f0["replay_ops_dispatched"],
+        "parity_max_abs_vs_dense": round(parity, 8),
+        "lint_warnings": problems,
+        "platform": "cpu",
+    }
+    print(json.dumps(rec), flush=True)
+    return ppz_ok
+
+
 def _spmd_line():
     """Run the --spmd gate in its own subprocess (it needs a virtual
     8-device CPU mesh, which must be forced before jax backend init) and
@@ -656,6 +769,15 @@ def _run_serve_child():
     {"metric": "serving-kernel"} line with selection, parity, tokens/s
     and p50 step-time fields.
 
+    Sixth phase (ISSUE 16) — MESH-SHARDED KERNEL: the fused kernel
+    under an mp=2 serving mesh (head-sharded weights + KV pools, the
+    kernel called per-shard through shard_map) must decode token-
+    bitwise vs the single-chip fused engine with zero post-warmup
+    compiles/demotions/fallbacks, and an mp-sharded DraftVerifyEngine
+    must stay bitwise too; the live describe_sharding() is linted for
+    replicated-but-shardable pools. Emits {"metric":
+    "serving-kernel-mp"}; the gate folds into the phase envelope.
+
     Convention matches --ratio: the telemetry line prints first, the
     {"metric": "serving"} result line stays last."""
     # CPU by DEFAULT (this is the calibrated microbench config), but an
@@ -663,6 +785,14 @@ def _run_serve_child():
     # banks the kernel phase's real on-chip pallas-vs-xla numbers
     # (ISSUE 14) instead of interpreter ones
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the mesh-kernel phase (ISSUE 16) needs >= 2 devices; force the
+    # virtual host mesh the same way --spmd does (append, don't
+    # setdefault — a user-set XLA_FLAGS must keep its own flags). On a
+    # real TPU the flag only touches the unused host platform.
+    _sflags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _sflags:
+        os.environ["XLA_FLAGS"] = (
+            _sflags + " --xla_force_host_platform_device_count=8").strip()
     import time as _t
 
     import jax
@@ -913,6 +1043,127 @@ def _run_serve_child():
     kx_toks, _, kx_tps, kx_p50 = _kernel_run("xla")
     kf_toks, fused_kind, kf_tps, kf_p50 = _kernel_run("pallas")
     kernel_parity = kx_toks == kf_toks
+
+    # ---- mesh-sharded kernel phase (ISSUE 16) ------------------------
+    # The fused kernel under an mp=2 serving mesh: weights and KV pools
+    # head-sharded, the kernel called per-shard through shard_map.
+    # Tokens must be BITWISE the single-chip fused engine's (each head's
+    # softmax lives whole on one shard), the steady window must add zero
+    # decode compiles / demotions / kernel fallbacks, and an mp-sharded
+    # DraftVerifyEngine must stay bitwise too. The live engine's
+    # describe_sharding() runs through tools/sharding_lint.py — a
+    # replicated-but-shardable KV pool is the demotion this phase exists
+    # to keep dead.
+    mesh_ok = True
+    mrec = {"metric": "serving-kernel-mp", "value": 0,
+            "unit": "post-warmup compiles", "platform": _plat}
+    if jax.device_count() < 2:
+        mrec.update(skipped="needs >= 2 devices", vs_baseline=1.0)
+    else:
+        import importlib.util as _ilu
+
+        from paddle_tpu.distributed import spmd as _spmd
+
+        mcfg = GPTConfig(vocab_size=128, n_layer=2, n_head=2,
+                         d_model=128, seq_len=64, initializer_range=0.3)
+
+        def _mesh_model(seed=3):
+            paddle.seed(seed)
+            return GPTForPretraining(GPTModel(mcfg))
+
+        mekw = dict(max_batch_size=1, buckets=(16,), rng_seed=5,
+                    block_size=16)
+        mprompt = [7, 3, 11, 42, 9, 23, 5]
+
+        def _mesh_leg(mesh, n=14):
+            eng = GenerationEngine(_mesh_model(), paged_kernel="pallas",
+                                   mesh=mesh, **mekw)
+            eng.prefill(0, mprompt, seed=2)   # warmup compile
+            for _ in range(3):
+                eng.decode_step()
+            eng.release(0)
+            mc0 = dict(_reg.counters("serving"))
+            mf0 = dict(_reg.counters("fastpath"))
+            out = [eng.prefill(0, mprompt, seed=2)]
+            times = []
+            for _ in range(n - 1):
+                t0 = _t.perf_counter()
+                out.append(int(eng.decode_step()[0]))
+                times.append(_t.perf_counter() - t0)
+            eng.release(0)
+            mc1 = dict(_reg.counters("serving"))
+            mf1 = dict(_reg.counters("fastpath"))
+            win = {
+                "decode_compiles":
+                    mc1["decode_compiles"] - mc0["decode_compiles"],
+                "kernel_fallbacks":
+                    mc1["kernel.fallbacks"] - mc0["kernel.fallbacks"],
+                "decode_demotions":
+                    mf1["decode_demotions"] - mf0["decode_demotions"],
+            }
+            return out, eng, win, round((n - 1) / max(sum(times), 1e-9), 1)
+
+        single_toks, _, _, single_tps = _mesh_leg(None)
+        smesh = _spmd.serving_mesh(2)
+        mesh_toks, mesh_eng, mwin, mesh_tps = _mesh_leg(smesh)
+        mesh_parity = mesh_toks == single_toks
+        mdesc = mesh_eng.describe_sharding()
+        _lpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "tools", "sharding_lint.py")
+        _lspec = _ilu.spec_from_file_location("sharding_lint", _lpath)
+        _slint = _ilu.module_from_spec(_lspec)
+        _lspec.loader.exec_module(_slint)
+        mesh_lint = _slint.lint_engine(mdesc, min_bytes=0)
+
+        # mp-sharded speculative decode: target AND drafter per-shard,
+        # tokens bitwise vs the single-chip plain engine
+        mplain = GenerationEngine(_mesh_model(), paged_kernel="xla",
+                                  **mekw)
+        mspec = DraftVerifyEngine(_mesh_model(), _mesh_model(seed=4),
+                                  draft_k=3, paged_kernel="pallas",
+                                  mesh=smesh, **mekw)
+
+        def _greedy(eng, spec_mode, n=12):
+            step = eng.decode_step_spec if spec_mode else eng.decode_step
+            out = [eng.prefill(0, mprompt, seed=6)]
+            while len(out) < n:
+                toks = step()
+                out.extend(int(x) for x in
+                           (toks[0] if spec_mode else [toks[0]]))
+            eng.release(0)
+            return out[:n]
+
+        spec_mesh_bitwise = (_greedy(mspec, True)
+                             == _greedy(mplain, False))
+        mstats = mspec.stats()
+        mesh_ok = (mesh_parity and spec_mesh_bitwise
+                   and mwin["decode_compiles"] == 0
+                   and mwin["kernel_fallbacks"] == 0
+                   and mwin["decode_demotions"] == 0
+                   and mesh_eng.stats()["paged_kernel_sharded"]
+                   and mstats["draft_kernel_sharded"]
+                   and not mesh_lint)
+        mrec.update({
+            "value": mwin["decode_compiles"],
+            "vs_baseline": 1.0 if mesh_ok else 0.0,
+            "mesh_axes": mesh_eng.stats()["mesh_axes"],
+            "fused_kernel": mesh_eng.paged_kernel,
+            "paged_kernel_sharded":
+                mesh_eng.stats()["paged_kernel_sharded"],
+            "draft_kernel_sharded": mstats["draft_kernel_sharded"],
+            "mesh_token_parity": mesh_parity,
+            "spec_mesh_bitwise": spec_mesh_bitwise,
+            "single_chip_tokens_per_s": single_tps,
+            "mesh_tokens_per_s": mesh_tps,
+            "post_warmup_decode_compiles": mwin["decode_compiles"],
+            "post_warmup_kernel_fallbacks": mwin["kernel_fallbacks"],
+            "post_warmup_decode_demotions": mwin["decode_demotions"],
+            "spec_mesh_refused":
+                _reg.counters("serving")["spec_mesh_refused"],
+            "lint_warnings": mesh_lint,
+        })
+    print(json.dumps(mrec), flush=True)
+
     krec = {
         "metric": "serving-kernel",
         # selection: what the MAIN serving engine above resolved to
@@ -1029,7 +1280,7 @@ def _run_serve_child():
                 and rec["decode_compiles_after_warmup"] == 0
                 and rec["spec_speedup_x"] > 1.0
                 and rec["itl_flatten_x"] > 1.5
-                and kernel_parity)
+                and kernel_parity and mesh_ok)
     return 0 if gates_ok else 1
 
 

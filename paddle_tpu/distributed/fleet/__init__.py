@@ -61,18 +61,15 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
         use_spmd = _os.environ.get(
             "PADDLE_TPU_SPMD", "0").lower() in ("1", "true", "on")
     mesh = hcg.spmd_mesh() if use_spmd else None
-    if use_spmd and mesh is None:
-        # mesh_from_hcg already recorded the structured spmd_pp_refused
-        # event naming the reason; the warning stays for interactive
-        # visibility (sharding>1 with pp>1 is the only refused topology)
+    if use_spmd and mesh is None:  # pragma: no cover — every topology
+        # folds since ISSUE 16; kept as a guard against a future
+        # mesh_from_hcg refusal regressing silently
         import warnings
 
         warnings.warn(
-            "use_spmd requested but this topology (pp_degree > 1 with "
-            "sharding_degree > 1) cannot fold onto an SPMD mesh: "
-            "pipeline parallelism stays on the HybridParallelEngine "
-            "path; SPMD lowering disabled (see the spmd_pp_refused "
-            "explainer event)", stacklevel=2)
+            "use_spmd requested but this topology could not fold onto "
+            "an SPMD mesh; SPMD lowering disabled (check the explainer "
+            "ring for the structured refusal event)", stacklevel=2)
     if mesh is not None:
         spmd.enable(mesh)
         if hcg.get_pipe_parallel_world_size() > 1:

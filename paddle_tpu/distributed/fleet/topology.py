@@ -21,9 +21,9 @@ from .. import collective
 
 __all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
 
-# spmd_mesh cache sentinel: None is a VALID cached result (a refused
-# topology) and must not re-run the fold — which would re-record its
-# spmd_pp_refused explainer event on every read
+# spmd_mesh cache sentinel: None stays a valid cached result (no
+# topology refuses since ISSUE 16, but a future refusal must not re-run
+# the fold on every read)
 _MESH_UNSET = object()
 
 
@@ -164,10 +164,11 @@ class HybridCommunicateGroup:
         ('dp', 'mp') at pp=1 ('sharding' folds into 'dp' — ZeRO
         param/slot specs shard over the batch axis), 3-axis
         ('dp', 'pp', 'mp') at pp>1 (ISSUE 15: the pp_spmd pipeline
-        step). None only for pp>1 combined with sharding>1, which stays
-        on the HybridParallelEngine path (structured spmd_pp_refused
-        event). Device order matches self.mesh for every folded case,
-        so shardings over either mesh may coexist."""
+        step; ISSUE 16 folds pp>1 with sharding>1 too, transposing the
+        device array so every device keeps its 4-axis hcg coordinate —
+        no topology refuses anymore). Device order matches self.mesh
+        for every folded case, so shardings over either mesh may
+        coexist."""
         if self._spmd_mesh is _MESH_UNSET:
             from .. import spmd
 

@@ -20,20 +20,20 @@ Mesh mapping (Fleet `HybridCommunicateGroup` topology → named mesh):
     data         dp_degree       'dp'
     sharding     sharding_deg    'dp'   (folded: ZeRO param/slot specs
                                          shard over the same axis the
-                                         batch is split on; pp=1 only —
-                                         'sharding' and 'data' are not
-                                         adjacent in the 4-axis device
-                                         order once 'pipe' > 1)
+                                         batch is split on; at pp>1 the
+                                         fold transposes the device
+                                         array so every device keeps
+                                         its 4-axis hcg coordinate —
+                                         see mesh_from_hcg)
     model        mp_degree       'mp'
     pipe         pp_degree       'pp'   (ISSUE 15: pp>1 folds to a
                                          3-axis ('dp','pp','mp') mesh;
                                          distributed/pp_spmd.py stacks
                                          the trunk over 'pp' and runs
                                          the microbatch schedule inside
-                                         the captured step. pp>1 with
-                                         sharding>1 is refused — engine
-                                         path — with a structured
-                                         spmd_pp_refused event)
+                                         the captured step. ISSUE 16:
+                                         pp>1 with sharding>1 folds
+                                         too — no topology refuses)
 
 Spec derivation (per-leaf PartitionSpec from `mp_layers` annotations,
 carried on `param.sharding_spec`):
@@ -126,32 +126,25 @@ def param_pspec(spec, mesh, shape=None):
 
 def mesh_from_hcg(hcg):
     """Folded SPMD mesh from a HybridCommunicateGroup: 2-axis
-    ('dp', 'mp') at pp=1 (ZeRO 'sharding' folds into 'dp'), 3-axis
-    ('dp', 'pp', 'mp') at pp>1 (ISSUE 15 — the pp_spmd pipeline step).
-    None when the topology still needs the engine path (pp>1 combined
-    with sharding>1: 'data' and 'sharding' are separated by 'pipe' in
-    the 4-axis device order, so the ZeRO fold cannot preserve device
-    order), with a structured spmd_pp_refused explainer event."""
+    ('dp', 'mp') at pp=1, 3-axis ('dp', 'pp', 'mp') at pp>1 (ISSUE 15 —
+    the pp_spmd pipeline step). ZeRO 'sharding' always folds into 'dp'.
+    At pp>1 the hcg device order is (data, pipe, sharding, model) —
+    'sharding' is separated from 'data' by 'pipe' — so the fold
+    TRANSPOSES the device array (ISSUE 16) instead of reshaping flat:
+    mesh coordinate (d*sh + s, p, m) holds the device at hcg linear
+    index ((d*pp + p)*sh + s)*mp + m, i.e. every device keeps its hcg
+    (data, pipe, sharding, model) coordinate and collectives over the
+    folded 'dp' axis span exactly the union of the hcg data and
+    sharding groups. At sh=1 the transpose is the identity, so the
+    pre-ISSUE-16 3-axis mesh is unchanged."""
     pp = hcg.get_pipe_parallel_world_size()
     sh = hcg.get_sharding_parallel_world_size()
     dp = hcg.get_data_parallel_world_size()
     mp = hcg.get_model_parallel_world_size()
     if pp > 1:
-        if sh > 1:
-            from ..profiler import explainer as _explain
-
-            _explain.record(
-                "spmd_pp_refused", op="mesh_from_hcg",
-                reason="sharding_with_pp",
-                why=(f"pp_degree={pp} with sharding_degree={sh}: the "
-                     f"ZeRO 'sharding'->'dp' fold cannot preserve the "
-                     f"(data, pipe, sharding, model) device order; this "
-                     f"topology stays on the HybridParallelEngine path"),
-                pp=pp, sharding=sh)
-            return None
-        # same flat order as hcg.mesh at sharding=1: (d, p, m) flattens
-        # identically, so shardings over either mesh may coexist
-        devs = np.array(jax.devices()[: dp * pp * mp]).reshape(dp, pp, mp)
+        devs = np.array(jax.devices()[: dp * pp * sh * mp]).reshape(
+            dp, pp, sh, mp)
+        devs = devs.transpose(0, 2, 1, 3).reshape(dp * sh, pp, mp)
         return Mesh(devs, ("dp", "pp", "mp"))
     dp *= sh
     # same flat device order as hcg.mesh at pp=1: (d, s, m) flattens to
@@ -160,7 +153,7 @@ def mesh_from_hcg(hcg):
     return Mesh(devs, ("dp", "mp"))
 
 
-def serving_mesh(mp=None):
+def serving_mesh(mp=None, *, model=None, n_head=None):
     """One-axis ``('mp',)`` decode mesh over the first ``mp`` local
     devices (default: all of them) — the serving engine's tensor-parallel
     topology (``GenerationEngine(..., mesh=serving_mesh(2))``). Serving
@@ -169,13 +162,26 @@ def serving_mesh(mp=None):
     model parallelism; the engine derives weight placement from the same
     ``sharding_spec`` annotations via :func:`param_pspec`. The mesh is
     NOT installed globally (no :func:`enable`): decode runs eagerly
-    inside its own jit, never through the lazy capture engine."""
+    inside its own jit, never through the lazy capture engine.
+
+    Pass the model (or its ``n_head``) to validate UP FRONT that mp
+    divides the attention head count — otherwise a bad mp surfaces deep
+    inside GSPMD lowering as an opaque shape error."""
     devs = jax.devices()
     mp = len(devs) if mp is None else int(mp)
     if mp < 1 or mp > len(devs):
         raise ValueError(
             f"serving_mesh: mp={mp} outside [1, {len(devs)}] available "
             "devices")
+    if n_head is None and model is not None:
+        gpt = getattr(model, "gpt", model)
+        heads = sorted({int(blk.attn.n_head) for blk in gpt.blocks})
+        n_head = heads[0] if heads else None
+    if n_head is not None and int(n_head) % mp:
+        raise ValueError(
+            f"serving_mesh: mp={mp} does not divide the model's "
+            f"n_head={int(n_head)} — pick an mp that divides the head "
+            "count (head-sharded decode splits whole heads per shard)")
     return Mesh(np.array(devs[:mp]), ("mp",))
 
 

@@ -131,7 +131,7 @@ class GPTAttention(nn.Layer):
         self.out_proj.weight.sharding_spec = ("mp", None)
 
     def forward(self, x, cache=None, cache_offset=None, seq_lens=None,
-                block_tables=None, paged_kernel=None):
+                block_tables=None, paged_kernel=None, paged_mesh=None):
         B, T, D = x.shape
         qkv = self.qkv_proj(x).reshape([B, T, 3, self.n_head, self.head_dim])
         q, k, v = ops.unbind(qkv, axis=2)
@@ -182,7 +182,8 @@ class GPTAttention(nn.Layer):
                 new_v = v_flat.reshape(v_pool.shape)
                 out = F.paged_attention(q, new_k, new_v, block_tables,
                                         seq_lens, cache_offset,
-                                        kernel=paged_kernel)
+                                        kernel=paged_kernel,
+                                        mesh=paged_mesh)
                 out = self.out_proj(out.reshape([B, T, D]))
                 return out, (new_k, new_v)
             slot_rows = ((block_tables * bs).unsqueeze(-1)
@@ -282,13 +283,14 @@ class GPTBlock(nn.Layer):
         return x + self.mlp(self.ln2(x))
 
     def forward(self, x, cache=None, cache_offset=None, seq_lens=None,
-                block_tables=None, paged_kernel=None):
+                block_tables=None, paged_kernel=None, paged_mesh=None):
         if cache is not None:
             a, new_cache = self.attn(self.ln1(x), cache=cache,
                                      cache_offset=cache_offset,
                                      seq_lens=seq_lens,
                                      block_tables=block_tables,
-                                     paged_kernel=paged_kernel)
+                                     paged_kernel=paged_kernel,
+                                     paged_mesh=paged_mesh)
             x = x + self.dropout(a)
             return x + self.mlp(self.ln2(x)), new_cache
         if self._recompute and self.training:
@@ -334,7 +336,7 @@ class GPTModel(nn.Layer):
 
     def forward(self, input_ids, position_ids=None, caches=None,
                 cache_offsets=None, seq_lens=None, block_tables=None,
-                paged_kernel=None):
+                paged_kernel=None, paged_mesh=None):
         if caches is not None and cache_offsets is None:
             _warn_legacy_cache()
         x = self.embeddings(input_ids, position_ids)
@@ -343,7 +345,8 @@ class GPTModel(nn.Layer):
             for blk, c in zip(self.blocks, caches):
                 x, nc = blk(x, cache=c, cache_offset=cache_offsets,
                             seq_lens=seq_lens, block_tables=block_tables,
-                            paged_kernel=paged_kernel)
+                            paged_kernel=paged_kernel,
+                            paged_mesh=paged_mesh)
                 new_caches.append(nc)
             return self.ln_f(x), new_caches
         for blk in self.blocks:

@@ -1103,20 +1103,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 
 def paged_attention(query, k_pool, v_pool, block_tables, seq_lens,
-                    q_offsets, kernel="xla", name=None):
+                    q_offsets, kernel="xla", mesh=None, name=None):
     """Fused paged-KV attention (ISSUE 14): ``query`` [B, T, H, Dh] reads
     each slot's logical KV view straight out of the shared block pool
     [num_blocks, block_size, H, Dh] through its ``block_tables`` [B, M]
     row — no gathered [B, M*bs, H, Dh] view is ever materialized on the
     Pallas routes. ``kernel`` is a STATIC choice ("pallas" | "interpret"
     | "xla"), resolved once per engine by
-    ``pallas_ops.select_paged_kernel``. Inference-only (nondiff): the
-    decode/verify hot path never backpropagates."""
+    ``pallas_ops.select_paged_kernel``; a ``mesh`` with mp>1 routes the
+    fused kinds per-shard through shard_map (ISSUE 16), head-sharded.
+    Inference-only (nondiff): the decode/verify hot path never
+    backpropagates."""
     from . import pallas_ops
 
     def f(q, kp, vp, bt, sl, qo):
         return pallas_ops.paged_attention(q, kp, vp, bt, sl, qo,
-                                          kernel=kernel)
+                                          kernel=kernel, mesh=mesh)
 
     return forward(f, (query, k_pool, v_pool, block_tables, seq_lens,
                        q_offsets), name="paged_attention", nondiff=True)

@@ -564,6 +564,42 @@ def _paged_attention_fused(q, k_pool, v_pool, block_tables, seq_lens,
       q_offsets.astype(jnp.int32), q, k_pool, v_pool)
 
 
+def _mesh_mp_degree(mesh):
+    """Size of the mesh's 'mp' axis (1 when absent or mesh is None)."""
+    if mesh is None:
+        return 1
+    return int(dict(mesh.shape).get("mp", 1))
+
+
+def _paged_attention_sharded(q, k_pool, v_pool, block_tables, seq_lens,
+                             q_offsets, scale, interpret, mesh):
+    """Per-shard fused kernel under ``jax.shard_map``: pools and q are
+    head-sharded over the mesh's 'mp' axis, block tables / seq_lens /
+    q_offsets ride in replicated, and each shard runs the UNMODIFIED
+    kernel body over its local heads. The kernel computes every head
+    independently (per-head scratch rows, no cross-head reduction), so
+    the sharded result is bitwise the single-chip result. check_vma is
+    off because pallas_call carries no replication rule."""
+    from jax.sharding import PartitionSpec as P
+
+    mp = _mesh_mp_degree(mesh)
+    H = int(q.shape[2])
+    if H % mp:  # select_paged_kernel prevents this; defensive
+        raise ValueError(
+            f"paged_attention: {H} heads do not divide over mesh axis "
+            f"mp={mp}; resolve the kernel with select_paged_kernel("
+            "num_heads=...) so indivisible head counts demote to xla")
+    head = P(None, None, "mp", None)
+    repl = P()
+    body = functools.partial(_paged_attention_fused, scale=scale,
+                             interpret=interpret)
+    return jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(head, head, head, repl, repl, repl),
+        out_specs=head, check_vma=False,
+    )(q, k_pool, v_pool, block_tables, seq_lens, q_offsets)
+
+
 def paged_attention_xla(q, k_pool, v_pool, block_tables, seq_lens,
                         q_offsets, scale=None):
     """The gather-path reference: materialize each slot's logical
@@ -594,16 +630,19 @@ def paged_attention_xla(q, k_pool, v_pool, block_tables, seq_lens,
 
 
 def paged_attention(q, k_pool, v_pool, block_tables, seq_lens, q_offsets,
-                    kernel="xla", scale=None):
+                    kernel="xla", scale=None, mesh=None):
     """Paged-KV attention: ``q`` [B, T, H, Dh] over pools
     [num_blocks, block_size, H, Dh] addressed by ``block_tables`` [B, M].
     ``seq_lens`` [B] counts each slot's valid rows INCLUDING the span's
     own freshly-scattered rows; ``q_offsets`` [B] is the absolute
     position of span row 0. ``kernel``: "pallas" (compiled TPU),
     "interpret" (the same kernel body through the Pallas interpreter —
-    the CPU-CI parity route) or "xla" (gather reference). Resolve the
-    choice ONCE per engine with :func:`select_paged_kernel` — it must
-    never vary per step or the serving replay fast path retraces."""
+    the CPU-CI parity route) or "xla" (gather reference). A ``mesh``
+    with an 'mp' axis of > 1 devices routes the fused kinds per-shard
+    through :func:`jax.shard_map` with head-sharded q/pools — the
+    kernel body is unchanged, each shard just sees H/mp heads. Resolve
+    the choice ONCE per engine with :func:`select_paged_kernel` — it
+    must never vary per step or the serving replay fast path retraces."""
     scale = float(scale) if scale is not None else q.shape[-1] ** -0.5
     if kernel == "xla":
         return paged_attention_xla(q, k_pool, v_pool, block_tables,
@@ -612,9 +651,15 @@ def paged_attention(q, k_pool, v_pool, block_tables, seq_lens, q_offsets,
         raise ValueError(
             f"unknown paged-attention kernel {kernel!r} "
             "(expected pallas | interpret | xla)")
-    out = _paged_attention_fused(q, k_pool, v_pool, block_tables,
-                                 seq_lens, q_offsets, scale,
-                                 interpret=(kernel == "interpret"))
+    if _mesh_mp_degree(mesh) > 1:
+        out = _paged_attention_sharded(q, k_pool, v_pool, block_tables,
+                                       seq_lens, q_offsets, scale,
+                                       interpret=(kernel == "interpret"),
+                                       mesh=mesh)
+    else:
+        out = _paged_attention_fused(q, k_pool, v_pool, block_tables,
+                                     seq_lens, q_offsets, scale,
+                                     interpret=(kernel == "interpret"))
     # kernel_mismatch fault (testing/faults.py): perturb ONE element of
     # the fused output so parity gates provably trip. Trace-time firing:
     # the perturbation is baked into whichever executable traces while
@@ -644,7 +689,8 @@ def paged_tileable(head_dim, block_size, dtype):
 
 
 def select_paged_kernel(requested=None, *, head_dim, block_size, dtype,
-                        mesh=None, family="paged_attention"):
+                        mesh=None, num_heads=None,
+                        family="paged_attention"):
     """Resolve the paged-attention kernel for one engine build.
 
     ``requested``: "pallas" | "xla" | "auto" | None (None reads env
@@ -658,10 +704,16 @@ def select_paged_kernel(requested=None, *, head_dim, block_size, dtype,
                   fall back to "xla" loudly;
       * xla    -> "xla", always.
 
-    Mesh-sharded engines always take the XLA path (the kernel is not
-    GSPMD-partitionable yet); the fallback event names it. Returns
-    ``(kind, reason)`` and bumps ``serving.kernel.<kind>`` — call once
-    at engine build, never per step."""
+    A ``mesh`` whose 'mp' axis has > 1 devices resolves PER SHARD: the
+    kernel is head-parallel, so when ``num_heads`` divides mp each
+    shard runs the unmodified body over its local num_heads/mp heads
+    (tileability depends only on head_dim/block_size/dtype, which head
+    sharding does not change). Indivisible or unknown head counts
+    demote to the GSPMD gather path with a loud fallback naming both
+    numbers. Returns ``(kind, reason)`` and bumps
+    ``serving.kernel.<kind>`` — call once at engine build, never per
+    step; the resolved kind is a static closure constant, so each
+    (bucket, kernel, mesh) pair keeps exactly one executable."""
     env = os.environ.get("PADDLE_TPU_PAGED_KERNEL", "")
     req = (requested or env or "auto").strip().lower()
     if req not in ("pallas", "xla", "auto"):
@@ -673,17 +725,26 @@ def select_paged_kernel(requested=None, *, head_dim, block_size, dtype,
             "pallas and off-chip engines run the interpreter)")
     on_tpu = _on_tpu()
     ok, why = paged_tileable(head_dim, block_size, dtype)
+    mp = _mesh_mp_degree(mesh)
     if req == "xla":
         kind, reason = "xla", "requested"
     elif pltpu is None:  # pragma: no cover — jaxlib without pallas-tpu
         kind, reason = "xla", "jax.experimental.pallas.tpu unavailable"
         if req == "pallas":
             _note_kernel_fallback(family, reason)
-    elif mesh is not None:
-        kind, reason = "xla", ("mesh-sharded decode is GSPMD-partitioned; "
-                               "the paged kernel is single-chip only")
+    elif mp > 1 and (num_heads is None or num_heads % mp):
+        if num_heads is None:
+            reason = (f"mesh-sharded decode (mp={mp}) needs num_heads "
+                      "to plan the per-shard kernel; demoting to the "
+                      "GSPMD gather path")
+        else:
+            reason = (f"model has {num_heads} heads, not divisible by "
+                      f"mesh axis mp={mp}: no per-shard kernel; "
+                      "demoting to the GSPMD gather path")
+        kind = "xla"
         if req == "pallas" or on_tpu:
-            _note_kernel_fallback(family, reason)
+            _note_kernel_fallback(family, reason, num_heads=num_heads,
+                                  mp=mp)
     elif req == "pallas":
         if on_tpu and not ok:
             kind, reason = "xla", why
@@ -706,6 +767,9 @@ def select_paged_kernel(requested=None, *, head_dim, block_size, dtype,
                                   block_size=block_size)
         else:
             kind, reason = "xla", "auto: platform is not tpu"
+    if mp > 1 and kind in ("pallas", "interpret"):
+        reason += (f"; per-shard over mesh mp={mp} "
+                   f"(local heads {num_heads // mp})")
     _paged_counters[f"kernel.{kind}"] += 1
     return kind, reason
 

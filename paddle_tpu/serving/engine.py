@@ -242,7 +242,26 @@ class GenerationEngine:
         self._paged_kernel, self._paged_kernel_reason = \
             _pallas_ops.select_paged_kernel(
                 paged_kernel, head_dim=gpt.blocks[0].attn.head_dim,
-                block_size=self.block_size, dtype=self._dtype, mesh=mesh)
+                block_size=self.block_size, dtype=self._dtype, mesh=mesh,
+                num_heads=gpt.blocks[0].attn.n_head)
+        # per-shard fused route (ISSUE 16): when the fused kernel
+        # survived mesh resolution, decode calls it through shard_map
+        # with head-sharded q/pools — a static closure constant like the
+        # kernel kind itself, so the (bucket, kernel, mesh) executable
+        # set stays exactly one deep. xla (or indivisible heads, which
+        # select demotes to xla) leaves this None and GSPMD partitions
+        # the gather path as before.
+        self._paged_mesh = mesh if (
+            mesh is not None
+            and self._paged_kernel in ("pallas", "interpret")) else None
+        if mesh is not None:
+            # telemetry for the stats_dump "mesh serving" section
+            _registry.gauge_set("serving.mesh.mp",
+                                _pallas_ops._mesh_mp_degree(mesh))
+            _registry.gauge_set("serving.mesh.paged_kernel",
+                                self._paged_kernel)
+            _registry.gauge_set("serving.mesh.paged_kernel_sharded",
+                                int(self._paged_mesh is not None))
 
         Nb, bs = self.pool.num_blocks, self.block_size
         self._kv_shapes = [(Nb, bs, blk.attn.n_head, blk.attn.head_dim)
@@ -427,7 +446,11 @@ class GenerationEngine:
         jit.StaticFunction state-swap idiom). Trace-time only — the jitted
         executables never re-enter Python. ``kernel`` selects the paged-
         attention read path (None = XLA gather): a static string, fixed
-        per compiled step."""
+        per compiled step. The fused kinds additionally close over the
+        engine's per-shard mesh (ISSUE 16) so a mesh engine runs the
+        kernel body per head-shard through shard_map."""
+        paged_mesh = self._paged_mesh \
+            if kernel in ("pallas", "interpret") else None
         old = {n: self._state[n]._data for n in self._names}
         for n, arr in zip(self._names, state_arrays):
             self._state[n]._data = arr
@@ -439,7 +462,7 @@ class GenerationEngine:
                     caches=caches, cache_offsets=Tensor(offsets),
                     seq_lens=Tensor(seq_lens),
                     block_tables=Tensor(block_tables),
-                    paged_kernel=kernel)
+                    paged_kernel=kernel, paged_mesh=paged_mesh)
             return (hidden._data,
                     tuple(c[0]._data for c in new_caches),
                     tuple(c[1]._data for c in new_caches))
@@ -507,35 +530,86 @@ class GenerationEngine:
                 gen_idx + adv.astype(gen_idx.dtype))
 
     # ------------------------------------------------------- weight swap --
-    def _resolve_swap_state(self, state):
+    def _resolve_swap_state(self, state, names=None):
         """Map an incoming state nest onto this engine's bound weight
-        names. Accepts the decoder's own state_dict, a wrapper model's
-        (uniform name prefix, e.g. ``gpt.``), or a full checkpoint nest
+        names (or an explicit ``names`` list — the spec-decode drafter
+        reuses the resolver against its own name set). Accepts the
+        decoder's own state_dict, a wrapper model's (uniform name
+        prefix, e.g. ``gpt.``), or a full checkpoint nest
         (``{"model": ..., "optimizer": ...}`` from
         capture_training_state — the optimizer part is ignored)."""
+        names = self._names if names is None else names
         if not isinstance(state, dict):
             raise WeightSwapError(
                 f"swap state must be a dict of name -> array, got "
                 f"{type(state).__name__}")
         if "model" in state and isinstance(state["model"], dict) \
-                and "model" not in self._names:
+                and "model" not in names:
             state = state["model"]
-        if all(n in state for n in self._names):
-            return {n: state[n] for n in self._names}
+        if all(n in state for n in names):
+            return {n: state[n] for n in names}
         # wrapper prefix: every engine name appears under one common
         # prefix (GPTForPretraining saves "gpt.<name>" while the engine
         # binds the inner GPTModel's names)
-        probe = self._names[0]
+        probe = names[0]
         for key in state:
             if key.endswith(probe) and key != probe:
                 pre = key[:-len(probe)]
-                if all(pre + n in state for n in self._names):
-                    return {n: state[pre + n] for n in self._names}
-        missing = [n for n in self._names if n not in state]
+                if all(pre + n in state for n in names):
+                    return {n: state[pre + n] for n in names}
+        missing = [n for n in names if n not in state]
         raise WeightSwapError(
-            f"swap state is missing {len(missing)}/{len(self._names)} "
+            f"swap state is missing {len(missing)}/{len(names)} "
             f"weights (first: {missing[:3]}); a partial swap would serve "
             "inconsistent weights, refusing")
+
+    def _stage_swap(self, resolved, names, bound):
+        """Validate and stage a resolved swap map against the ``bound``
+        Tensor dict (the engine's target state, or the spec-decode
+        drafter's): aval/sharding checks happen for EVERY array before
+        the first assignment, so staging either returns a complete array
+        list or raises with nothing mutated."""
+        staged = []
+        for n in names:
+            cur = bound[n]._data
+            v = resolved[n]
+            if isinstance(v, Tensor):
+                v = v._data
+            if isinstance(v, jax.Array):
+                if v.shape != cur.shape:
+                    raise WeightSwapError(
+                        f"aval mismatch for {n!r}: engine holds "
+                        f"{tuple(cur.shape)}, swap offers "
+                        f"{tuple(v.shape)} — this is a different model")
+                try:
+                    v_placed = len(v.devices()) > 1
+                    mesh_mismatch = v_placed and v.sharding != cur.sharding
+                except Exception:
+                    v_placed, mesh_mismatch = True, False
+                if mesh_mismatch:
+                    raise WeightSwapError(
+                        f"sharding mismatch for {n!r}: engine weight is "
+                        f"placed as {cur.sharding}, swap offers "
+                        f"{v.sharding} — re-place the arrays on the "
+                        "serving mesh before swapping")
+                arr = v if v.dtype == cur.dtype else v.astype(cur.dtype)
+                if self._mesh is not None and not v_placed:
+                    # single-device/host array onto a mesh engine: place
+                    # it like the numpy path does — a checkpoint load
+                    # should not have to know the serving layout
+                    arr = jax.device_put(arr, cur.sharding)
+            else:
+                a = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
+                if tuple(a.shape) != tuple(cur.shape):
+                    raise WeightSwapError(
+                        f"aval mismatch for {n!r}: engine holds "
+                        f"{tuple(cur.shape)}, swap offers "
+                        f"{tuple(a.shape)} — this is a different model")
+                arr = jnp.asarray(a, cur.dtype)
+                if self._mesh is not None:
+                    arr = jax.device_put(arr, cur.sharding)
+            staged.append(arr)
+        return staged
 
     def swap_weights(self, state, source=None):
         """Atomically replace every bound weight. Must be called between
@@ -556,42 +630,7 @@ class GenerationEngine:
         under new) — the weight-generation bump makes every cached prefix
         unmatchable, so post-swap requests recompute their prefixes."""
         resolved = self._resolve_swap_state(state)
-        staged = []
-        for n in self._names:
-            cur = self._state[n]._data
-            v = resolved[n]
-            if isinstance(v, Tensor):
-                v = v._data
-            if isinstance(v, jax.Array):
-                if v.shape != cur.shape:
-                    raise WeightSwapError(
-                        f"aval mismatch for {n!r}: engine holds "
-                        f"{tuple(cur.shape)}, swap offers "
-                        f"{tuple(v.shape)} — this is a different model")
-                try:
-                    placed = (len(v.devices()) > 1 or
-                              len(cur.devices()) > 1)
-                    mesh_mismatch = placed and v.sharding != cur.sharding
-                except Exception:
-                    mesh_mismatch = False
-                if mesh_mismatch:
-                    raise WeightSwapError(
-                        f"sharding mismatch for {n!r}: engine weight is "
-                        f"placed as {cur.sharding}, swap offers "
-                        f"{v.sharding} — re-place the arrays on the "
-                        "serving mesh before swapping")
-                arr = v if v.dtype == cur.dtype else v.astype(cur.dtype)
-            else:
-                a = np.asarray(v.numpy() if hasattr(v, "numpy") else v)
-                if tuple(a.shape) != tuple(cur.shape):
-                    raise WeightSwapError(
-                        f"aval mismatch for {n!r}: engine holds "
-                        f"{tuple(cur.shape)}, swap offers "
-                        f"{tuple(a.shape)} — this is a different model")
-                arr = jnp.asarray(a, cur.dtype)
-                if self._mesh is not None:
-                    arr = jax.device_put(arr, cur.sharding)
-            staged.append(arr)
+        staged = self._stage_swap(resolved, self._names, self._state)
         if _faults.ACTIVE:
             _faults.fire("kill_during_swap")
         for n, arr in zip(self._names, staged):
@@ -1134,13 +1173,47 @@ class GenerationEngine:
         return hits / total if total else 0.0
 
     def stats(self):
-        return {**_registry.counters("serving"),
+        out = {**_registry.counters("serving"),
+               "paged_kernel": self._paged_kernel,
+               "paged_kernel_reason": self._paged_kernel_reason,
+               "mean_occupancy": self.mean_occupancy(),
+               "prefix_hit_rate": self.prefix_hit_rate(),
+               "kv_blocks_total": self.pool.usable_blocks,
+               "kv_blocks_in_use": self.pool.in_use(),
+               "kv_blocks_free": self.pool.free_count(),
+               "prefix_cache_nodes": len(self.prefix_cache),
+               "weight_generation": self.prefix_cache.generation}
+        if self._mesh is not None:
+            out["mesh_axes"] = dict(zip(
+                self._mesh.axis_names,
+                (int(s) for s in self._mesh.devices.shape)))
+            out["paged_kernel_sharded"] = self._paged_mesh is not None
+        return out
+
+    def describe_sharding(self):
+        """JSON-able placement description of the engine's hot buffers —
+        consumed by tools/sharding_lint.py ``lint_engine`` (the serving
+        analogue of spmd.describe_plans): mesh axes, the resolved paged
+        kernel, and one record per per-layer KV pool with its partition
+        spec, so the lint can flag a mesh engine whose pools stayed
+        replicated (the exact demotion ISSUE 16 removes)."""
+        from ..core.lazy import _spec_repr
+
+        mesh = None
+        if self._mesh is not None:
+            mesh = {"axes": dict(zip(
+                self._mesh.axis_names,
+                (int(s) for s in self._mesh.devices.shape)))}
+        pools = []
+        for i, (k, v) in enumerate(zip(self._k, self._v)):
+            for name, a in (("k", k), ("v", v)):
+                pools.append({
+                    "layer": i, "pool": name,
+                    "shape": [int(d) for d in a.shape],
+                    "dtype": str(a.dtype), "bytes": int(a.nbytes),
+                    "spec": (_spec_repr(a.sharding)
+                             if self._mesh is not None else None)})
+        return {"mesh": mesh,
                 "paged_kernel": self._paged_kernel,
-                "paged_kernel_reason": self._paged_kernel_reason,
-                "mean_occupancy": self.mean_occupancy(),
-                "prefix_hit_rate": self.prefix_hit_rate(),
-                "kv_blocks_total": self.pool.usable_blocks,
-                "kv_blocks_in_use": self.pool.in_use(),
-                "kv_blocks_free": self.pool.free_count(),
-                "prefix_cache_nodes": len(self.prefix_cache),
-                "weight_generation": self.prefix_cache.generation}
+                "paged_kernel_sharded": self._paged_mesh is not None,
+                "kv_pools": pools}

@@ -240,23 +240,29 @@ class ContinuousBatchScheduler:
         return out
 
     # ----------------------------------------------------- weight swaps --
-    def request_swap(self, state, source=None):
+    def request_swap(self, state, source=None, draft_state=None):
         """Stage a weight swap; thread-safe, O(1). The swap is applied by
         the driving thread at the NEXT step boundary — between decode
         steps, so no request ever observes a half-swapped model. Staging
         twice before a step replaces the earlier stage (newest weights
-        win)."""
+        win). ``draft_state`` (spec-decode engines only, ISSUE 16) swaps
+        the drafter in the same commit so acceptance recovers instead of
+        decaying against stale draft weights."""
         with self._lock:
-            self._pending_swap = (state, source)
+            self._pending_swap = (state, source, draft_state)
 
     def _apply_pending_swap(self):
         with self._lock:
             pending, self._pending_swap = self._pending_swap, None
         if pending is None:
             return
-        state, source = pending
+        state, source, draft_state = pending
         try:
-            self.engine.swap_weights(state, source=source)
+            if draft_state is not None:
+                self.engine.swap_weights(state, source=source,
+                                         draft_state=draft_state)
+            else:
+                self.engine.swap_weights(state, source=source)
             self.swap_count += 1
             self.last_swap_error = None
         except Exception as e:

@@ -80,7 +80,20 @@ _counters = _registry.scoped_counters("serving", {
     "spec_rounds": 0, "spec_slot_rounds": 0, "spec_proposed": 0,
     "spec_accepted": 0, "spec_emitted": 0, "draft_prefills": 0,
     "verify_compiles": 0, "draft_compiles": 0,
-    "draft_kv_blocks_hwm": 0})
+    "draft_kv_blocks_hwm": 0, "spec_mesh_refused": 0,
+    "draft_swaps": 0})
+
+
+def _refuse_mesh(reason, why, **detail):
+    """Structured mesh refusal (ISSUE 16 satellite): the tentpole lifts
+    the blanket mesh ban, but residual topologies the spec engine cannot
+    serve still refuse — with a ``spec_mesh_refused`` explainer event +
+    counter naming the reason, so a refusal in a serving fleet is
+    diagnosable from the ring instead of a bare traceback."""
+    _counters["spec_mesh_refused"] += 1
+    _explain.record("spec_mesh_refused", op="DraftVerifyEngine",
+                    reason=reason, why=why, **detail)
+    raise ValueError(why)
 
 
 class DraftVerifyEngine(GenerationEngine):
@@ -94,20 +107,37 @@ class DraftVerifyEngine(GenerationEngine):
     ``draft_model`` must share the target's vocabulary (token ids are
     compared for acceptance) and block geometry is shared by
     construction; everything else (depth, width, heads) is free — the
-    canonical pairing is gpt2-tiny drafting for gpt2-medium.  The
-    drafter's weights are fixed for the engine's lifetime: a target
+    canonical pairing is gpt2-tiny drafting for gpt2-medium.  A target
     ``swap_weights`` keeps serving bitwise-correct (acceptance is
-    re-checked against the NEW target every round) at a possibly lower
-    acceptance rate until the drafter is rebuilt.
+    re-checked against the NEW target every round); pass the matching
+    ``draft_state`` to the swap and the drafter's weights AND its KV
+    (recomputed from each slot's token history) swap too, so acceptance
+    recovers instead of decaying against stale draft weights.
+
+    Mesh-sharded serving (ISSUE 16): an ``('mp',)`` serving mesh shards
+    the TARGET's weights/KV per head and the verify executable runs
+    per-shard through the same fused route as plain decode; the drafter
+    stays effectively single-shard (it is tiny) — its weights and KV
+    ride the mesh replicated unless its own head count divides mp, in
+    which case its kernel shards too. Meshes with non-'mp' axes of
+    degree > 1 are refused with a structured ``spec_mesh_refused``
+    event (spec decode has no batch/pipeline axis to map them to).
     """
 
     def __init__(self, model, draft_model, draft_k=4,
                  draft_num_blocks=None, **kw):
-        if kw.get("mesh") is not None:
-            raise ValueError(
-                "DraftVerifyEngine does not support mesh-sharded decode "
-                "yet — shard the plain GenerationEngine, or serve the "
-                "spec engine single-chip")
+        mesh = kw.get("mesh")
+        if mesh is not None:
+            extra = {a: int(s)
+                     for a, s in zip(mesh.axis_names, mesh.devices.shape)
+                     if a != "mp" and int(s) > 1}
+            if extra:
+                _refuse_mesh(
+                    "non_mp_axes",
+                    "DraftVerifyEngine supports only the one-axis "
+                    f"('mp',) serving mesh; got extra axes {extra} — "
+                    "spec decode has no batch or pipeline dimension to "
+                    "map them to", axes=extra)
         super().__init__(model, **kw)
         self.draft_k = int(draft_k)
         if self.draft_k < 1:
@@ -138,10 +168,23 @@ class DraftVerifyEngine(GenerationEngine):
             if self._dstate[n] is dwt)
         self._ddtype = dwt._data.dtype
 
+        # mesh-sharded target (ISSUE 16): the drafter's weights ride the
+        # mesh REPLICATED — it is tiny, and replicated placement lets
+        # its arrays join the mesh-committed verify/draft executables
+        # without resharding
+        if self._mesh is not None:
+            for n in self._dnames:
+                t = self._dstate[n]
+                t._data = jax.device_put(_lazy.force(t._data), self._repl)
+
         # the drafter's paged kernel resolves SEPARATELY against its own
-        # shapes (head_dim/dtype may differ from the target's); same
-        # requested policy, same build-time-only contract. The verify
-        # span rides the target's kernel resolved by super().__init__.
+        # shapes (head_dim/dtype/heads may differ from the target's);
+        # same requested policy, same build-time-only contract. The
+        # verify span rides the target's kernel resolved by
+        # super().__init__. Under a mesh the drafter's head count rarely
+        # divides mp — select demotes it to the GSPMD gather path loudly
+        # (kernel_fallback, family paged_attention.draft) while the
+        # target keeps its per-shard fused route.
         from ..ops import pallas_ops as _pallas_ops
 
         self._draft_kernel, self._draft_kernel_reason = \
@@ -149,7 +192,17 @@ class DraftVerifyEngine(GenerationEngine):
                 kw.get("paged_kernel"),
                 head_dim=dgpt.blocks[0].attn.head_dim,
                 block_size=self.block_size, dtype=self._ddtype,
+                mesh=self._mesh,
+                num_heads=dgpt.blocks[0].attn.n_head,
                 family="paged_attention.draft")
+        self._draft_mesh = self._mesh if (
+            self._mesh is not None
+            and self._draft_kernel in ("pallas", "interpret")) else None
+        if self._mesh is not None:
+            _registry.gauge_set("serving.mesh.draft_kernel",
+                                self._draft_kernel)
+            _registry.gauge_set("serving.mesh.draft_kernel_sharded",
+                                int(self._draft_mesh is not None))
 
         # drafter paged KV: same block geometry as the target (tables
         # share the row math), its own pool arrays (drafter head count
@@ -163,8 +216,34 @@ class DraftVerifyEngine(GenerationEngine):
                             for blk in dgpt.blocks]
         self._dk = [jnp.zeros(s, self._ddtype) for s in self._dkv_shapes]
         self._dv = [jnp.zeros(s, self._ddtype) for s in self._dkv_shapes]
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axes = dict(zip(self._mesh.axis_names,
+                            self._mesh.devices.shape))
+            mp = int(axes.get("mp", 1))
+            dheads_ok = mp > 1 and all(
+                blk.attn.n_head % mp == 0 for blk in dgpt.blocks)
+            dkv = NamedSharding(
+                self._mesh,
+                PartitionSpec(None, None, "mp", None)
+                if dheads_ok else PartitionSpec())
+            self._dk = [jax.device_put(a, dkv) for a in self._dk]
+            self._dv = [jax.device_put(a, dkv) for a in self._dv]
         self._draft_tables = np.zeros((B, self.blocks_per_slot), np.int32)
         self._draft_blocks = [[] for _ in range(B)]
+        # acceptance per weight generation (stats_dump "mesh serving"
+        # section): generation -> [accepted, proposed], so a hot-swap's
+        # acceptance recovery (or decay, if the drafter was not swapped)
+        # is readable from stats
+        self._gen_accept = {}
+        # per-slot token history (prompt + every emitted token, the
+        # pending last token included): len == cur_len + 1 for installed
+        # slots, and rows 0..cur_len-1 of the drafter's KV always hold
+        # exactly history[:cur_len] — which is what lets swap_weights
+        # REBUILD the drafter KV under new drafter weights (acceptance
+        # recovery after a hot-swap) instead of serving stale context
+        self._slot_tokens = [[] for _ in range(B)]
         # drafter ingest cursor per slot: how many prompt rows the
         # drafter's KV holds (trails the target's chunk cursor when the
         # target prefix-hits; advanced window by window)
@@ -194,6 +273,8 @@ class DraftVerifyEngine(GenerationEngine):
         """The drafter's trace-time parameter rebinding — same
         StaticFunction state-swap idiom as the target's
         ``_forward_slot``, against the drafter's own module tree."""
+        paged_mesh = self._draft_mesh \
+            if kernel in ("pallas", "interpret") else None
         old = {n: self._dstate[n]._data for n in self._dnames}
         for n, arr in zip(self._dnames, dstate_arrays):
             self._dstate[n]._data = arr
@@ -206,7 +287,7 @@ class DraftVerifyEngine(GenerationEngine):
                     caches=caches, cache_offsets=Tensor(offsets),
                     seq_lens=Tensor(seq_lens),
                     block_tables=Tensor(block_tables),
-                    paged_kernel=kernel)
+                    paged_kernel=kernel, paged_mesh=paged_mesh)
             return (hidden._data,
                     tuple(c[0]._data for c in new_caches),
                     tuple(c[1]._data for c in new_caches))
@@ -400,12 +481,31 @@ class DraftVerifyEngine(GenerationEngine):
             self._draft_ingested[slot] = 0
             raise
 
+    def _install_slot(self, slot, prompt, table_ids, bt_row, tok, key,
+                      temperature, top_k, top_p, matched_prefix,
+                      max_new_tokens):
+        super()._install_slot(slot, prompt, table_ids, bt_row, tok, key,
+                              temperature, top_k, top_p, matched_prefix,
+                              max_new_tokens)
+        # token history starts as prompt + pending first token
+        # (len == cur_len + 1, the standing invariant)
+        self._slot_tokens[slot] = [int(t) for t in prompt] + [int(tok)]
+
+    def _finish_decode(self, active, n_active, toks):
+        # plain decode_step on a spec engine (scheduler fallback) must
+        # keep the history invariant too — each step appends its one
+        # emitted token
+        super()._finish_decode(active, n_active, toks)
+        for b in np.nonzero(active)[0]:
+            self._slot_tokens[b].append(int(toks[b]))
+
     def release(self, slot):
         if self._draft_blocks[slot]:
             self.draft_pool.decref(self._draft_blocks[slot])
             self._draft_blocks[slot] = []
         self._draft_tables[slot] = 0
         self._draft_ingested[slot] = 0
+        self._slot_tokens[slot] = []
         super().release(slot)
 
     def import_request_kv(self, slot, payload, prompt_ids=None):
@@ -433,7 +533,81 @@ class DraftVerifyEngine(GenerationEngine):
         except Exception:
             super().release(slot)
             raise
+        self._slot_tokens[slot] = [int(t) for t in prompt] \
+            + [int(self._last_tokens[slot])]
         return first
+
+    # ------------------------------------------------------- weight swap --
+    def swap_weights(self, state, source=None, draft_state=None):
+        """Target hot-swap, optionally with a matching drafter swap.
+
+        Without ``draft_state`` this is the inherited target swap:
+        emitted tokens stay bitwise-correct (acceptance is re-checked
+        against the new target every round) but the drafter now guesses
+        from stale weights, so acceptance decays. With ``draft_state``
+        the drafter's weights swap in the SAME all-or-nothing commit
+        (both states validate before either engine mutates), and every
+        in-flight slot's drafter KV is REBUILT from its token history
+        under the new drafter weights — acceptance recovers immediately
+        instead of paying a stale-context penalty for the rest of each
+        stream."""
+        dstaged = None
+        if draft_state is not None:
+            dresolved = self._resolve_swap_state(draft_state,
+                                                 names=self._dnames)
+            dstaged = self._stage_swap(dresolved, self._dnames,
+                                       self._dstate)
+        super().swap_weights(state, source=source)
+        if dstaged is None:
+            return
+        for n, arr in zip(self._dnames, dstaged):
+            self._dstate[n]._data = arr
+        self._dstate_tuple = None
+        self._rebuild_draft_kv()
+        _counters["draft_swaps"] += 1
+        _explain.record(
+            "serving_draft_swap", op="swap_weights",
+            why=f"swapped {len(dstaged)} drafter weights"
+                + (f" from {source}" if source else "")
+                + "; every in-flight slot's drafter KV was rebuilt from "
+                  "its token history, so acceptance recovers immediately "
+                  "instead of decaying against stale draft context",
+            weights=len(dstaged), source=source)
+
+    def _rebuild_draft_kv(self):
+        """Recompute every in-flight slot's drafter KV under the CURRENT
+        drafter weights by re-ingesting its token history (prompt +
+        emitted tokens) window by window — the same ``_draft_ingest``
+        path chunked admission uses, so window lengths stay inside the
+        bucket ladder and no new executable shapes appear. Rows past the
+        re-ingested span hold stale garbage, exactly like rejected
+        speculation rows: masked out of every read and overwritten by
+        the next round's writes."""
+        maxw = self.buckets[-1]
+        for slot in range(self.max_batch_size):
+            if self._active[slot]:
+                hist = self._slot_tokens[slot]
+                end = int(self._cur_lens[slot])
+            elif slot in self._mid_prefill:
+                # mid-chunked-admission: the drafter had ingested the
+                # prompt up to its cursor; redo that span under the new
+                # weights (remaining chunks continue from there)
+                hist = list(self._mid_prefill[slot]["prompt"])
+                end = self._draft_ingested[slot]
+            else:
+                continue
+            if end <= 0:
+                continue
+            if len(hist) < end:  # history can't cover the KV: refuse
+                raise RuntimeError(
+                    f"slot {slot}: token history ({len(hist)}) shorter "
+                    f"than cur_len ({end}) — drafter KV cannot be "
+                    "rebuilt; this is a bookkeeping bug")
+            self._draft_ingested[slot] = 0
+            while self._draft_ingested[slot] < end:
+                self._draft_ingest(
+                    slot, hist,
+                    min(self._draft_ingested[slot] + maxw, end))
 
     # ------------------------------------------------------------ decode --
     def reprime(self):
@@ -529,6 +703,8 @@ class DraftVerifyEngine(GenerationEngine):
         out = [[] for _ in range(self.max_batch_size)]
         total = 0
         c = _counters
+        gen_acc = self._gen_accept.setdefault(
+            self.prefix_cache.generation, [0, 0])
         for b in np.nonzero(active)[0]:
             m = int(emitted[b])
             toks = [int(t) for t in sampled[b, :m]]
@@ -538,11 +714,20 @@ class DraftVerifyEngine(GenerationEngine):
             self._gen_idx[b] += m
             if m:
                 self._last_tokens[b] = toks[-1]
+                self._slot_tokens[b].extend(toks)
             c["spec_accepted"] += int(accepts[b])
             c["spec_proposed"] += K
             c["spec_emitted"] += m
+            gen_acc[0] += int(accepts[b])
+            gen_acc[1] += K
         c["spec_rounds"] += 1
         c["spec_slot_rounds"] += n_active
+        if gen_acc[1]:
+            # per-weight-generation acceptance (stats_dump "mesh
+            # serving" section reads these gauges)
+            _registry.gauge_set(
+                f"serving.spec_acceptance.gen{self.prefix_cache.generation}",
+                round(gen_acc[0] / gen_acc[1], 4))
         sc = _serving_counters
         sc["decode_steps"] += 1
         sc["active_slot_steps"] += n_active
@@ -583,11 +768,40 @@ class DraftVerifyEngine(GenerationEngine):
         r = _counters["spec_slot_rounds"]
         return _counters["spec_emitted"] / r if r else 0.0
 
+    def acceptance_by_generation(self):
+        """Acceptance rate per weight generation (the prefix-cache
+        generation a round ran under): a hot-swap that also swapped the
+        drafter shows recovery here; a target-only swap shows decay."""
+        return {int(g): (a / p if p else 0.0)
+                for g, (a, p) in sorted(self._gen_accept.items())}
+
+    def describe_sharding(self):
+        desc = super().describe_sharding()
+        from ..core.lazy import _spec_repr
+
+        for i, (k, v) in enumerate(zip(self._dk, self._dv)):
+            for name, a in (("k", k), ("v", v)):
+                desc["kv_pools"].append({
+                    "layer": i, "pool": f"draft_{name}", "draft": True,
+                    "shape": [int(d) for d in a.shape],
+                    "dtype": str(a.dtype), "bytes": int(a.nbytes),
+                    "spec": (_spec_repr(a.sharding)
+                             if self._mesh is not None else None)})
+        desc["draft_paged_kernel"] = self._draft_kernel
+        desc["draft_kernel_sharded"] = self._draft_mesh is not None
+        return desc
+
     def stats(self):
-        return {**super().stats(),
-                "draft_paged_kernel": self._draft_kernel,
-                "draft_k": self.draft_k,
-                "acceptance_rate": self.acceptance_rate(),
-                "accepted_len_mean": self.accepted_len_mean(),
-                "draft_kv_blocks_total": self.draft_pool.usable_blocks,
-                "draft_kv_blocks_in_use": self.draft_pool.in_use()}
+        out = {**super().stats(),
+               "draft_paged_kernel": self._draft_kernel,
+               "draft_paged_kernel_reason": self._draft_kernel_reason,
+               "draft_k": self.draft_k,
+               "acceptance_rate": self.acceptance_rate(),
+               "accepted_len_mean": self.accepted_len_mean(),
+               "acceptance_by_generation":
+                   self.acceptance_by_generation(),
+               "draft_kv_blocks_total": self.draft_pool.usable_blocks,
+               "draft_kv_blocks_in_use": self.draft_pool.in_use()}
+        if self._mesh is not None:
+            out["draft_kernel_sharded"] = self._draft_mesh is not None
+        return out

@@ -177,17 +177,41 @@ class TestKernelSelection:
             pallas_ops.select_paged_kernel(
                 None, head_dim=64, block_size=16, dtype=jnp.float32)
 
-    def test_mesh_forces_xla_with_loud_fallback(self):
+    @pytest.mark.skipif(jax.device_count() < 2,
+                        reason="needs >= 2 (forced host) devices")
+    def test_mesh_indivisible_heads_demotes_loudly(self):
+        # ISSUE 16: a mesh no longer demotes per se — only heads that do
+        # not divide the 'mp' axis do, and the demotion names both
+        # numbers in a kernel_fallback event
+        from paddle_tpu.distributed import spmd
+
+        mesh = spmd.serving_mesh(2)
         c0 = dict(registry.counters("serving"))
         kind, reason = pallas_ops.select_paged_kernel(
             "pallas", head_dim=64, block_size=16, dtype=jnp.float32,
-            mesh=object())
-        assert kind == "xla" and "mesh" in reason
+            mesh=mesh, num_heads=3)
+        assert kind == "xla"
+        assert "3" in reason and "mp=2" in reason
         c1 = registry.counters("serving")
         assert c1["kernel.fallbacks"] == c0["kernel.fallbacks"] + 1
         ev = [e for e in explainer.events(kind="kernel_fallback")
-              if "mesh" in (e.get("why") or "")]
-        assert ev, "mesh fallback must land a kernel_fallback event"
+              if e.get("mp") == 2 and e.get("num_heads") == 3]
+        assert ev, "head/mp demotion must land a kernel_fallback event"
+
+    @pytest.mark.skipif(jax.device_count() < 2,
+                        reason="needs >= 2 (forced host) devices")
+    def test_mesh_divisible_heads_keeps_per_shard_kernel(self):
+        from paddle_tpu.distributed import spmd
+
+        mesh = spmd.serving_mesh(2)
+        c0 = dict(registry.counters("serving"))
+        kind, reason = pallas_ops.select_paged_kernel(
+            "pallas", head_dim=64, block_size=16, dtype=jnp.float32,
+            mesh=mesh, num_heads=4)
+        assert kind == "interpret"  # cpu: kernel body via interpreter
+        assert "per-shard" in reason and "local heads 2" in reason
+        c1 = registry.counters("serving")
+        assert c1["kernel.fallbacks"] == c0["kernel.fallbacks"]
 
     def test_tileability_reasons(self):
         ok, _ = pallas_ops.paged_tileable(128, 16, jnp.bfloat16)
@@ -302,6 +326,158 @@ class TestEngineTokenParity:
         assert f1["decode_audit_runs"] > f0["decode_audit_runs"]
         eng.reset()
         eng.pool.audit()
+
+
+@pytest.mark.skipif(jax.device_count() < 2,
+                    reason="needs >= 2 (forced host) devices for mp=2")
+class TestMeshShardedKernel:
+    """ISSUE 16 tentpole: the fused kernel route survives an mp mesh.
+    Per-shard execution through shard_map must be token-BITWISE with the
+    single-chip fused engine (each head's online softmax is computed
+    whole on exactly one shard — nothing crosses the 'mp' axis), with
+    zero post-warmup compiles/demotions, for plain decode, spec decode,
+    and across a target+drafter weight hot-swap."""
+
+    EKW = dict(max_batch_size=2, buckets=(8, 16), rng_seed=9,
+               block_size=4)
+
+    @staticmethod
+    def _lint_mod():
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "tools", "sharding_lint.py")
+        spec = importlib.util.spec_from_file_location("sharding_lint",
+                                                      path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_serving_mesh_validates_head_divisibility(self):
+        from paddle_tpu.distributed import spmd
+
+        with pytest.raises(ValueError, match=r"mp=3.*n_head=2"):
+            spmd.serving_mesh(3, model=_build_model(77))
+
+    def test_mp2_fused_decode_bitwise_zero_recompiles(self):
+        from paddle_tpu.distributed import spmd
+        from paddle_tpu.serving import GenerationEngine
+
+        single = GenerationEngine(_build_model(76),
+                                  paged_kernel="pallas", **self.EKW)
+        mesh = spmd.serving_mesh(2, model=_build_model(76))
+        sharded = GenerationEngine(_build_model(76),
+                                   paged_kernel="pallas", mesh=mesh,
+                                   **self.EKW)
+        assert sharded.paged_kernel == "interpret"  # cpu: kernel body
+        assert sharded.stats()["paged_kernel_sharded"]
+        rng = np.random.default_rng(8)
+        for i, kw in enumerate([dict(temperature=0.0),
+                                dict(temperature=0.9, top_k=25)]):
+            prompt = list(rng.integers(1, VOCAB, 6 + 3 * i))
+            want = _run_one(single, prompt, 9, seed=i, **kw)
+            got = _run_one(sharded, prompt, 9, seed=i, **kw)
+            assert got == want
+        # KV pools are head-sharded — the lint agrees nothing was left
+        # replicated (the demotion this PR removed)
+        desc = sharded.describe_sharding()
+        assert desc["paged_kernel_sharded"]
+        assert all(pool["spec"] == [None, None, "mp"]
+                   for pool in desc["kv_pools"])
+        assert self._lint_mod().lint_engine(desc, min_bytes=0) == []
+        # zero post-warmup churn, same window as the single-chip gate
+        sharded.prefill(0, [5, 9, 2, 7], seed=0)
+        for _ in range(3):
+            sharded.decode_step()
+        c0 = dict(registry.counters("serving"))
+        f0 = dict(registry.counters("fastpath"))
+        for _ in range(2 * sharded._audit_every):
+            sharded.decode_step()
+        c1 = registry.counters("serving")
+        f1 = registry.counters("fastpath")
+        assert c1["decode_compiles"] == c0["decode_compiles"]
+        assert c1["kernel.fallbacks"] == c0["kernel.fallbacks"]
+        assert f1["decode_demotions"] == f0["decode_demotions"]
+        assert f1["decode_rebuilds"] == f0["decode_rebuilds"]
+        sharded.reset()
+        sharded.pool.audit()
+
+    def test_mp2_spec_decode_bitwise(self):
+        from paddle_tpu.distributed import spmd
+        from paddle_tpu.serving import (DraftVerifyEngine,
+                                        GenerationEngine)
+
+        plain = GenerationEngine(_build_model(73), paged_kernel="xla",
+                                 **self.EKW)
+        mesh = spmd.serving_mesh(2, model=_build_model(73))
+        spec = DraftVerifyEngine(_build_model(73), _build_model(74),
+                                 draft_k=3, paged_kernel="pallas",
+                                 mesh=mesh, **self.EKW)
+        st = spec.stats()
+        assert st["paged_kernel_sharded"] and st["draft_kernel_sharded"]
+        rng = np.random.default_rng(3)
+        for i, kw in enumerate([dict(temperature=0.0),
+                                dict(temperature=0.8, top_k=20)]):
+            prompt = list(rng.integers(1, VOCAB, 7 + 2 * i))
+            want = _run_one(plain, prompt, 9, seed=i, **kw)
+            got = _run_one(spec, prompt, 9,
+                           step=spec.decode_step_spec, seed=i, **kw)
+            assert got == want
+        # drafter pools ride the same head-sharded layout
+        draft_pools = [p for p in spec.describe_sharding()["kv_pools"]
+                       if p.get("draft")]
+        assert draft_pools and all(p["spec"] == [None, None, "mp"]
+                                   for p in draft_pools)
+        spec.pool.audit()
+        spec.draft_pool.audit()
+
+    def test_draft_swap_rebuilds_kv_and_recovers_acceptance(self):
+        from paddle_tpu.distributed import spmd
+        from paddle_tpu.serving import (DraftVerifyEngine,
+                                        GenerationEngine)
+
+        ekw = dict(self.EKW, max_batch_size=1)
+        plain = GenerationEngine(_build_model(73), paged_kernel="xla",
+                                 **ekw)
+        mesh = spmd.serving_mesh(2, model=_build_model(73))
+        spec = DraftVerifyEngine(_build_model(73), _build_model(74),
+                                 draft_k=3, paged_kernel="pallas",
+                                 mesh=mesh, **ekw)
+        rng = np.random.default_rng(5)
+        prompt = list(rng.integers(1, VOCAB, 7))
+        wp = [plain.prefill(0, prompt, seed=0)]
+        ws = [spec.prefill(0, prompt, seed=0)]
+        while len(wp) < 6:
+            wp.append(int(plain.decode_step()[0]))
+        while len(ws) < 6:
+            ws.extend(spec.decode_step_spec()[0])
+        # mid-stream hot-swap: same target weights, drafter becomes a
+        # TWIN of the target — spec_decode's exact-acceptance bound
+        t_state = dict(_build_model(73).gpt.state_dict())
+        d_state = dict(_build_model(73).gpt.state_dict())
+        c0 = dict(registry.counters("serving"))
+        spec.swap_weights(dict(t_state), draft_state=d_state)
+        plain.swap_weights(t_state)
+        assert registry.counters("serving")["draft_swaps"] \
+            == c0["draft_swaps"] + 1
+        while len(wp) < 14:
+            wp.append(int(plain.decode_step()[0]))
+        while len(ws) < 14:
+            ws.extend(spec.decode_step_spec()[0])
+        # the rebuilt drafter KV continues BITWISE mid-request...
+        assert ws[:14] == wp[:14]
+        # ...and the twin drafter's rounds are fully accepted in the
+        # new weight generation (per-generation acceptance isolates the
+        # pre-swap wrong-drafter rounds)
+        by_gen = spec.acceptance_by_generation()
+        gen = spec.prefix_cache.generation
+        assert by_gen[gen] == 1.0
+        assert by_gen[gen - 1] < 1.0
+        spec.release(0)
+        plain.release(0)
+        spec.pool.audit()
+        spec.draft_pool.audit()
 
 
 class TestKernelMismatchFault:

@@ -150,8 +150,9 @@ class TestSpecDerivation:
     def test_pp_topology_selects_spmd_mesh(self):
         # ISSUE 15: pp>1 is a first-class SPMD citizen — the folded mesh
         # gains a 'pp' axis (tests/test_spmd_pp.py drives the pipeline
-        # step itself); only pp>1 with sharding>1 still refuses, with a
-        # structured spmd_pp_refused event
+        # step itself); ISSUE 16: pp>1 WITH sharding>1 folds too —
+        # 'sharding' collapses into 'dp' exactly like the pp=1 case, and
+        # no topology refuses the SPMD path anymore
         strategy = fleet.DistributedStrategy()
         strategy.hybrid_configs = {
             "dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
@@ -162,10 +163,12 @@ class TestSpecDerivation:
         assert spmd.enabled()
         strategy.hybrid_configs["sharding_degree"] = 2
         strategy.hybrid_configs["dp_degree"] = 1
-        with pytest.warns(UserWarning, match="sharding_degree"):
-            fleet.init(is_collective=True, strategy=strategy)
-        assert fleet.get_hybrid_communicate_group().spmd_mesh() is None
-        assert not spmd.enabled()
+        fleet.init(is_collective=True, strategy=strategy)
+        mesh = fleet.get_hybrid_communicate_group().spmd_mesh()
+        assert mesh is not None and mesh.axis_names == ("dp", "pp", "mp")
+        assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+            "dp": 2, "pp": 2, "mp": 2}  # dp picks up the ZeRO fold
+        assert spmd.enabled()
 
 
 class TestOneCompilation:

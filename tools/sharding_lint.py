@@ -20,7 +20,12 @@ Checks:
   * pipeline coverage (ISSUE 15): a captured pp_pipeline step on a mesh
     whose 'pp' axis has > 1 devices must carry at least one
     stage-sharded leaf — none means the trunk stacking silently
-    replicated every stage's params (pp memory scaling lost).
+    replicated every stage's params (pp memory scaling lost);
+  * serving KV replication (ISSUE 16): a serving engine dump
+    (`engine.describe_sharding()`, detected by its "kv_pools" key) on
+    an mp>1 mesh must head-shard each KV pool whose head count divides
+    mp — replicated-but-shardable pools are the demotion the
+    mesh-complete fast path removed.
 
 Pure stdlib on purpose — no paddle_tpu / jax import, so it lints a
 dumped JSON anywhere (CI box, laptop). bench.py --spmd calls `lint()`
@@ -124,6 +129,36 @@ def lint(desc, min_bytes=MIN_SHARDABLE_BYTES):
     return problems
 
 
+def lint_engine(desc, min_bytes=MIN_SHARDABLE_BYTES):
+    """Problem strings for a serving engine's ``describe_sharding()``
+    dict (ISSUE 16): a mesh engine whose per-layer KV pool is replicated
+    while its HEAD dim (pools are [num_blocks, block_size, H, Dh];
+    serving shards whole heads, never blocks or head_dim) divides the
+    'mp' axis left the exact demotion this PR removed on the table —
+    every decode step gathers the full pool on every shard."""
+    axes = _mesh_axes(desc)
+    mp = axes.get("mp", 0)
+    problems = []
+    if mp <= 1:
+        return problems  # single-chip (or no mesh): nothing to shard
+    for pool in desc.get("kv_pools", ()):
+        spec = pool.get("spec")
+        if spec == "opaque":
+            continue
+        shape = pool.get("shape", ())
+        tag = (f"kv pool layer {pool.get('layer')} "
+               f"({pool.get('pool')}) {shape}/{pool.get('dtype')}")
+        if len(shape) == 4 and shape[2] and shape[2] % mp == 0 \
+                and _is_replicated(spec) \
+                and pool.get("bytes", 0) >= min_bytes:
+            problems.append(
+                f"{tag}: replicated on an mp={mp} mesh but its head dim "
+                f"({shape[2]}) divides mp — head-shard it "
+                f"(P(None, None, 'mp', None)) so each shard holds "
+                f"H/mp heads and the per-shard kernel route applies")
+    return problems
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="describe_plans() JSON file, or - for "
@@ -140,6 +175,13 @@ def main(argv=None):
     except ValueError as e:
         print(f"{args.path}: not a JSON document: {e}", file=sys.stderr)
         return 2
+    if "kv_pools" in desc:  # serving-engine describe_sharding() dump
+        problems = lint_engine(desc, args.min_bytes)
+        print(f"{len(desc.get('kv_pools', ()))} kv pool(s), "
+              f"{len(problems)} problem(s)")
+        for p in problems:
+            print(f"  WARN {p}")
+        return 1 if problems else 0
     problems = lint(desc, args.min_bytes)
     n_plans = len(desc.get("plans", ()))
     n_lowered = sum(1 for p in desc.get("plans", ()) if p.get("spmd"))

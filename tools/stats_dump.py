@@ -197,6 +197,30 @@ def _print_pipeline(counters, gauges):
     _print_counters(pl)
 
 
+_MESH_SERVING_PREFIXES = ("serving.mesh.", "serving.spec_acceptance.")
+_MESH_SERVING_KEYS = frozenset(("serving.spec_mesh_refused",
+                                "serving.draft_swaps"))
+
+
+def _print_mesh_serving(counters, gauges):
+    """Mesh-sharded serving health (ISSUE 16): which per-shard kernel
+    each engine resolved to (sharded=0 on an mp>1 mesh means the fused
+    route demoted — indivisible heads), residual spec-engine mesh
+    refusals, drafter hot-swaps, and the spec acceptance rate PER WEIGHT
+    GENERATION — a post-swap generation whose acceptance does not
+    recover means the drafter was not swapped along with the target."""
+    ms = {k: counters.pop(k) for k in list(counters)
+          if k.startswith(_MESH_SERVING_PREFIXES)
+          or k in _MESH_SERVING_KEYS}
+    ms.update({k: gauges.pop(k) for k in list(gauges)
+               if k.startswith(_MESH_SERVING_PREFIXES)
+               or k in _MESH_SERVING_KEYS})
+    if not any(bool(v) for v in ms.values()):
+        return
+    print("mesh serving:")
+    _print_counters(ms)
+
+
 _KERNEL_PREFIXES = ("serving.kernel.", "kernel.")
 
 
@@ -281,6 +305,11 @@ def _print_snapshot(snap):
     # pod restarts / orphan replays / routing hit rate are the
     # cross-process resilience story, read as one table
     _print_fleet(counters, gauges)
+    # mesh serving (ISSUE 16) claims its serving.mesh.* gauges and the
+    # spec-engine mesh counters before the kernel/spec tables: the
+    # per-shard kernel route and per-generation acceptance are one
+    # story
+    _print_mesh_serving(counters, gauges)
     # kernel selection (ISSUE 14) claims serving.kernel.* / kernel.*
     # before the serving table: which paged/flash implementation is
     # actually running, and whether anything fell back to the slow path
