@@ -204,6 +204,11 @@ def _run_ratio_child():
     over the measured window. vs_baseline is 1.3/ratio: the ISSUE-9
     acceptance gate tightened the ISSUE-2 gate from 2.0 to 1.3."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # ISSUE 18: the 1.3x gate must hold WITH span tracing armed —
+    # tracing that only gates clean while disabled is not deployable.
+    # Spans sit around executable calls, never inside the replay loop,
+    # so the measured window sees one boolean load per span site.
+    os.environ.setdefault("PADDLE_TPU_TRACE", "1")
     import statistics
     import time as _t
 
@@ -347,6 +352,7 @@ def _run_ratio_child():
         "fastpath_audit_runs": f1["audit_runs"] - f0["audit_runs"],
         "fastpath_demotions": f1["demotions"] - f0["demotions"],
         "ckpt_interval": CKPT_EVERY if ckpt_on else 0,
+        "tracing_enabled": os.environ.get("PADDLE_TPU_TRACE") == "1",
         "platform": "cpu",
     }
     # the SPMD one-compilation gate rides every --ratio run (ISSUE 6):
@@ -785,6 +791,11 @@ def _run_serve_child():
     # banks the kernel phase's real on-chip pallas-vs-xla numbers
     # (ISSUE 14) instead of interpreter ones
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # ISSUE 18: every serving gate below (0 post-warmup compiles, 0
+    # failed, spec bitwise) must hold WITH tracing + latency histograms
+    # recording — the observability plane rides the bench, not a
+    # separate instrumented build
+    os.environ.setdefault("PADDLE_TPU_TRACE", "1")
     # the mesh-kernel phase (ISSUE 16) needs >= 2 devices; force the
     # virtual host mesh the same way --spmd does (append, don't
     # setdefault — a user-set XLA_FLAGS must keep its own flags). On a
@@ -1187,6 +1198,12 @@ def _run_serve_child():
     occ = ((c1["active_slot_steps"] - c0["active_slot_steps"])
            / (steps * server.engine.max_batch_size)) if steps else 0.0
     ttft = _reg.timings("serving").get("serving.ttft", {})
+    # log2 latency histograms (ISSUE 18): TTFT + inter-token p50/p99
+    # from the always-mergeable fixed-bucket records — what a fleet
+    # aggregates across pods, reported here from one server
+    hists = _reg.histograms("serving")
+    h_ttft = hists.get("serving.ttft", {})
+    h_itl = hists.get("serving.inter_token", {})
     _telemetry_line()
     rec = {
         "metric": "serving",
@@ -1196,6 +1213,11 @@ def _run_serve_child():
         "requests": len(reqs),
         "tokens": tokens,
         "ttft_ms_mean": round(ttft.get("mean_ms", 0.0), 2),
+        "ttft_p50_ms": round(h_ttft.get("p50_ms", 0.0), 2),
+        "ttft_p99_ms": round(h_ttft.get("p99_ms", 0.0), 2),
+        "inter_token_p50_ms": round(h_itl.get("p50_ms", 0.0), 3),
+        "inter_token_p99_ms": round(h_itl.get("p99_ms", 0.0), 3),
+        "tracing_enabled": os.environ.get("PADDLE_TPU_TRACE") == "1",
         # train→serve loop gates (ISSUE 7): the mid-flight hot-swap must
         # land (swap_count >= 1) with ZERO failed requests and zero new
         # decode compiles (same-aval swap replays the compiled step).
@@ -1296,6 +1318,9 @@ def _run_serve_fleet_child():
     Convention matches --serve: the {"metric": "serving-fleet"} result
     line prints last; exits nonzero when a hard gate fails."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    # ISSUE 18: fleet gates hold with the tracing plane on — the router
+    # pins trace ids, the pods ship spans back on stats replies
+    os.environ.setdefault("PADDLE_TPU_TRACE", "1")
     import tempfile
     import time as _t
 
@@ -1371,6 +1396,7 @@ def _run_serve_fleet_child():
         fleet.shutdown()
         return {"tps": tokens / dt, "failed": failed,
                 "hit_rate": st["prefix_hit_rate"], "stats": st,
+                "hists": st.get("hists", {}),
                 "swap": swap_res,
                 "router": {k: f1[k] - f0.get(k, 0) for k in f1}}
 
@@ -1428,6 +1454,20 @@ def _run_serve_fleet_child():
             str(p): d.get("decode_compiles")
             for p, d in aff["stats"]["pods"].items()},
         "orphans_replayed": aff["router"].get("orphans_replayed", 0),
+        # fleet-aggregated latency histograms (ISSUE 18): log2 buckets
+        # merged across both pods' stats replies — the operator's TTFT /
+        # inter-token health line for the whole fleet
+        "ttft_p50_ms": round(
+            aff["hists"].get("serving.ttft", {}).get("p50_ms", 0.0), 2),
+        "ttft_p99_ms": round(
+            aff["hists"].get("serving.ttft", {}).get("p99_ms", 0.0), 2),
+        "inter_token_p50_ms": round(
+            aff["hists"].get("serving.inter_token", {})
+            .get("p50_ms", 0.0), 3),
+        "inter_token_p99_ms": round(
+            aff["hists"].get("serving.inter_token", {})
+            .get("p99_ms", 0.0), 3),
+        "tracing_enabled": os.environ.get("PADDLE_TPU_TRACE") == "1",
         "gates_ok": gates_ok,
         "platform": "cpu",
     }

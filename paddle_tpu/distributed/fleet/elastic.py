@@ -586,6 +586,15 @@ class StepWatchdog:
                 self.on_trip(ev)
             except Exception:
                 pass
+        try:
+            # the flight recorder lands next to the stack dump: stacks
+            # say where the process is stuck, the flight ring says what
+            # requests it was running when it got there
+            from ...profiler import tracing as _tracing
+
+            _tracing.dump_flight_recorder(reason=f"watchdog: {why}")
+        except Exception:
+            pass
         if self.escalate == "exit":
             if self.store is not None:
                 try:  # best-effort breadcrumb for the supervisor

@@ -160,7 +160,9 @@ class Profiler:
         self._export_count = 0
         self._pending_export = False  # closed window not yet delivered
         self._delivered = 0           # on_trace_ready invocations
-        self._step_times = []
+        self._step_times = []  # fixed-size reservoir (registry.RESERVOIR_CAP)
+        self._step_count = 0
+        self._step_total = 0.0
         self._last_t = None
 
     def start(self):
@@ -200,7 +202,15 @@ class Profiler:
     def step(self, num_samples=None):
         now = time.perf_counter()
         if self._last_t is not None:
-            self._step_times.append(now - self._last_t)
+            dt = now - self._last_t
+            # bounded: running count/total + a fixed reservoir for the
+            # summary percentiles (long profiled runs used to grow this
+            # list forever), plus the mergeable log2 histogram that the
+            # fleet metrics plane aggregates
+            self._step_count += 1
+            self._step_total += dt
+            registry.reservoir_add(self._step_times, self._step_count, dt)
+            registry.hist_record("step_host", dt, scope="profiler")
         self._last_t = now
         self._step += 1
         prev = getattr(self, "_state", ProfilerState.CLOSED)
@@ -250,6 +260,7 @@ class Profiler:
         self._export_count += 1
         meta = registry.snapshot()
         meta["step_times_ms"] = [t * 1e3 for t in self._step_times]
+        meta["step_count"] = self._step_count
         self._last_export = timeline.write_chrome_trace(
             os.path.join(d, name + ".json"), self._host_spans, meta)
         return self._last_export
@@ -260,13 +271,13 @@ class Profiler:
             return "no steps recorded"
         import numpy as np
 
+        # percentiles from the reservoir (a uniform sample of every step
+        # when the run outgrew it); count/avg from the exact running sums
         ts = np.asarray(self._step_times) * 1e3
-        line = (f"steps={len(ts)} avg={ts.mean():.3f}ms p50="
+        avg_s = self._step_total / max(self._step_count, 1)
+        line = (f"steps={self._step_count} avg={avg_s * 1e3:.3f}ms p50="
                 f"{np.percentile(ts, 50):.3f}ms p99="
                 f"{np.percentile(ts, 99):.3f}ms")
-        # cost-model-derived throughput: set_step_metrics declares the
-        # per-step work; MFU = model FLOPs / time / device peak
-        avg_s = float(np.mean(self._step_times))
         tokens = registry.gauge("step.tokens")
         flops = registry.gauge("step.flops")
         if tokens:

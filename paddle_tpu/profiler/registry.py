@@ -23,12 +23,15 @@ time).
 """
 from __future__ import annotations
 
+import math
+import random
 import threading
 import time
 
 _lock = threading.Lock()
 _counter_scopes: dict = {}
 _timing_scopes: dict = {}
+_hist_scopes: dict = {}
 _gauges: dict = {}
 
 
@@ -61,18 +64,45 @@ def gauge(name, default=None):
     return _gauges.get(name, default)
 
 
+def gauge_drop(name):
+    """Retire one gauge key (long-lived servers must not leak keys for
+    dead generations — ISSUE 18 satellite)."""
+    _gauges.pop(name, None)
+
+
+# Per-timing reservoir: a fixed-size uniform sample of the raw
+# observations riding as rec[2], so percentiles stay available over
+# unbounded runs without unbounded lists (ISSUE 18 satellite). 128
+# samples bound the p99 estimate's noise well below the log2-histogram
+# bucket width that backs the real latency SLO numbers.
+RESERVOIR_CAP = 128
+
+
+def reservoir_add(res, count, value):
+    """Uniform reservoir sampling: after `count` total observations the
+    capped list `res` is a uniform sample of all of them."""
+    if len(res) < RESERVOIR_CAP:
+        res.append(value)
+    else:
+        j = int(random.random() * count)
+        if j < RESERVOIR_CAP:
+            res[j] = value
+
+
 def timing(name, seconds, scope="timings"):
-    """Accumulate one duration observation: [count, total_seconds]."""
+    """Accumulate one duration observation:
+    [count, total_seconds, reservoir]."""
     s = _timing_scopes.get(scope)
     if s is None:
         with _lock:
             s = _timing_scopes.setdefault(scope, {})
     rec = s.get(name)
     if rec is None:
-        s[name] = [1, float(seconds)]
+        s[name] = [1, float(seconds), [float(seconds)]]
     else:
         rec[0] += 1
         rec[1] += seconds
+        reservoir_add(rec[2], rec[0], seconds)
 
 
 class time_block:
@@ -123,6 +153,98 @@ def tally(scope, name, *arrays):
     d[name + ".bytes"] = d.get(name + ".bytes", 0) + nb
 
 
+# ---------------------------------------------------------- histograms --
+# Fourth primitive (ISSUE 18): fixed log2-bucket latency histograms.
+# Bucket i holds observations in (2^(EMIN+i-1), 2^(EMIN+i)] seconds —
+# `math.frexp(v)[1] - EMIN` is the index, one C call + two list/dict
+# stores on the hot path, zero allocation after the first observation.
+# 44 buckets span ~0.95 µs (bucket 0 catches everything at or below)
+# to 2^23 s; values past either end clamp into the edge buckets.
+# Mergeable across processes by summing counts bucket-wise — the fleet
+# aggregates pod histograms without ever shipping raw samples.
+HIST_EMIN = -20
+HIST_NBUCKETS = 44
+
+
+def hist_record(name, seconds, scope="serving"):
+    """Record one duration observation into a log2 histogram."""
+    s = _hist_scopes.get(scope)
+    if s is None:
+        with _lock:
+            s = _hist_scopes.setdefault(scope, {})
+    rec = s.get(name)
+    if rec is None:
+        rec = s[name] = [0, 0.0, [0] * HIST_NBUCKETS]
+    rec[0] += 1
+    rec[1] += seconds
+    if seconds > 0.0:
+        i = math.frexp(seconds)[1] - HIST_EMIN
+        if i < 0:
+            i = 0
+        elif i >= HIST_NBUCKETS:
+            i = HIST_NBUCKETS - 1
+    else:
+        i = 0
+    rec[2][i] += 1
+
+
+def hist_bucket_upper_ms(i):
+    """Upper edge of bucket `i` in milliseconds."""
+    return 2.0 ** (HIST_EMIN + int(i)) * 1e3
+
+
+def hist_quantile_ms(snap, q):
+    """Quantile from a histogram snapshot's sparse buckets: walk the
+    cumulative counts and report the covering bucket's upper edge (a
+    conservative, ≤2x estimate by construction of log2 buckets)."""
+    cnt = snap.get("count", 0)
+    if not cnt:
+        return 0.0
+    buckets = snap.get("buckets") or {}
+    target = q * cnt
+    acc = 0
+    last = 0
+    for i in sorted(int(b) for b in buckets):
+        acc += buckets[str(i)]
+        last = i
+        if acc >= target:
+            return hist_bucket_upper_ms(i)
+    return hist_bucket_upper_ms(last)
+
+
+def hist_merge(dst, src):
+    """Merge histogram snapshot `src` into dict `dst` in place (fleet
+    aggregation: sum counts/totals bucket-wise, refresh quantiles)."""
+    dst["count"] = dst.get("count", 0) + src.get("count", 0)
+    dst["total_s"] = dst.get("total_s", 0.0) + src.get("total_s", 0.0)
+    db = dst.setdefault("buckets", {})
+    for b, n in (src.get("buckets") or {}).items():
+        db[b] = db.get(b, 0) + n
+    cnt = dst["count"]
+    dst["mean_ms"] = (dst["total_s"] / cnt * 1e3) if cnt else 0.0
+    dst["p50_ms"] = hist_quantile_ms(dst, 0.5)
+    dst["p99_ms"] = hist_quantile_ms(dst, 0.99)
+    return dst
+
+
+def histograms(scope=None):
+    """{"<scope>.<name>": {count, total_s, mean_ms, p50_ms, p99_ms,
+    buckets}} — buckets are sparse {str(index): count} (JSON-safe)."""
+    scopes = [scope] if scope is not None else list(_hist_scopes)
+    out = {}
+    for sc in scopes:
+        for k, rec in list(_hist_scopes.get(sc, {}).items()):
+            cnt, tot = rec[0], rec[1]
+            snap = {"count": cnt, "total_s": tot,
+                    "mean_ms": (tot / cnt * 1e3) if cnt else 0.0,
+                    "buckets": {str(i): n for i, n in enumerate(rec[2])
+                                if n}}
+            snap["p50_ms"] = hist_quantile_ms(snap, 0.5)
+            snap["p99_ms"] = hist_quantile_ms(snap, 0.99)
+            out[f"{sc}.{k}"] = snap
+    return out
+
+
 def counters(scope=None):
     """Flat snapshot: {"<scope>.<name>": value} (or one scope's dict)."""
     if scope is not None:
@@ -139,9 +261,16 @@ def timings(scope=None):
     out = {}
     for sc in scopes:
         for k, rec in list(_timing_scopes.get(sc, {}).items()):
-            cnt, tot = rec
-            out[f"{sc}.{k}"] = {"count": cnt, "total_s": tot,
-                                "mean_ms": (tot / cnt * 1e3) if cnt else 0.0}
+            cnt, tot = rec[0], rec[1]
+            entry = {"count": cnt, "total_s": tot,
+                     "mean_ms": (tot / cnt * 1e3) if cnt else 0.0}
+            res = rec[2] if len(rec) > 2 else None
+            if res:
+                srt = sorted(res)
+                entry["p50_ms"] = srt[len(srt) // 2] * 1e3
+                entry["p99_ms"] = srt[min(len(srt) - 1,
+                                          int(len(srt) * 0.99))] * 1e3
+            out[f"{sc}.{k}"] = entry
     return out
 
 
@@ -150,7 +279,8 @@ def gauges():
 
 
 def snapshot():
-    return {"counters": counters(), "gauges": gauges(), "timings": timings()}
+    return {"counters": counters(), "gauges": gauges(),
+            "timings": timings(), "hists": histograms()}
 
 
 def reset(scope=None):
@@ -168,5 +298,8 @@ def reset(scope=None):
         for sc, s in list(_timing_scopes.items()):
             if scope is None or sc == scope:
                 s.clear()
+        for sc, h in list(_hist_scopes.items()):
+            if scope is None or sc == scope:
+                h.clear()
         if scope is None:
             _gauges.clear()

@@ -1,0 +1,337 @@
+"""paddle_tpu.profiler.tracing — fleet-wide request traces + flight recorder.
+
+Three pieces, all process-local and allocation-bounded, that together
+give one joined view of a request's life across router, pods and
+engines (ISSUE 18):
+
+* **Trace context.** A request's trace_id is a splitmix64 hash of its
+  router-pinned sampling seed — pure data, no wire-unique state — so a
+  pod that dies and has its orphan replayed bitwise (same seed, PR 11)
+  emits spans that land in the SAME trace as the first attempt. Spans
+  are (trace_id, name, t0, t1, tid) in local `clock()` seconds,
+  appended to a bounded ring only while `enabled()`; a disabled process
+  pays one attribute load per span site.
+
+* **Clock alignment.** Every process's span clock is `time.monotonic`
+  (arbitrary epoch — the same clock the scheduler stamps request
+  lifecycle timestamps with, so those timestamps are span endpoints
+  without conversion). Alignment data rides the existing
+  channels — no new sockets: each process can report `clock()` ("here
+  is my now") inside a request/reply exchange, and the caller computes
+  `offset = (t_send + t_recv) / 2 - remote_now` (the classic
+  store-handshake midpoint estimate, error bounded by RTT/2).
+  `clock_anchor()` (wall minus monotonic) is the zero-RTT fallback
+  for same-host processes whose wall clocks agree.
+
+* **Flight recorder.** An always-on bounded ring of request lifecycle
+  events (admit, prefill, token milestones, swap, fatal) —
+  `dump_flight_recorder()` writes it as JSON next to the PR 12 stack
+  dump when a process is about to die (FatalEngineError, watchdog trip,
+  injected pod kill), and `ServingFleet` collects the files post-mortem.
+
+`FleetTraceCollector` merges span buffers shipped from many processes
+(pods piggyback theirs on `stats`/`drain` replies) into one
+chrome-trace JSON: one file, one trace_id per request, spans from every
+pid on a common aligned timebase. `load_profiler_result` reads it back
+and `tools/stats_dump.py --traces` renders the per-request waterfall.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+
+clock = time.monotonic
+
+_lock = threading.Lock()
+_MASK = (1 << 64) - 1
+
+# ------------------------------------------------------------ trace ids --
+
+
+def trace_id_for_seed(seed):
+    """Deterministic 16-hex trace id from a request's pinned sampling
+    seed (splitmix64 finalizer). The router pins every request's seed
+    before routing, and an orphan replay reuses it — so both attempts
+    hash to the same trace and the merged timeline shows the whole
+    story, death and replay included."""
+    x = (int(seed) + 0x9E3779B97F4A7C15) & _MASK
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _MASK
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _MASK
+    x ^= x >> 31
+    return f"{x:016x}"
+
+
+# ------------------------------------------------------------ span ring --
+
+_enabled = os.environ.get("PADDLE_TPU_TRACE", "") not in ("", "0")
+_span_cap = int(os.environ.get("PADDLE_TPU_TRACE_RING", "8192"))
+_spans: list = []
+_spans_dropped = 0
+
+
+def enabled():
+    return _enabled
+
+
+def enable(capacity=None):
+    """Start recording spans (idempotent). ``capacity`` bounds the ring;
+    spans past the cap are dropped and counted, never grown — the ring
+    is expected to be drained by periodic `stats` pulls."""
+    global _enabled, _span_cap
+    if capacity is not None:
+        _span_cap = int(capacity)
+    _enabled = True
+
+
+def disable():
+    global _enabled
+    _enabled = False
+
+
+def add_span(trace_id, name, t0, t1, tid=None):
+    """Record one closed span. Hot-path shape: one boolean load when
+    disabled; one append when enabled. Callers on replay fast paths must
+    sit AROUND the executable call, never inside the per-op loop."""
+    global _spans_dropped
+    if not _enabled:
+        return
+    if len(_spans) >= _span_cap:
+        _spans_dropped += 1
+        return
+    _spans.append((trace_id or "", name,
+                   tid if tid is not None else threading.get_ident(),
+                   t0, t1))
+
+
+class span:
+    """``with span(trace_id, "prefill"):`` — records one span on exit.
+    When tracing is disabled the body runs with zero bookkeeping."""
+
+    __slots__ = ("_trace", "_name", "_t0")
+
+    def __init__(self, trace_id, name):
+        self._trace = trace_id
+        self._name = name
+
+    def __enter__(self):
+        self._t0 = clock() if _enabled else 0.0
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0:
+            add_span(self._trace, self._name, self._t0, clock())
+        return False
+
+
+def drain_spans():
+    """Return-and-clear the local span buffer as JSON-friendly lists
+    ``[trace_id, name, tid, t0, t1]`` (local clock seconds). This is
+    what a pod ships inside its `stats` / `drain` replies."""
+    global _spans_dropped
+    with _lock:
+        out = [list(s) for s in _spans]
+        _spans.clear()
+        _spans_dropped = 0
+    return out
+
+
+def spans_dropped():
+    return _spans_dropped
+
+
+def pending_spans():
+    return len(_spans)
+
+
+# ------------------------------------------------------ clock alignment --
+
+
+def clock_anchor():
+    """Wall-clock epoch of this process's span clock: adding the anchor
+    to a local `clock()` reading yields wall time. Same-host processes
+    share a wall clock, so exchanging anchors aligns their spans with
+    zero handshake; cross-host, prefer `offset_from_exchange`."""
+    return time.time() - clock()
+
+
+def offset_from_exchange(t_send, t_recv, remote_now):
+    """Clock offset (add to REMOTE timestamps to land on the LOCAL
+    clock) from one request/reply exchange: the remote sampled its clock
+    (`remote_now`) somewhere between our `t_send` and `t_recv`, so the
+    midpoint estimate is off by at most RTT/2. This is the TCPStore-style
+    handshake ridden over the existing pod line-JSON socket."""
+    return (t_send + t_recv) / 2.0 - remote_now
+
+
+# ------------------------------------------------------ fleet collector --
+
+
+class FleetTraceCollector:
+    """Merge per-process span buffers into one chrome-trace document.
+
+    Each contributing process registers under a label ("router",
+    "pod0", ...) with a clock offset that maps its local span clock onto
+    the collector's (the router's) clock. `add_spans` is cumulative —
+    pods ship incremental buffers on every `stats` pull and a final one
+    in the `drain` reply; the collector just keeps appending."""
+
+    def __init__(self):
+        self._procs: dict = {}  # label -> {"pid", "offset", "spans"}
+
+    def set_process(self, label, pid=None, offset=0.0):
+        p = self._procs.get(label)
+        if p is None:
+            p = self._procs[label] = {"pid": pid, "offset": float(offset),
+                                      "spans": []}
+        else:
+            if pid is not None:
+                p["pid"] = pid
+            p["offset"] = float(offset)
+        return p
+
+    def add_spans(self, label, spans, pid=None, offset=None):
+        p = self._procs.get(label)
+        if p is None:
+            p = self.set_process(label, pid=pid,
+                                 offset=0.0 if offset is None else offset)
+        else:
+            if pid is not None:
+                p["pid"] = pid
+            if offset is not None:
+                p["offset"] = float(offset)
+        p["spans"].extend(spans)
+
+    def span_count(self):
+        return sum(len(p["spans"]) for p in self._procs.values())
+
+    def _aligned(self):
+        """Yield (label, pid, trace_id, name, tid, t0, t1) with t0/t1 on
+        the collector's clock."""
+        for label, p in sorted(self._procs.items()):
+            off = p["offset"]
+            pid = p["pid"] if p["pid"] is not None else abs(hash(label)) % 10**6
+            for s in p["spans"]:
+                trace_id, name, tid, t0, t1 = s
+                yield label, pid, trace_id, name, tid, t0 + off, t1 + off
+
+    def traces(self):
+        """{trace_id: [span dicts sorted by aligned start]} — the
+        per-request view (spans with no trace_id group under ""). """
+        out: dict = {}
+        for label, pid, trace_id, name, tid, t0, t1 in self._aligned():
+            out.setdefault(trace_id, []).append(
+                {"name": name, "proc": label, "pid": pid, "tid": tid,
+                 "t0": t0, "t1": t1})
+        for spans in out.values():
+            spans.sort(key=lambda s: (s["t0"], s["t1"]))
+        return out
+
+    def to_chrome_trace(self, meta=None):
+        """One chrome-trace doc: "X" events carry their trace_id in
+        args (chrome://tracing shows it on click; stats_dump --traces
+        groups by it), plus process_name metadata rows so the per-pid
+        lanes read as router/pod0/pod1."""
+        evs = []
+        for label, p in sorted(self._procs.items()):
+            pid = p["pid"] if p["pid"] is not None else abs(hash(label)) % 10**6
+            evs.append({"name": "process_name", "ph": "M", "pid": pid,
+                        "args": {"name": label}})
+        for label, pid, trace_id, name, tid, t0, t1 in self._aligned():
+            ev = {"name": name, "ph": "X", "cat": "trace",
+                  "ts": round(t0 * 1e6, 3),
+                  "dur": round((t1 - t0) * 1e6, 3),
+                  "pid": pid, "tid": tid}
+            if trace_id:
+                ev["args"] = {"trace_id": trace_id}
+            evs.append(ev)
+        doc = {"traceEvents": evs, "displayTimeUnit": "ms"}
+        full_meta = {"clock_offsets": {label: p["offset"]
+                                       for label, p in self._procs.items()}}
+        if meta:
+            full_meta.update(meta)
+        doc["paddle_tpu"] = full_meta
+        return doc
+
+    def write(self, path, meta=None):
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(meta), f)
+        return path
+
+
+# ------------------------------------------------------ flight recorder --
+
+_FLIGHT_CAP = int(os.environ.get("PADDLE_TPU_FLIGHT_RING", "256"))
+_flight: collections.deque = collections.deque(maxlen=_FLIGHT_CAP)
+
+
+def flight(event, rid=None, trace_id=None, **detail):
+    """Record one request-lifecycle event in the always-on bounded ring.
+    Cost: one tuple + deque append; sits at per-request (not per-op)
+    frequency, so it stays off every fast path."""
+    _flight.append((time.time(), event, rid, trace_id, detail or None))
+
+
+def flight_events():
+    out = []
+    for t, event, rid, trace_id, detail in list(_flight):
+        rec = {"t": t, "event": event}
+        if rid is not None:
+            rec["rid"] = rid
+        if trace_id:
+            rec["trace_id"] = trace_id
+        if detail:
+            rec["detail"] = detail
+        # newest last — the tail is what ran as the process died
+        out.append(rec)
+    return out
+
+
+def flight_clear():
+    _flight.clear()
+
+
+def flight_dump_path():
+    """Where this process's flight dump lands: ``PADDLE_TPU_FLIGHT_DIR``
+    (the fleet points every pod at its log dir) + a tag that survives
+    respawn counting (``flight_<tag>_<pid>.json``)."""
+    d = os.environ.get("PADDLE_TPU_FLIGHT_DIR")
+    if not d:
+        return None
+    tag = os.environ.get("PADDLE_TPU_FLIGHT_TAG") or f"pid{os.getpid()}"
+    return os.path.join(d, f"flight_{tag}_{os.getpid()}.json")
+
+
+def dump_flight_recorder(reason="", path=None, extra=None):
+    """Write the ring to ``path`` (default `flight_dump_path()`,
+    falling back to the tempdir so a dump is never silently lost).
+    Swallows I/O errors — this runs on paths that are already dying and
+    must not mask the original failure. Returns the path or None."""
+    if path is None:
+        path = flight_dump_path()
+    if path is None:
+        import tempfile
+
+        path = os.path.join(tempfile.gettempdir(),
+                            f"paddle_flight_pid{os.getpid()}.json")
+    doc = {"schema": "paddle_tpu.flight/1", "reason": reason,
+           "pid": os.getpid(), "wall_time": time.time(),
+           "clock_anchor": clock_anchor(), "events": flight_events()}
+    if extra:
+        doc["extra"] = extra
+    try:
+        with open(path, "w") as f:
+            json.dump(doc, f)
+    except OSError:
+        return None
+    return path
+
+
+def load_flight_dump(path):
+    with open(path) as f:
+        doc = json.load(f)
+    if doc.get("schema") != "paddle_tpu.flight/1":
+        raise ValueError(f"{path}: not a flight-recorder dump")
+    return doc

@@ -68,6 +68,7 @@ from ..core.tensor import Tensor
 from ..profiler import RecordEvent
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
+from ..profiler import tracing as _tracing
 from ..testing import faults as _faults
 from . import sampling as _sampling
 from .block_pool import BlockPool, PagePoolExhausted, RadixPrefixCache
@@ -292,6 +293,10 @@ class GenerationEngine:
         # allocated, chunks are landing) nor active (it must not join the
         # decode batch until its first token is sampled).
         self._mid_prefill: dict = {}
+        # fleet tracing (ISSUE 18): slot -> trace id, derived from the
+        # request seed at admission (or carried inside a KV-handoff
+        # payload) so engine-level spans tag the request they serve
+        self._slot_trace: dict = {}
 
         # seed-determinism root: one split of the global generator, so
         # paddle_tpu.seed(s) pins every sampled token this engine produces.
@@ -364,6 +369,7 @@ class GenerationEngine:
         self._active[slot] = False
         self._cur_lens[slot] = 0
         self._gen_idx[slot] = 0
+        self._slot_trace.pop(slot, None)
         self._fast = None  # slot membership changed: rebuild + re-radar
 
     def slot_len(self, slot):
@@ -629,6 +635,7 @@ class GenerationEngine:
         would serve a franken-model (prefix under old weights, suffix
         under new) — the weight-generation bump makes every cached prefix
         unmatchable, so post-swap requests recompute their prefixes."""
+        t0 = _tracing.clock() if _tracing.enabled() else 0.0
         resolved = self._resolve_swap_state(state)
         staged = self._stage_swap(resolved, self._names, self._state)
         if _faults.ACTIVE:
@@ -645,6 +652,12 @@ class GenerationEngine:
         self.prefix_cache.new_generation()
         self._note_pool()
         _counters["weight_swaps"] += 1
+        if t0:
+            # swap-boundary span: process-level (no single request owns
+            # it), marks the wall every in-flight stream decoded across
+            _tracing.add_span(None, "swap_weights", t0, _tracing.clock())
+        _tracing.flight("swap_weights", weights=len(staged), source=source,
+                        generation=self.prefix_cache.generation)
         _explain.record(
             "serving_weight_swap", op="swap_weights",
             why=f"swapped {len(staged)} weights"
@@ -836,17 +849,22 @@ class GenerationEngine:
         ``can_admit`` pre-check makes that unreachable in normal
         operation)."""
         prompt = self._check_prompt(slot, prompt_ids)
+        trace = _tracing.trace_id_for_seed(seed) if seed is not None \
+            else None
         table_ids, bt_row, P = self._admit_blocks(prompt, max_new_tokens)
         key = self._request_key(seed)
         try:
-            tok = self._prefill_call(prompt[P:], len(prompt), P, bt_row,
-                                     key, temperature, top_k, top_p)
+            with _tracing.span(trace, "prefill"):
+                tok = self._prefill_call(prompt[P:], len(prompt), P,
+                                         bt_row, key, temperature, top_k,
+                                         top_p)
         except Exception:
             self.pool.decref(table_ids)  # failed admission leaks nothing
             self._note_pool()
             raise
         self._install_slot(slot, prompt, table_ids, bt_row, tok, key,
                            temperature, top_k, top_p, P, max_new_tokens)
+        self._slot_trace[slot] = trace
         return tok
 
     # -------------------------------------------------- chunked prefill --
@@ -878,6 +896,8 @@ class GenerationEngine:
             "key": self._request_key(seed), "temperature": temperature,
             "top_k": top_k, "top_p": top_p, "matched": P,
             "max_new_tokens": max_new_tokens,
+            "trace": _tracing.trace_id_for_seed(seed)
+            if seed is not None else None,
         }
         self._note_pool()
         _counters["chunked_prefills"] += 1
@@ -897,10 +917,11 @@ class GenerationEngine:
         prompt, start = st["prompt"], st["done"]
         end = min(start + st["chunk"], len(prompt))
         try:
-            tok = self._prefill_call(
-                prompt[start:end], end, start, st["bt_row"], st["key"],
-                st["temperature"], st["top_k"], st["top_p"])
-            self._chunk_extra(slot, prompt, start, end)
+            with _tracing.span(st.get("trace"), "prefill_chunk"):
+                tok = self._prefill_call(
+                    prompt[start:end], end, start, st["bt_row"], st["key"],
+                    st["temperature"], st["top_k"], st["top_p"])
+                self._chunk_extra(slot, prompt, start, end)
         except Exception:
             # drop the chunk state; reserved extras (drafter blocks)
             # come back when the scheduler releases the slot
@@ -917,6 +938,7 @@ class GenerationEngine:
             slot, prompt, st["table_ids"], st["bt_row"], tok, st["key"],
             st["temperature"], st["top_k"], st["top_p"], st["matched"],
             st["max_new_tokens"])
+        self._slot_trace[slot] = st.get("trace")
         return tok
 
     # --------------------------------------------- prefill→decode handoff --
@@ -935,11 +957,17 @@ class GenerationEngine:
         if not self._active[slot]:
             raise RuntimeError(f"slot {slot} is not active; nothing to "
                                "export")
+        trace = self._slot_trace.get(slot)
+        t0 = _tracing.clock() if _tracing.enabled() else 0.0
         ids = list(self._slot_blocks[slot])
         idx = jnp.asarray(np.asarray(ids, np.int32))
         ks = [np.asarray(jnp.take(a, idx, axis=0)) for a in self._k]
         vs = [np.asarray(jnp.take(a, idx, axis=0)) for a in self._v]
         _counters["handoff_exports"] += 1
+        if t0:
+            _tracing.add_span(trace, "kv_export", t0, _tracing.clock())
+        _tracing.flight("kv_export", trace_id=trace, slot=slot,
+                        blocks=len(ids))
         return {
             "n_blocks": len(ids),
             "block_size": self.block_size,
@@ -952,6 +980,10 @@ class GenerationEngine:
             "top_k": int(self._top_ks[slot]),
             "top_p": float(self._top_ps[slot]),
             "weight_generation": self.prefix_cache.generation,
+            # trace context rides the handoff payload: the decode pod's
+            # import span lands in the SAME trace without any extra wire
+            # field between pods
+            "trace": trace,
         }
 
     def can_import(self, payload):
@@ -978,6 +1010,7 @@ class GenerationEngine:
         pod."""
         if self._active[slot]:
             raise RuntimeError(f"slot {slot} is still active")
+        t0 = _tracing.clock() if _tracing.enabled() else 0.0
         gen = payload.get("weight_generation")
         if gen is not None and int(gen) != self.prefix_cache.generation:
             # a weight swap landed between the export and this import:
@@ -1034,6 +1067,7 @@ class GenerationEngine:
                 created = self.prefix_cache.insert(
                     prompt[:full * self.block_size], fresh[:full])
                 _counters["prefix_inserted_blocks"] += created
+        self._slot_trace[slot] = payload.get("trace")
         self._slot_blocks[slot] = fresh
         self._block_tables[slot] = bt_row
         self._active[slot] = True
@@ -1048,6 +1082,11 @@ class GenerationEngine:
         self._note_pool()
         _counters["handoff_imports"] += 1
         _counters["tokens_generated"] += 1  # the adopted first token
+        if t0:
+            _tracing.add_span(payload.get("trace"), "kv_import", t0,
+                              _tracing.clock())
+        _tracing.flight("kv_import", trace_id=payload.get("trace"),
+                        slot=slot, blocks=n)
         return int(payload["last_token"])
 
     # ------------------------------------------------------------- decode --

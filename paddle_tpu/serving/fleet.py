@@ -73,6 +73,7 @@ import time
 
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
+from ..profiler import tracing as _tracing
 from .router import FleetRouter, PodClient
 from .scheduler import RequestStatus
 
@@ -183,6 +184,11 @@ class ServingFleet:
         self._monitor = None
         self._redistributor = None
         self._started = False
+        # fleet-wide trace merge: the router process is the reference
+        # clock (offset 0); pod offsets come from the stats-reply
+        # midpoint handshake (no extra sockets)
+        self.trace = _tracing.FleetTraceCollector()
+        self.trace.set_process("router", pid=os.getpid(), offset=0.0)
 
     # ------------------------------------------------------------ control --
     @property
@@ -265,7 +271,16 @@ class ServingFleet:
             "PADDLE_POD_PORT_FILE": port_file,
             "PYTHONPATH": _repo_root() + os.pathsep
             + env.get("PYTHONPATH", ""),
+            # a dying pod's flight recorder lands next to its log so the
+            # fleet (or a human) can read it post-mortem
+            "PADDLE_TPU_FLIGHT_DIR": self._log_dir,
+            "PADDLE_TPU_FLIGHT_TAG": f"pod{idx}",
         })
+        if _tracing.enabled():
+            # tracing in the router process turns it on fleet-wide: the
+            # pods inherit the flag at spawn and ship spans back on
+            # stats/drain replies
+            env["PADDLE_TPU_TRACE"] = "1"
         if plat:
             env["JAX_PLATFORMS"] = plat
         if per_env:
@@ -310,6 +325,18 @@ class ServingFleet:
             h.retired = True
             h.drained = True
             return
+        # a dying pod dumps its flight recorder on the way out (fatal
+        # engine error, watchdog trip, injected kill) — surface the
+        # post-mortem file(s) in the death record
+        dumps = [p for p in self.flight_dumps()
+                 if os.path.basename(p).startswith(f"flight_pod{h.idx}_")]
+        if dumps:
+            _explain.record(
+                "fleet_flight_dump", op="supervise",
+                why=f"pod {h.idx} died (rc={rc}); its flight-recorder "
+                    f"dump(s) hold the last request lifecycle events: "
+                    f"{dumps}",
+                pod=h.idx, rc=rc, paths=dumps)
         if h.restarts >= self.max_restarts:
             h.retired = True
             _counters["pods_retired"] += 1
@@ -421,7 +448,11 @@ class ServingFleet:
                 continue
             reply = None
             if not h.retired and h.client.alive:
+                t_send = _tracing.clock()
                 reply = h.client.call({"op": "stats"}, timeout=timeout)
+                if reply is not None:
+                    self._harvest_trace(h, reply, t_send,
+                                        _tracing.clock())
             per_pod[h.idx] = {
                 "role": h.role, "retired": h.retired,
                 "restarts": h.restarts,
@@ -431,12 +462,69 @@ class ServingFleet:
             }
         hits = sum(p.get("prefix_hits", 0) for p in per_pod.values())
         misses = sum(p.get("prefix_misses", 0) for p in per_pod.values())
+        hists: dict = {}
+        for p in per_pod.values():
+            for name, snap in (p.get("hists") or {}).items():
+                _registry.hist_merge(hists.setdefault(name, {}), snap)
         return {
             "pods": per_pod,
             "router": self.router.stats(),
+            "hists": hists,
             "prefix_hit_rate": hits / (hits + misses)
             if hits + misses else 0.0,
         }
+
+    def _harvest_trace(self, h, reply, t_send, t_recv):
+        """Fold the span buffer a pod piggybacked on a stats/drain reply
+        into the fleet collector. The pod's clock offset comes from the
+        reply's own `mono_now` bracketed by our send/recv stamps (RTT/2
+        midpoint error) — the handshake rides the exchange that was
+        happening anyway, no extra sockets or round-trips."""
+        spans = reply.pop("spans", None)
+        remote_now = reply.pop("mono_now", None)
+        anchor = reply.pop("clock_anchor", None)
+        reply.pop("spans_dropped", None)
+        if not spans:
+            return
+        if remote_now is not None:
+            offset = _tracing.offset_from_exchange(t_send, t_recv,
+                                                   remote_now)
+        elif anchor is not None:
+            # same-host fallback: both wall clocks agree, so the anchor
+            # difference maps pod-monotonic onto router-monotonic
+            offset = float(anchor) - _tracing.clock_anchor()
+        else:
+            offset = 0.0
+        try:
+            pid = self._pod.procs[h.idx].pid
+        except (IndexError, AttributeError):
+            pid = None
+        self.trace.add_spans(f"pod{h.idx}", spans, pid=pid,
+                             offset=offset)
+
+    def collect_trace(self, path=None):
+        """Pull every pod's pending spans (one stats round per pod via
+        `stats()`), fold in the router's own buffer, and return the
+        merged chrome-trace doc — ONE file, every process's spans on the
+        router's clock, each span tagged with its request's trace_id.
+        Writes JSON to ``path`` when given."""
+        if self._started:
+            self.stats()
+        self.trace.add_spans("router", _tracing.drain_spans(),
+                             pid=os.getpid(), offset=0.0)
+        if path is not None:
+            return self.trace.write(path)
+        return self.trace.to_chrome_trace()
+
+    def flight_dumps(self):
+        """Flight-recorder dump files left in the fleet log dir by pods
+        that died (or were killed) — ``flight_pod<idx>_<pid>.json``."""
+        import glob
+
+        if not self._log_dir:
+            return []
+        return sorted(glob.glob(
+            os.path.join(self._log_dir, "flight_*.json")))
 
     def pods_alive(self):
         return len([h for h in self._handles
@@ -460,10 +548,17 @@ class ServingFleet:
                     continue
 
                 def _drain(hh=h):
-                    if hh.client.call(
-                            {"op": "drain", "timeout": timeout},
-                            timeout=timeout + 10.0) is not None:
+                    t_send = _tracing.clock()
+                    reply = hh.client.call(
+                        {"op": "drain", "timeout": timeout},
+                        timeout=timeout + 10.0)
+                    if reply is not None:
                         hh.drained = True
+                        # the pod's FINAL span buffer rides the
+                        # drain_done reply — after this the process is
+                        # gone
+                        self._harvest_trace(hh, reply, t_send,
+                                            _tracing.clock())
 
                 t = threading.Thread(target=_drain, daemon=True)
                 t.start()

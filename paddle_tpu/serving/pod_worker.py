@@ -99,12 +99,14 @@ class _PrefillSwapShim:
 class PodWorker:
     def __init__(self, spec):
         from paddle_tpu.profiler import registry as _registry
+        from paddle_tpu.profiler import tracing as _tracing
         from paddle_tpu.serving.engine import GenerationEngine
         from paddle_tpu.serving.server import (CheckpointFollower,
                                                GenerationServer)
         from paddle_tpu.testing import faults as _faults
 
         self._registry = _registry
+        self._tracing = _tracing
         self._faults = _faults
         self.spec = spec
         self.role = spec.get("role", "serve")
@@ -206,6 +208,8 @@ class PodWorker:
         while True:
             if self.server is not None \
                     and self.server.fatal_error is not None:
+                self._tracing.dump_flight_recorder(
+                    reason=f"pod fatal: {self.server.fatal_error}")
                 os._exit(17)
             time.sleep(0.02)
 
@@ -240,6 +244,8 @@ class PodWorker:
                 from paddle_tpu.serving.engine import FatalEngineError
 
                 if isinstance(e, FatalEngineError):
+                    self._tracing.dump_flight_recorder(
+                        reason=f"fatal in op {op!r}: {e}")
                     os._exit(17)
                 send({"op": "error", "mid": msg.get("mid"),
                       "error": f"{type(e).__name__}: {e}"})
@@ -275,6 +281,7 @@ class PodWorker:
             send(self._ack(msg["mid"]))
             return
         req = GenerationRequest(msg["prompt"], **self._options(msg))
+        req.trace_id = msg.get("trace")
         try:
             self.server.submit_request(req)
         except (QueueFullError, RuntimeError) as e:
@@ -308,6 +315,7 @@ class PodWorker:
             send(self._ack(msg["mid"]))
             return
         req = GenerationRequest(msg["prompt"], **self._options(msg))
+        req.trace_id = msg.get("trace")
         req.kv_payload = unpack_payload(msg["payload"])
         try:
             self.server.submit_request(req)
@@ -360,7 +368,9 @@ class PodWorker:
         except PagePoolExhausted as e:
             send({"op": "reject", "mid": msg["mid"], "reason": str(e)})
             return
-        except FatalEngineError:
+        except FatalEngineError as e:
+            self._tracing.dump_flight_recorder(
+                reason=f"fatal in prefill: {e}")
             os._exit(17)
         except Exception as e:
             # off the handler loop now: this thread owns its own error
@@ -436,7 +446,14 @@ class PodWorker:
               "timings": {k: {"count": v.get("count"),
                               "mean_ms": v.get("mean_ms")}
                           for k, v in
-                          self._registry.timings("serving").items()}})
+                          self._registry.timings("serving").items()},
+              "hists": self._registry.histograms("serving"),
+              "spans": self._tracing.drain_spans(),
+              "spans_dropped": self._tracing.spans_dropped(),
+              "clock_anchor": self._tracing.clock_anchor(),
+              # sampled as late as possible: the router midpoints its
+              # send/recv stamps against this for the clock offset
+              "mono_now": self._tracing.clock()})
 
     def _op_drain(self, msg, send):
         """Graceful retirement: finish every queued + in-flight request,
@@ -445,7 +462,10 @@ class PodWorker:
         if self.server is not None:
             self.server.shutdown(drain=True,
                                  timeout=float(msg.get("timeout", 60.0)))
-        send({"op": "drain_done", "mid": msg["mid"]})
+        send({"op": "drain_done", "mid": msg["mid"],
+              "spans": self._tracing.drain_spans(),
+              "clock_anchor": self._tracing.clock_anchor(),
+              "mono_now": self._tracing.clock()})
         os._exit(0)
 
     # ------------------------------------------------------------ helpers --

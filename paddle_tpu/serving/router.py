@@ -69,6 +69,7 @@ import numpy as np
 
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
+from ..profiler import tracing as _tracing
 from ..testing import faults as _faults
 from .scheduler import QueueFullError, RequestStatus
 
@@ -128,6 +129,8 @@ class FleetRequest:
             raise ValueError("prompt_ids must not be empty")
         self.options = dict(options)
         self.rid = None
+        self.trace_id = None     # derived from the pinned seed
+        self.submit_ts = None    # router clock at submit (tracing only)
         self.pod = None          # pod id the request is currently on
         self.attempts = 0        # route attempts (resubmits included)
         self.tokens: list = []
@@ -399,6 +402,14 @@ class FleetRouter:
             options["seed"] = next(self._seeds)
         req = FleetRequest(prompt_ids, options)
         req.rid = next(self._rid)
+        # the trace id is a pure function of the pinned seed, so an
+        # orphan replay (same seed, different pod) joins the SAME trace
+        req.trace_id = _tracing.trace_id_for_seed(options["seed"])
+        if _tracing.enabled():
+            req.submit_ts = _tracing.clock()
+        _tracing.flight("route_submit", rid=req.rid,
+                        trace_id=req.trace_id,
+                        prompt_len=len(req.prompt_ids))
         with self._lock:
             self._reqs[req.rid] = req
         _counters["requests_routed"] += 1
@@ -519,7 +530,8 @@ class FleetRouter:
             else:
                 reply = rec.client.call(
                     {"op": "submit", "rid": req.rid,
-                     "prompt": req.prompt_ids, "options": req.options},
+                     "prompt": req.prompt_ids, "options": req.options,
+                     "trace": req.trace_id},
                     timeout=self.ack_timeout)
             if reply is None:
                 continue  # lost before ack: try the next pod
@@ -527,6 +539,9 @@ class FleetRouter:
                 if not self._bind(req, rec, reply):
                     continue  # pod died as it acked: next candidate
                 self._remember_affinity(req, rec.pod_id, sticky)
+                if req.submit_ts is not None:
+                    _tracing.add_span(req.trace_id, "route",
+                                      req.submit_ts, _tracing.clock())
                 return
             rejects += 1
             _counters["router_rejects"] += 1
@@ -550,10 +565,12 @@ class FleetRouter:
         opts = req.options
         pre_pods, _ = self._candidates(req, roles=("prefill",))
         payload = None
+        h0 = _tracing.clock() if _tracing.enabled() else 0.0
         for rec in pre_pods:
             reply = rec.client.call(
                 {"op": "prefill", "rid": req.rid,
-                 "prompt": req.prompt_ids, "options": opts},
+                 "prompt": req.prompt_ids, "options": opts,
+                 "trace": req.trace_id},
                 timeout=self.prefill_timeout)
             if reply is not None and reply.get("op") == "prefill_done":
                 payload = reply["payload"]
@@ -562,6 +579,11 @@ class FleetRouter:
             self._hold(req)
             return
         _counters["handoffs"] += 1
+        if h0:
+            # prefill RPC + payload hop, as seen from the router — the
+            # pods' own kv_export/kv_import spans nest inside this
+            _tracing.add_span(req.trace_id, "handoff", h0,
+                              _tracing.clock())
         dec_pods, sticky = self._candidates(req, roles=("decode",))
         rejects = 0
         for rec in dec_pods:
@@ -574,7 +596,7 @@ class FleetRouter:
                 reply = rec.client.call(
                     {"op": "adopt", "rid": req.rid,
                      "prompt": req.prompt_ids, "options": opts,
-                     "payload": payload},
+                     "payload": payload, "trace": req.trace_id},
                     timeout=self.ack_timeout)
             if reply is None:
                 continue
@@ -672,6 +694,13 @@ class FleetRouter:
             _counters["requests_completed"] += 1
         else:
             _counters["requests_failed"] += 1
+        if req.submit_ts is not None:
+            # full router-side lifetime: submit → completion callback
+            _tracing.add_span(req.trace_id, "request", req.submit_ts,
+                              _tracing.clock())
+        _tracing.flight("fleet_finish", rid=req.rid,
+                        trace_id=req.trace_id, status=str(status),
+                        pod=req.pod)
         req.finished.set()
         with self._lock:
             self._reqs.pop(req.rid, None)

@@ -17,7 +17,10 @@ transitions set a ``threading.Event`` so a frontend can block on
 driven from a single thread (``serving.GenerationServer`` owns that loop).
 
 Telemetry: ``serving.requests_*`` counters, ``serving.queue_wait`` /
-``serving.ttft`` timings, and a running ``serving.tokens_per_sec`` gauge.
+``serving.ttft`` timings, log2 latency histograms (``ttft``,
+``inter_token``, ``queue_wait``), per-request trace spans
+(queue_wait → prefill/admit → decode) and a running
+``serving.tokens_per_sec`` gauge.
 """
 from __future__ import annotations
 
@@ -28,6 +31,7 @@ import time
 
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
+from ..profiler import tracing as _tracing
 from .block_pool import PagePoolExhausted
 from .engine import FatalEngineError, StaleHandoffError
 
@@ -94,6 +98,13 @@ class GenerationRequest:
         self.submit_ts = None
         self.deadline = None
         self.ttft_s = None
+        # fleet tracing (ISSUE 18): the router ships an explicit trace
+        # id with handed-off requests; locally submitted requests derive
+        # one from the pinned seed at submit() — both hash the same seed
+        # so an orphan replay joins the original trace
+        self.trace_id = None
+        self.first_tok_ts = None
+        self.last_tok_ts = None
 
     @property
     def done(self):
@@ -158,8 +169,13 @@ class ContinuousBatchScheduler:
             if request.timeout_s is not None:
                 request.deadline = request.submit_ts + request.timeout_s
             request.status = RequestStatus.QUEUED
+            if request.trace_id is None and request.seed is not None:
+                request.trace_id = _tracing.trace_id_for_seed(request.seed)
             self._queue.append(request)
             _counters["requests_submitted"] += 1
+        _tracing.flight("submit", rid=request.rid,
+                        trace_id=request.trace_id,
+                        prompt_len=len(request.prompt_ids))
         return request
 
     def has_work(self):
@@ -389,6 +405,7 @@ class ContinuousBatchScheduler:
                 now = time.monotonic()
                 req.ttft_s = now - req.submit_ts
                 _registry.timing("ttft", req.ttft_s, scope="serving")
+                _registry.hist_record("ttft", req.ttft_s)
                 self._append_token(req, first, now)
 
         # (3) one decode iteration over every active slot; per-request
@@ -398,6 +415,9 @@ class ContinuousBatchScheduler:
         # slot per iteration — each bitwise-equal to plain decode's — and
         # stop conditions are applied per token in emission order.
         if self._active:
+            # decode-iteration span: ONE ring append per iteration when
+            # tracing is on (never per slot / per token), zero work off
+            it0 = _tracing.clock() if _tracing.enabled() else 0.0
             spec = getattr(self.engine, "decode_step_spec", None)
             if spec is not None:
                 per_slot = self._decode_with_retry(spec)
@@ -415,6 +435,8 @@ class ContinuousBatchScheduler:
                 now = time.monotonic()
                 for slot, req in list(self._active.items()):
                     self._append_token(req, int(toks[slot]), now)
+            if it0:
+                _tracing.add_span(None, "decode_iter", it0, _tracing.clock())
 
         self._update_throughput()
         return self.has_work()
@@ -483,9 +505,15 @@ class ContinuousBatchScheduler:
             req.slot = slot
             req.status = RequestStatus.RUNNING
             self._prefilling[slot] = req
-            _registry.timing("queue_wait", t_start - req.submit_ts,
-                             scope="serving")
+            wait = t_start - req.submit_ts
+            _registry.timing("queue_wait", wait, scope="serving")
+            _registry.hist_record("queue_wait", wait)
+            _tracing.add_span(req.trace_id, "queue_wait",
+                              req.submit_ts, t_start)
+            _tracing.flight("admit_chunked", rid=req.rid,
+                            trace_id=req.trace_id, slot=slot)
             return True
+        handoff = req.kv_payload is not None
         try:
             first = None
             if req.kv_payload is not None:
@@ -538,11 +566,18 @@ class ContinuousBatchScheduler:
         req.slot = slot
         req.status = RequestStatus.RUNNING
         self._active[slot] = req
-        _registry.timing("queue_wait", t_start - req.submit_ts,
-                         scope="serving")
+        wait = t_start - req.submit_ts
+        _registry.timing("queue_wait", wait, scope="serving")
+        _registry.hist_record("queue_wait", wait)
         now = time.monotonic()
         req.ttft_s = now - req.submit_ts
         _registry.timing("ttft", req.ttft_s, scope="serving")
+        _registry.hist_record("ttft", req.ttft_s)
+        _tracing.add_span(req.trace_id, "queue_wait", req.submit_ts, t_start)
+        _tracing.add_span(req.trace_id,
+                          "kv_adopt" if handoff else "admit", t_start, now)
+        _tracing.flight("admit", rid=req.rid, trace_id=req.trace_id,
+                        slot=slot, handoff=handoff)
         self._append_token(req, first, now)
         return True
 
@@ -552,6 +587,13 @@ class ContinuousBatchScheduler:
         # the intermediate tokens — the length stop must see each
         # token's own position, exactly as plain decode would have)
         req.tokens.append(token)
+        # inter-token latency histogram: one frexp + two list stores per
+        # token — rides the per-token bookkeeping that already runs here
+        if req.last_tok_ts is not None:
+            _registry.hist_record("inter_token", now - req.last_tok_ts)
+        else:
+            req.first_tok_ts = now
+        req.last_tok_ts = now
         if slot_len is None and req.slot is not None:
             slot_len = self.engine.slot_len(req.slot)
         if req.eos_id is not None and token == req.eos_id:
@@ -580,6 +622,13 @@ class ContinuousBatchScheduler:
             _counters["requests_timeout"] += 1
         else:
             _counters["requests_failed"] += 1
+        if req.first_tok_ts is not None and req.last_tok_ts is not None \
+                and req.last_tok_ts > req.first_tok_ts:
+            _tracing.add_span(req.trace_id, "decode",
+                              req.first_tok_ts, req.last_tok_ts)
+        _tracing.flight("finish", rid=req.rid, trace_id=req.trace_id,
+                        status=status, stop=req.stop_reason,
+                        tokens=len(req.tokens))
         req.finished.set()
 
     def _update_throughput(self):

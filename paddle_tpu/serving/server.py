@@ -30,6 +30,7 @@ import time
 import zlib
 
 from ..profiler import explainer as _explain
+from ..profiler import tracing as _tracing
 from .engine import FatalEngineError, GenerationEngine
 from .scheduler import (ContinuousBatchScheduler, GenerationRequest,
                         QueueFullError, RequestStatus)
@@ -204,6 +205,12 @@ class GenerationServer:
                             "exiting — supervisor restart / takeover "
                             "required",
                         error=str(e))
+                    # flight recorder: the last N request lifecycle
+                    # events, dumped next to whatever kills the process
+                    # (post-mortem: what was this replica serving?)
+                    _tracing.flight("fatal", error=str(e))
+                    _tracing.dump_flight_recorder(
+                        reason=f"fatal_engine_error: {e}")
                     if self._fail_fast_on_fatal:
                         self.scheduler.cancel_pending(
                             reason=f"fatal engine error: {e}")

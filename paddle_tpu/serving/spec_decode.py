@@ -64,6 +64,7 @@ from ..core import lazy as _lazy
 from ..core.tensor import Tensor
 from ..profiler import explainer as _explain
 from ..profiler import registry as _registry
+from ..profiler import tracing as _tracing
 from ..testing import faults as _faults
 from . import sampling as _sampling
 from .block_pool import BlockPool, PagePoolExhausted
@@ -76,6 +77,10 @@ __all__ = ["DraftVerifyEngine"]
 # speculative-decode counters live in the shared "serving" scope so
 # stats_dump/bench read one table; verify_compiles/draft_compiles feed
 # the engine's signature radar (phases "verify" / "draft")
+# gauge-retention bound for serving.spec_acceptance.gen<N> (ISSUE 18
+# satellite): generations older than the last 4 fold into .historic
+SPEC_ACCEPT_KEEP_GENERATIONS = 4
+
 _counters = _registry.scoped_counters("serving", {
     "spec_rounds": 0, "spec_slot_rounds": 0, "spec_proposed": 0,
     "spec_accepted": 0, "spec_emitted": 0, "draft_prefills": 0,
@@ -235,8 +240,12 @@ class DraftVerifyEngine(GenerationEngine):
         # acceptance per weight generation (stats_dump "mesh serving"
         # section): generation -> [accepted, proposed], so a hot-swap's
         # acceptance recovery (or decay, if the drafter was not swapped)
-        # is readable from stats
+        # is readable from stats. Only the last
+        # SPEC_ACCEPT_KEEP_GENERATIONS generations keep live gauges —
+        # older ones fold into one ".historic" rollup so a long-lived
+        # server with frequent hot-swaps never leaks registry keys
         self._gen_accept = {}
+        self._accept_historic = [0, 0]
         # per-slot token history (prompt + every emitted token, the
         # pending last token included): len == cur_len + 1 for installed
         # slots, and rows 0..cur_len-1 of the drafter's KV always hold
@@ -677,6 +686,9 @@ class DraftVerifyEngine(GenerationEngine):
         (last, lens, keys, gen, temps, tks, tps, act, bt, dbt) = fast
         K = self.draft_k
         dstate = self._draft_arrays()
+        # spec-round span sits AROUND the two executable calls (PR 8
+        # contract: no span work inside the replayed round)
+        rt0 = _tracing.clock() if _tracing.enabled() else 0.0
         with _registry.time_block("decode_step", scope="serving"):
             drafts, ndk, ndv = self._draft_round_jit(
                 dstate, tuple(self._dk), tuple(self._dv), last, lens,
@@ -728,13 +740,34 @@ class DraftVerifyEngine(GenerationEngine):
             _registry.gauge_set(
                 f"serving.spec_acceptance.gen{self.prefix_cache.generation}",
                 round(gen_acc[0] / gen_acc[1], 4))
+            if len(self._gen_accept) > SPEC_ACCEPT_KEEP_GENERATIONS:
+                self._retire_old_generations()
         sc = _serving_counters
         sc["decode_steps"] += 1
         sc["active_slot_steps"] += n_active
         sc["tokens_generated"] += total
         _registry.gauge_set("serving.batch_occupancy",
                             n_active / self.max_batch_size)
+        if rt0:
+            _tracing.add_span(None, "spec_round", rt0, _tracing.clock())
         return out
+
+    def _retire_old_generations(self):
+        """Fold generations beyond the last
+        ``SPEC_ACCEPT_KEEP_GENERATIONS`` into the ``.historic`` rollup
+        and retire their gauges — bounded registry keys no matter how
+        many hot-swaps a server lives through."""
+        while len(self._gen_accept) > SPEC_ACCEPT_KEEP_GENERATIONS:
+            g = min(self._gen_accept)
+            acc, prop = self._gen_accept.pop(g)
+            self._accept_historic[0] += acc
+            self._accept_historic[1] += prop
+            _registry.gauge_drop(f"serving.spec_acceptance.gen{g}")
+        if self._accept_historic[1]:
+            _registry.gauge_set(
+                "serving.spec_acceptance.historic",
+                round(self._accept_historic[0]
+                      / self._accept_historic[1], 4))
 
     def _audit_fast(self, fast):
         """Spec-round audit: base cursor checks plus the drafter's block
@@ -800,6 +833,9 @@ class DraftVerifyEngine(GenerationEngine):
                "accepted_len_mean": self.accepted_len_mean(),
                "acceptance_by_generation":
                    self.acceptance_by_generation(),
+               "acceptance_historic":
+                   (self._accept_historic[0] / self._accept_historic[1]
+                    if self._accept_historic[1] else 0.0),
                "draft_kv_blocks_total": self.draft_pool.usable_blocks,
                "draft_kv_blocks_in_use": self.draft_pool.in_use()}
         if self._mesh is not None:

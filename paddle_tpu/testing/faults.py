@@ -349,6 +349,13 @@ def fire(point, step=None, rank=None, path=None, op=None):
             return False
         _record(point,
                 f"serving pod SIGKILLed at request #{ent['count']}")
+        try:
+            # last gasp before the SIGKILL-style exit: the flight
+            # recorder is the only record of what this pod was doing
+            from paddle_tpu.profiler import tracing as _tracing
+            _tracing.dump_flight_recorder(reason="fault:pod_kill")
+        except Exception:
+            pass
         os._exit(137)
 
     if point == "router_drop":

@@ -332,11 +332,13 @@ class TestDataLoaderTelemetry:
 class TestFastPathTelemetryCost:
     """ISSUE-9 satellite: on a replayed (zero-dispatch) step, telemetry
     is batched into one dict-merge — ZERO calls into the registry's
-    function API (inc/timing/tally/gauge_set) and zero explainer events
-    land per step. A regression here silently re-taxes the hot path."""
+    function API (inc/timing/tally/gauge_set), zero explainer events,
+    and (ISSUE 18) zero histogram records or trace spans land per step.
+    A regression here silently re-taxes the hot path."""
 
     def test_replayed_step_makes_no_registry_calls(self, monkeypatch):
         from paddle_tpu.profiler import explainer as _explainer
+        from paddle_tpu.profiler import tracing as _tracing
 
         paddle.seed(13)
         net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(),
@@ -371,12 +373,21 @@ class TestFastPathTelemetryCost:
 
             return wrapper
 
-        for name in ("inc", "timing", "tally", "gauge_set"):
+        for name in ("inc", "timing", "tally", "gauge_set",
+                     "hist_record"):
             monkeypatch.setattr(registry, name, spy(name))
         orig_record = _explainer.record
         monkeypatch.setattr(
             _explainer, "record",
             lambda *a, **k: calls.append("explain") or orig_record(*a, **k))
+        # trace spans must sit AROUND the executable call, never inside
+        # the replayed loop: with tracing ON, a replayed step still makes
+        # zero add_span calls from this thread's step body
+        monkeypatch.setattr(_tracing, "_enabled", True)
+        orig_span = _tracing.add_span
+        monkeypatch.setattr(
+            _tracing, "add_span",
+            lambda *a, **k: calls.append("span") or orig_span(*a, **k))
 
         from paddle_tpu.core import dispatch as _dispatch
 

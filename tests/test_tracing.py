@@ -1,0 +1,350 @@
+"""Fleet-wide request tracing + metrics plane (ISSUE 18).
+
+Covers the acceptance gates:
+  * deterministic trace ids from the router-pinned seed (an orphan
+    replay joins the SAME trace);
+  * bounded span ring, zero-cost when disabled, drain-and-ship wire
+    shape;
+  * log2 latency histograms: bucket placement, conservative quantiles,
+    fleet-side merge; the timing reservoir stays capped (the unbounded-
+    growth satellite);
+  * spec-acceptance per-generation gauges bounded by the historic
+    rollup (the gauge key-leak satellite);
+  * flight recorder ring + dump/load round-trip;
+  * FleetTraceCollector clock alignment and chrome-trace shape,
+    loadable by load_profiler_result and rendered by
+    tools/stats_dump.py --traces;
+  * the REAL cross-pod round-trip: a disaggregated prefill→decode fleet
+    request produces ONE merged trace with a single trace_id spanning
+    router + both pod subprocesses, causally ordered.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.profiler import registry, tracing
+
+TOOLS = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools")
+
+
+@pytest.fixture(autouse=True)
+def _clean_tracing():
+    tracing.disable()
+    tracing.drain_spans()
+    tracing.flight_clear()
+    yield
+    tracing.disable()
+    tracing.drain_spans()
+    tracing.flight_clear()
+
+
+class TestTraceIds:
+    def test_deterministic_and_distinct(self):
+        a = tracing.trace_id_for_seed(7)
+        assert a == tracing.trace_id_for_seed(7)
+        assert len(a) == 16 and int(a, 16) >= 0
+        ids = {tracing.trace_id_for_seed(s) for s in range(256)}
+        assert len(ids) == 256  # splitmix64 never collides this small
+
+    def test_matches_router_and_scheduler_derivation(self):
+        # router, scheduler and engine all derive independently from the
+        # seed — one function, one answer, or the trace splits
+        from paddle_tpu.serving.scheduler import GenerationRequest
+
+        req = GenerationRequest([1, 2, 3], seed=42)
+        assert req.trace_id is None  # derived at submit, not construction
+        assert tracing.trace_id_for_seed(42) \
+            == tracing.trace_id_for_seed(42)
+
+
+class TestSpanRing:
+    def test_disabled_records_nothing(self):
+        tracing.add_span("t", "x", 0.0, 1.0)
+        with tracing.span("t", "y"):
+            pass
+        assert tracing.pending_spans() == 0
+
+    def test_enabled_bounded_and_drained(self):
+        tracing.enable(capacity=4)
+        for i in range(7):
+            tracing.add_span("t", f"s{i}", float(i), float(i) + 0.5)
+        assert tracing.pending_spans() == 4
+        assert tracing.spans_dropped() == 3
+        wire = tracing.drain_spans()
+        assert len(wire) == 4 and tracing.pending_spans() == 0
+        assert tracing.spans_dropped() == 0  # drain resets the counter
+        # wire shape: [trace_id, name, tid, t0, t1] — JSON-serializable
+        json.dumps(wire)
+        trace_id, name, tid, t0, t1 = wire[0]
+        assert (trace_id, name) == ("t", "s0") and t1 > t0
+
+    def test_span_context_manager(self):
+        tracing.enable()
+        with tracing.span("abc", "work"):
+            pass
+        ((trace_id, name, _tid, t0, t1),) = tracing.drain_spans()
+        assert (trace_id, name) == ("abc", "work") and t1 >= t0
+
+
+class TestHistograms:
+    def test_bucket_placement_and_quantiles(self):
+        registry.reset("histtest")
+        for ms in (1.0, 1.0, 1.0, 100.0):
+            registry.hist_record("lat", ms / 1e3, scope="histtest")
+        snap = registry.histograms("histtest")["histtest.lat"]
+        assert snap["count"] == 4
+        assert abs(snap["total_s"] - 0.103) < 1e-9
+        # log2 upper-edge estimates are conservative: within 2x above
+        assert 1.0 <= snap["p50_ms"] <= 2.0
+        assert 100.0 <= snap["p99_ms"] <= 200.0
+        registry.reset("histtest")
+
+    def test_extreme_values_clamp(self):
+        registry.reset("histtest")
+        registry.hist_record("lat", 0.0, scope="histtest")
+        registry.hist_record("lat", -1.0, scope="histtest")
+        registry.hist_record("lat", 1e12, scope="histtest")
+        snap = registry.histograms("histtest")["histtest.lat"]
+        assert snap["count"] == 3
+        assert sum(snap["buckets"].values()) == 3
+        registry.reset("histtest")
+
+    def test_merge_is_bucketwise(self):
+        registry.reset("histtest")
+        registry.hist_record("lat", 0.001, scope="histtest")
+        a = registry.histograms("histtest")["histtest.lat"]
+        registry.reset("histtest")
+        registry.hist_record("lat", 0.1, scope="histtest")
+        b = registry.histograms("histtest")["histtest.lat"]
+        merged = registry.hist_merge({}, a)
+        registry.hist_merge(merged, b)
+        assert merged["count"] == 2
+        assert sum(merged["buckets"].values()) == 2
+        assert merged["p99_ms"] >= 100.0
+        registry.reset("histtest")
+
+    def test_snapshot_carries_hists(self):
+        registry.hist_record("x", 0.01, scope="histtest")
+        snap = registry.snapshot()
+        assert "histtest.x" in snap["hists"]
+        registry.reset("histtest")
+        assert "histtest.x" not in registry.snapshot()["hists"]
+
+
+class TestTimingReservoirBounded:
+    """The unbounded-growth satellite: timings() once appended every
+    observation to a list — a serving process recording ttft per request
+    grew without bound. Now: exact count/total + a capped reservoir."""
+
+    def test_reservoir_caps_and_stats_stay_exact(self):
+        registry.reset("restest")
+        n = registry.RESERVOIR_CAP * 40
+        for i in range(n):
+            registry.timing("t", 0.001, scope="restest")
+        rec = registry._timing_scopes["restest"]["t"]
+        assert len(rec[2]) == registry.RESERVOIR_CAP  # bounded
+        out = registry.timings("restest")["restest.t"]
+        assert out["count"] == n  # exact despite sampling
+        assert abs(out["total_s"] - n * 0.001) < 1e-6
+        assert out["p50_ms"] > 0 and out["p99_ms"] >= out["p50_ms"]
+        registry.reset("restest")
+
+
+class TestSpecAcceptanceGaugeRetention:
+    """The gauge key-leak satellite: one serving.spec_acceptance.gen<N>
+    gauge per weight swap grew the registry forever on a long-lived
+    server. Only the last K generations keep live gauges; older ones
+    fold into .historic."""
+
+    def test_retire_folds_into_historic(self):
+        from paddle_tpu.serving.spec_decode import (
+            SPEC_ACCEPT_KEEP_GENERATIONS, DraftVerifyEngine)
+
+        eng = DraftVerifyEngine.__new__(DraftVerifyEngine)
+        eng._gen_accept = {g: [g + 1, 10] for g in range(10)}
+        eng._accept_historic = [0, 0]
+        for g in range(10):
+            registry.gauge_set(f"serving.spec_acceptance.gen{g}", 0.5)
+        eng._retire_old_generations()
+        assert len(eng._gen_accept) == SPEC_ACCEPT_KEEP_GENERATIONS
+        assert sorted(eng._gen_accept) == [6, 7, 8, 9]  # newest kept
+        gauges = registry.gauges()
+        for g in range(6):
+            assert f"serving.spec_acceptance.gen{g}" not in gauges
+        # historic rollup = sum of the retired generations
+        assert eng._accept_historic == [sum(g + 1 for g in range(6)), 60]
+        assert gauges["serving.spec_acceptance.historic"] == round(
+            eng._accept_historic[0] / 60, 4)
+        for g in range(6, 10):
+            registry.gauge_drop(f"serving.spec_acceptance.gen{g}")
+        registry.gauge_drop("serving.spec_acceptance.historic")
+
+
+class TestFlightRecorder:
+    def test_ring_and_dump_round_trip(self, tmp_path):
+        for i in range(5):
+            tracing.flight("admit", rid=i, trace_id=f"t{i}", slot=i % 2)
+        path = str(tmp_path / "flight.json")
+        got = tracing.dump_flight_recorder(reason="unit test", path=path)
+        assert got == path
+        doc = tracing.load_flight_dump(path)
+        assert doc["reason"] == "unit test"
+        assert doc["pid"] == os.getpid()
+        assert [e["rid"] for e in doc["events"]] == list(range(5))
+        assert doc["events"][-1]["detail"] == {"slot": 0}
+        # anchor + event wall times let a reader align the dump against
+        # a merged trace
+        assert doc["clock_anchor"] > 0
+
+    def test_ring_is_bounded(self):
+        for i in range(tracing._FLIGHT_CAP + 50):
+            tracing.flight("e", rid=i)
+        evs = tracing.flight_events()
+        assert len(evs) == tracing._FLIGHT_CAP
+        assert evs[-1]["rid"] == tracing._FLIGHT_CAP + 49  # newest kept
+
+    def test_load_rejects_non_dump(self, tmp_path):
+        p = tmp_path / "not_a_dump.json"
+        p.write_text("{}")
+        with pytest.raises(ValueError):
+            tracing.load_flight_dump(str(p))
+
+
+class TestClockAlignment:
+    def test_offset_from_exchange_midpoint(self):
+        # remote clock runs 100s behind: remote_now sampled at local
+        # midpoint 5.0 reads -95.0 → offset +100 maps remote onto local
+        assert tracing.offset_from_exchange(4.0, 6.0, -95.0) == 100.0
+
+    def test_anchor_roundtrip(self):
+        import time as _t
+
+        a = tracing.clock_anchor()
+        assert abs((a + tracing.clock()) - _t.time()) < 0.5
+
+
+class TestFleetTraceCollector:
+    def _collector(self):
+        c = tracing.FleetTraceCollector()
+        c.set_process("router", pid=100, offset=0.0)
+        # pod's clock is 10s behind the router's: offset +10 aligns it
+        c.add_spans("pod0", [["tr1", "prefill", 1, 1.0, 2.0]],
+                    pid=200, offset=10.0)
+        c.add_spans("router", [["tr1", "request", 1, 10.5, 13.0],
+                               ["", "decode_iter", 1, 12.0, 12.1]])
+        return c
+
+    def test_alignment_and_grouping(self):
+        c = self._collector()
+        assert c.span_count() == 3
+        tr = c.traces()
+        assert set(tr) == {"tr1", ""}
+        spans = tr["tr1"]
+        # pod prefill lands INSIDE the router's request span once offset
+        assert [s["name"] for s in spans] == ["request", "prefill"]
+        assert spans[1]["t0"] == 11.0 and spans[1]["proc"] == "pod0"
+
+    def test_chrome_trace_loadable_and_rendered(self, tmp_path):
+        c = self._collector()
+        path = str(tmp_path / "trace.json")
+        c.write(path)
+        from paddle_tpu.profiler import load_profiler_result
+
+        load_profiler_result(path)  # raises on a bad shape
+        doc = json.load(open(path))
+        names = {e["args"]["name"] for e in doc["traceEvents"]
+                 if e.get("ph") == "M"}
+        assert names == {"router", "pod0"}
+        xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+        assert {e.get("args", {}).get("trace_id")
+                for e in xs} == {"tr1", None}
+        assert doc["paddle_tpu"]["clock_offsets"]["pod0"] == 10.0
+        # the stdlib-only dump tool renders the waterfall from the file
+        out = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "stats_dump.py"),
+             "--traces", path],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert "trace tr1" in out.stdout
+        assert "pod0:prefill" in out.stdout
+        assert "router:request" in out.stdout
+
+
+CONFIG = dict(vocab_size=96, n_layer=2, n_head=2, d_model=48,
+              seq_len=64, initializer_range=0.35)
+MODEL_SPEC = {"kind": "gpt", "seed": 21, "config": CONFIG}
+ENGINE_KW = dict(max_batch_size=2, buckets=[16], block_size=4,
+                 rng_seed=0)
+
+
+class TestCrossPodTraceMerge:
+    """THE acceptance gate: a disaggregated fleet request produces ONE
+    merged chrome trace — a single trace_id whose spans come from three
+    real processes (router + prefill pod + decode pod), causally
+    ordered on the router's clock."""
+
+    def test_disagg_request_one_trace_three_processes(self, tmp_path):
+        from proc_utils import proc_timeout
+
+        from paddle_tpu.serving.fleet import ServingFleet
+
+        tracing.enable()
+        fleet = ServingFleet(MODEL_SPEC, roles=["prefill", "decode"],
+                             engine=ENGINE_KW,
+                             connect_timeout=proc_timeout(120))
+        try:
+            fleet.start()
+            seed = 5
+            tokens = fleet.generate([3, 5, 7, 9, 11, 2, 4, 6],
+                                    max_new_tokens=4, seed=seed,
+                                    result_timeout=proc_timeout(120))
+            assert len(tokens) == 4
+            path = str(tmp_path / "fleet_trace.json")
+            fleet.collect_trace(path)
+        finally:
+            fleet.shutdown(drain=False)
+            tracing.disable()
+
+        from paddle_tpu.profiler import load_profiler_result
+
+        load_profiler_result(path)
+        doc = json.load(open(path))
+        want = tracing.trace_id_for_seed(seed)
+        mine = [e for e in doc["traceEvents"] if e.get("ph") == "X"
+                and e.get("args", {}).get("trace_id") == want]
+        # ONE trace id across >= 3 distinct pids
+        pids = {e["pid"] for e in mine}
+        assert len(pids) >= 3, (pids, mine)
+        by_name = {}
+        for e in mine:
+            by_name.setdefault(e["name"], []).append(e)
+        for name in ("request", "handoff", "prefill", "kv_export",
+                     "kv_import", "decode"):
+            assert name in by_name, sorted(by_name)
+        # causal order on the merged clock (RTT/2-bounded alignment:
+        # allow a generous same-host slack)
+        slack_us = 50e3
+
+        def t0(name):
+            return min(e["ts"] for e in by_name[name])
+
+        assert t0("prefill") + slack_us >= t0("request")
+        assert t0("kv_export") + slack_us >= t0("prefill")
+        assert t0("kv_import") + slack_us >= t0("kv_export")
+        assert t0("decode") + slack_us >= t0("kv_import")
+        # the router's request span covers (within slack) the whole life
+        req = by_name["request"][0]
+        for e in mine:
+            assert e["ts"] + slack_us >= req["ts"]
+            assert e["ts"] + e["dur"] <= req["ts"] + req["dur"] + slack_us
+        # and the waterfall tool renders it
+        out = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "stats_dump.py"),
+             "--traces", path],
+            capture_output=True, text=True, timeout=60)
+        assert out.returncode == 0, out.stderr
+        assert f"trace {want}" in out.stdout

@@ -415,6 +415,15 @@ st = fleet.stats()
 assert st["pods"][0]["restarts"] >= 1
 assert st["router"]["requests_failed"] == 0
 assert registry.counters("fleet")["orphans_replayed"] >= 1
+# the killed pod dumped its flight recorder on the way out (ISSUE 18):
+# the post-mortem file must exist in the fleet log dir and parse, with
+# the lifecycle events that led up to the kill
+from paddle_tpu.profiler.tracing import load_flight_dump
+dumps = fleet.flight_dumps()
+assert dumps, "pod_kill left no flight-recorder dump in the log dir"
+doc = load_flight_dump(dumps[0])
+assert doc["reason"] == "fault:pod_kill", doc["reason"]
+assert doc["events"], "flight dump has no lifecycle events"
 fleet.shutdown()
 print("FLEET-KILL-OK")
 """
@@ -422,7 +431,7 @@ print("FLEET-KILL-OK")
     if ok and "FLEET-KILL-OK" not in out:
         return False, "scenario exited 0 without completing"
     return ok, why or ("pod respawned under backoff; orphans replayed "
-                       "bitwise, zero failed")
+                       "bitwise, zero failed; flight dump parsed")
 
 
 @scenario("fleet-slow-pod", "one straggler pod in a 2-pod fleet: all "

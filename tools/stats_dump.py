@@ -14,6 +14,8 @@ the artifact landed (CI box, laptop) in milliseconds.
 Usage:
     python tools/stats_dump.py /tmp/paddle_tpu_profile/worker0.json
     python tools/stats_dump.py bench_output.log
+    python tools/stats_dump.py --traces fleet_trace.json   # per-request
+                                                           # waterfall
 """
 from __future__ import annotations
 
@@ -265,6 +267,24 @@ def _print_kv_pool(counters, gauges):
     _print_counters(kv)
 
 
+def _print_hists(hists, indent="  "):
+    """Latency histograms (ISSUE 18): fixed log2 buckets, so p50/p99
+    are conservative upper-edge estimates — cheap enough to be on for
+    every request, honest enough to alarm on."""
+    if not hists:
+        return
+    print("latency histograms (log2 buckets):")
+    width = max(len(k) for k in hists)
+    print(f"{indent}{'name':<{width}}  {'count':>8} {'mean_ms':>10} "
+          f"{'p50_ms':>10} {'p99_ms':>10}")
+    for k in sorted(hists):
+        h = hists[k]
+        print(f"{indent}{k:<{width}}  {h.get('count', 0):>8} "
+              f"{h.get('mean_ms', 0.0):>10.3f} "
+              f"{h.get('p50_ms', 0.0):>10.3f} "
+              f"{h.get('p99_ms', 0.0):>10.3f}")
+
+
 def _print_snapshot(snap):
     counters = dict(snap.get("counters") or {})
     timings = dict(snap.get("timings") or {})
@@ -355,6 +375,52 @@ def _print_snapshot(snap):
     if timings:
         print("timings:")
         _print_timings(timings)
+    _print_hists(dict(snap.get("hists") or {}))
+
+
+def _dump_waterfall(doc):
+    """Per-request waterfall (ISSUE 18): group the merged fleet trace's
+    "X" events by their request trace_id and print each request's spans
+    in causal order across every process — the one joined view of a
+    request's life."""
+    procs = {}
+    for e in doc.get("traceEvents", ()):
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            procs[e.get("pid")] = (e.get("args") or {}).get(
+                "name", str(e.get("pid")))
+    traces = {}
+    for e in doc.get("traceEvents", ()):
+        if e.get("ph") != "X":
+            continue
+        tid = (e.get("args") or {}).get("trace_id") or "(untraced)"
+        traces.setdefault(tid, []).append(e)
+    if not traces:
+        print("no spans in trace")
+        return
+    bar_w = 40
+    for tid in sorted(traces):
+        evs = sorted(traces[tid],
+                     key=lambda e: (float(e.get("ts", 0.0)),
+                                    float(e.get("dur", 0.0))))
+        t0 = min(float(e.get("ts", 0.0)) for e in evs)
+        t1 = max(float(e.get("ts", 0.0)) + float(e.get("dur", 0.0))
+                 for e in evs)
+        total = max(t1 - t0, 1e-9)
+        print(f"trace {tid}  ({len(evs)} spans, "
+              f"{len({e.get('pid') for e in evs})} processes, "
+              f"{total / 1e3:.3f}ms)")
+        w = max(len(f"{procs.get(e.get('pid'), e.get('pid'))}:"
+                    f"{e.get('name', '?')}") for e in evs)
+        for e in evs:
+            ts = float(e.get("ts", 0.0))
+            dur = float(e.get("dur", 0.0))
+            lead = int((ts - t0) / total * bar_w)
+            fill = max(1, int(dur / total * bar_w))
+            bar = " " * lead + "#" * min(fill, bar_w - lead)
+            label = (f"{procs.get(e.get('pid'), e.get('pid'))}:"
+                     f"{e.get('name', '?')}")
+            print(f"  {label:<{w}}  [{bar:<{bar_w}}] "
+                  f"+{(ts - t0) / 1e3:>9.3f}ms {dur / 1e3:>9.3f}ms")
 
 
 def _dump_trace(doc):
@@ -407,6 +473,10 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("path", help="trace JSON / telemetry JSONL / "
                                  "counters dict")
+    ap.add_argument("--traces", action="store_true",
+                    help="render the per-request waterfall (spans "
+                         "grouped by trace_id across processes) instead "
+                         "of the aggregate span table")
     args = ap.parse_args(argv)
     try:
         with open(args.path) as f:
@@ -417,6 +487,13 @@ def main(argv=None):
             print(f"{args.path}: no JSON document and no telemetry lines",
                   file=sys.stderr)
             return 1
+        return 0
+    if args.traces:
+        if not (isinstance(doc, dict) and "traceEvents" in doc):
+            print(f"{args.path}: --traces needs a chrome-trace JSON "
+                  "(no traceEvents key)", file=sys.stderr)
+            return 1
+        _dump_waterfall(doc)
         return 0
     if isinstance(doc, dict) and "traceEvents" in doc:
         _dump_trace(doc)
