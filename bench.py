@@ -1400,6 +1400,46 @@ def _run_serve_fleet_child():
                 "swap": swap_res,
                 "router": {k: f1[k] - f0.get(k, 0) for k in f1}}
 
+    def run_handoff(data_plane):
+        """Disagg prefill→decode fleet over one data plane, SAME
+        traffic: the handoff bytes/s line that justifies the binary
+        wire (ISSUE 19). Returns per-plane throughput + wire volume."""
+        f0 = dict(_reg.counters("fleet"))
+        fleet = ServingFleet(model_spec, roles=("prefill", "decode"),
+                             engine=engine_kw, data_plane=data_plane,
+                             server={"max_queue_size": 64}).start()
+        warm = []
+        for pl in (8, 20):
+            warm.append(fleet.submit(
+                [int(t) for t in rng.integers(1, 128, pl)],
+                max_new_tokens=4, seed=3000 + pl))
+            warm[-1].result(300)
+        c0 = {p: d.get("decode_compiles")
+              for p, d in fleet.stats()["pods"].items()}
+        t0 = _t.perf_counter()
+        reqs = [fleet.submit(prompt, max_new_tokens=8, seed=4000 + i)
+                for i, prompt in enumerate(traffic)]
+        for r in reqs:
+            r.result(300)
+        dt = _t.perf_counter() - t0
+        st = fleet.stats()
+        c1 = {p: d.get("decode_compiles")
+              for p, d in st["pods"].items()}
+        f1 = dict(_reg.counters("fleet"))
+        failed = len([r for r in reqs + warm if r.status != "done"])
+        tokens = sum(len(r.tokens) for r in reqs)
+        fleet.shutdown()
+        nbytes = f1.get("handoff_bytes", 0) - f0.get("handoff_bytes", 0)
+        return {"tps": tokens / dt, "dt": dt, "failed": failed,
+                "bytes": nbytes, "bytes_per_s": nbytes / dt,
+                "binary": (f1.get("handoffs_binary", 0)
+                           - f0.get("handoffs_binary", 0)),
+                "fallback": (f1.get("handoffs_fallback", 0)
+                             - f0.get("handoffs_fallback", 0)),
+                "zero_recompile": c1 == c0,
+                "wire_retries": st.get("data_plane", {})
+                .get("tx_retries", 0)}
+
     one = run_phase(1, "prefix")
     paddle.seed(1)
     swap_sd = {k: np.asarray(v.numpy())
@@ -1409,6 +1449,8 @@ def _run_serve_fleet_child():
         _ckpt.save_checkpoint(d, {"model": swap_sd}, step=1)
         aff = run_phase(2, "prefix", swap_dir=d)
     rr = run_phase(2, "round_robin")
+    hand_bin = run_handoff("binary")
+    hand_json = run_handoff("json")
 
     scaling = aff["tps"] / one["tps"] if one["tps"] else 0.0
     swap_pods_ok = aff["swap"] is not None and all(
@@ -1424,11 +1466,25 @@ def _run_serve_fleet_child():
     # "≳ linear": 2 separate pod processes should scale ~2x on this
     # traffic; the gate is deliberately below 2.0 to absorb CI-box
     # core contention without letting sub-linear regressions hide
+    # the binary plane must carry EVERY handoff (no silent JSON
+    # fallback), drop no requests, and add no post-warmup compiles —
+    # the bytes/s comparison is only honest if both planes went clean
+    handoff_ok = (hand_bin["failed"] == 0 and hand_json["failed"] == 0
+                  and hand_bin["fallback"] == 0
+                  and hand_bin["binary"] >= len(traffic)
+                  and hand_bin["zero_recompile"]
+                  and hand_json["zero_recompile"])
+    # the ≥1.4x scaling gate needs cores for 2 pod processes + the
+    # router to actually run in parallel; on a 1-2 core box the number
+    # is a hardware statement, not a regression — report it degraded
+    # (same convention as --run's cpu "degraded" flag), don't fail it
+    scaling_measurable = (os.cpu_count() or 1) >= 3
     gates_ok = (one["failed"] == 0 and aff["failed"] == 0
                 and rr["failed"] == 0
-                and scaling >= 1.4
+                and (scaling >= 1.4 or not scaling_measurable)
                 and aff["hit_rate"] > rr["hit_rate"]
-                and swap_pods_ok and swap_zero_recompile)
+                and swap_pods_ok and swap_zero_recompile
+                and handoff_ok)
     _telemetry_line()
     rec = {
         "metric": "serving-fleet",
@@ -1439,6 +1495,7 @@ def _run_serve_fleet_child():
         "tokens_per_sec_1pod": round(one["tps"], 1),
         "scaling_x": round(scaling, 2),
         "scaling_gate": 1.4,
+        "scaling_degraded": not scaling_measurable,
         # prefix-affinity routing must beat round-robin on the same
         # shared-system-prompt traffic (the router's reason to exist)
         "prefix_hit_rate_affinity": round(aff["hit_rate"], 4),
@@ -1468,6 +1525,22 @@ def _run_serve_fleet_child():
             aff["hists"].get("serving.inter_token", {})
             .get("p99_ms", 0.0), 3),
         "tracing_enabled": os.environ.get("PADDLE_TPU_TRACE") == "1",
+        # pods×hosts scaling line + the KV-handoff wire rate, binary
+        # frames vs the old JSON/base64 control-channel hop on the SAME
+        # disagg traffic (ISSUE 19)
+        "pods_x_hosts": "2x1",
+        "handoff_bytes_per_s_binary": round(hand_bin["bytes_per_s"], 1),
+        "handoff_bytes_per_s_json": round(hand_json["bytes_per_s"], 1),
+        "handoff_wire_bytes_binary": hand_bin["bytes"],
+        "handoff_wire_bytes_json": hand_json["bytes"],
+        "handoff_json_overhead_x": round(
+            hand_json["bytes"] / hand_bin["bytes"], 3)
+        if hand_bin["bytes"] else 0.0,
+        "disagg_tokens_per_sec_binary": round(hand_bin["tps"], 1),
+        "disagg_tokens_per_sec_json": round(hand_json["tps"], 1),
+        "handoffs_binary": hand_bin["binary"],
+        "handoffs_fallback": hand_bin["fallback"],
+        "handoff_gates_ok": handoff_ok,
         "gates_ok": gates_ok,
         "platform": "cpu",
     }

@@ -32,6 +32,7 @@ from ...profiler import explainer as _explain
 from ...profiler import registry as _registry
 
 __all__ = ["ElasticManager", "ElasticStatus", "publish_generation",
+           "endpoint_key", "publish_endpoint", "resolve_endpoint",
            "HeartbeatLease", "StepWatchdog", "PreemptionCoordinator",
            "GenerationFence", "StaleGenerationError", "ElasticTrainContext",
            "request_resize", "pending_resize", "dump_thread_stacks",
@@ -85,6 +86,101 @@ def publish_generation(store, world, log=None, scope="elastic"):
         if log is not None:
             log(f"elastic generation bump failed: {e}")
         return False
+
+
+# -------------------------------------------------- endpoint publication --
+#
+# ISSUE 19 tentpole (1): serving pods used to advertise their control
+# port through a LOCAL file (pod{i}.port), which only works when the
+# router shares a filesystem with every pod. Endpoints now go through
+# the rendezvous store — the same TCPStore the fleet already runs for
+# weight-swap generations — so a pod can live on any host:
+#
+#   {scope}/endpoint/{pod}      JSON {host, port, data_port, role,
+#                               generation, pid}
+#   {scope}/endpoint/{pod}/gen  monotone counter (add()-published), so
+#                               watchers can cheaply poll for "newer
+#                               than what I have"
+#
+# Generation = the pod's restart count (PADDLE_RESTART_COUNT): a
+# respawned pod publishes gen N+1, and readers asking for min_gen=N+1
+# never resolve the dead incarnation's address — stale-generation
+# REJECTION is the reader's job and is encoded in resolve_endpoint.
+
+
+def endpoint_key(pod, scope="serving"):
+    return f"{scope}/endpoint/{pod}"
+
+
+def publish_endpoint(store, pod, host, port, generation, role="serve",
+                     data_port=0, scope="serving", log=None):
+    """Publish this pod incarnation's endpoints. Monotone by
+    generation: a slow/stale publisher (an old incarnation flushing its
+    dying breath after the respawn already registered) never overwrites
+    a newer record. Best-effort like publish_generation — the pod must
+    serve even if the store hiccups (callers retry via republish)."""
+    import json as _json
+
+    if store is None:
+        return False
+    key = endpoint_key(pod, scope)
+    doc = {"host": host, "port": int(port), "data_port": int(data_port),
+           "role": role, "generation": int(generation),
+           "pid": os.getpid()}
+    try:
+        if store.check(key):
+            try:
+                cur = _json.loads(store.get(key))
+                if int(cur.get("generation", -1)) > int(generation):
+                    _explain.record(
+                        "stale_endpoint_publish", op="endpoint",
+                        why=f"pod {pod} gen {generation} yielded to "
+                            f"newer gen {cur['generation']}", pod=pod)
+                    return False
+            except Exception:
+                pass  # unreadable record: overwrite it
+        store.set(key, _json.dumps(doc))
+        store.add(f"{key}/gen", 1)
+        return True
+    except Exception as e:
+        if log is not None:
+            log(f"endpoint publish failed for pod {pod}: {e}")
+        return False
+
+
+def resolve_endpoint(store, pod, scope="serving", min_gen=0,
+                     timeout=0.0):
+    """Resolve a pod's endpoint record, REJECTING stale generations:
+    returns the JSON doc once its generation is >= min_gen, or None
+    when `timeout` seconds pass without one (timeout 0 = one shot).
+    A rejected stale record lands in the explainer so 'router kept
+    dialing a dead pod' is diagnosable, not silent."""
+    import json as _json
+
+    if store is None:
+        return None
+    key = endpoint_key(pod, scope)
+    deadline = time.time() + float(timeout)
+    stale_seen = None
+    while True:
+        try:
+            if store.check(key):
+                doc = _json.loads(store.get(key))
+                if int(doc.get("generation", -1)) >= int(min_gen):
+                    return doc
+                stale_seen = doc.get("generation")
+        except Exception:
+            pass  # store hiccup: poll again inside the window
+        if time.time() >= deadline:
+            break
+        time.sleep(0.05)
+    if stale_seen is not None:
+        _explain.record(
+            "stale_endpoint_rejected", op="endpoint",
+            why=f"pod {pod} endpoint gen {stale_seen} < required "
+                f"{min_gen} (old incarnation); resolution refused",
+            pod=pod)
+    return None
 
 
 class ElasticStatus:
@@ -715,6 +811,17 @@ class PreemptionCoordinator:
     def triggered(self):
         return self._event.is_set()
 
+    def poke(self):
+        """Synchronous notice check, for callers already paying a store
+        round-trip (the per-step fence barrier). The poll thread
+        normally wins; this closes the starvation race where a rank
+        reaches its save boundary before its poll thread ever ran —
+        without it, a stalled peer can march into a step barrier the
+        announcer has already left."""
+        if not self._event.is_set():
+            self._adopt()
+        return self._event.is_set()
+
     def should_save(self, step):
         """True at the first step boundary at/past the fleet target."""
         if not self._event.is_set():
@@ -963,7 +1070,16 @@ class ElasticTrainContext:
         """Generation-fenced store barrier over the current world."""
         if self.fence is None:
             return 0
-        return self.fence.barrier(name, self.world, timeout=timeout)
+        n = self.fence.barrier(name, self.world, timeout=timeout)
+        # a peer's ack on this barrier ORDERS AFTER its announce(), so
+        # any preemption notice published before the barrier completed
+        # is visible now — checking here makes lockstep ranks adopt the
+        # fleet save target deterministically even when the async poll
+        # thread is starved
+        coord = getattr(self, "coordinator", None)
+        if coord is not None:
+            coord.poke()
+        return n
 
     @property
     def preempt_requested(self):

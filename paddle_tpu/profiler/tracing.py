@@ -91,19 +91,26 @@ def disable():
     _enabled = False
 
 
-def add_span(trace_id, name, t0, t1, tid=None):
+def add_span(trace_id, name, t0, t1, tid=None, meta=None):
     """Record one closed span. Hot-path shape: one boolean load when
     disabled; one append when enabled. Callers on replay fast paths must
-    sit AROUND the executable call, never inside the per-op loop."""
+    sit AROUND the executable call, never inside the per-op loop.
+
+    ``meta`` (optional dict, JSON-able) rides as a sixth element — the
+    data plane stamps frame ids / byte counts here so the merged trace
+    shows what moved over each wire span. Spans without meta keep the
+    5-tuple shape (the wire format is unchanged for them)."""
     global _spans_dropped
     if not _enabled:
         return
     if len(_spans) >= _span_cap:
         _spans_dropped += 1
         return
-    _spans.append((trace_id or "", name,
-                   tid if tid is not None else threading.get_ident(),
-                   t0, t1))
+    tid = tid if tid is not None else threading.get_ident()
+    if meta:
+        _spans.append((trace_id or "", name, tid, t0, t1, dict(meta)))
+    else:
+        _spans.append((trace_id or "", name, tid, t0, t1))
 
 
 class span:
@@ -208,23 +215,29 @@ class FleetTraceCollector:
         return sum(len(p["spans"]) for p in self._procs.values())
 
     def _aligned(self):
-        """Yield (label, pid, trace_id, name, tid, t0, t1) with t0/t1 on
-        the collector's clock."""
+        """Yield (label, pid, trace_id, name, tid, t0, t1, meta) with
+        t0/t1 on the collector's clock. Spans are 5-tuples, or 6-tuples
+        when the emitter attached a meta dict (frame id, byte count)."""
         for label, p in sorted(self._procs.items()):
             off = p["offset"]
             pid = p["pid"] if p["pid"] is not None else abs(hash(label)) % 10**6
             for s in p["spans"]:
-                trace_id, name, tid, t0, t1 = s
-                yield label, pid, trace_id, name, tid, t0 + off, t1 + off
+                trace_id, name, tid, t0, t1 = s[:5]
+                meta = s[5] if len(s) > 5 else None
+                yield (label, pid, trace_id, name, tid, t0 + off,
+                       t1 + off, meta)
 
     def traces(self):
         """{trace_id: [span dicts sorted by aligned start]} — the
         per-request view (spans with no trace_id group under ""). """
         out: dict = {}
-        for label, pid, trace_id, name, tid, t0, t1 in self._aligned():
-            out.setdefault(trace_id, []).append(
-                {"name": name, "proc": label, "pid": pid, "tid": tid,
-                 "t0": t0, "t1": t1})
+        for label, pid, trace_id, name, tid, t0, t1, meta in \
+                self._aligned():
+            rec = {"name": name, "proc": label, "pid": pid, "tid": tid,
+                   "t0": t0, "t1": t1}
+            if meta:
+                rec["meta"] = meta
+            out.setdefault(trace_id, []).append(rec)
         for spans in out.values():
             spans.sort(key=lambda s: (s["t0"], s["t1"]))
         return out
@@ -239,13 +252,17 @@ class FleetTraceCollector:
             pid = p["pid"] if p["pid"] is not None else abs(hash(label)) % 10**6
             evs.append({"name": "process_name", "ph": "M", "pid": pid,
                         "args": {"name": label}})
-        for label, pid, trace_id, name, tid, t0, t1 in self._aligned():
+        for label, pid, trace_id, name, tid, t0, t1, meta in \
+                self._aligned():
             ev = {"name": name, "ph": "X", "cat": "trace",
                   "ts": round(t0 * 1e6, 3),
                   "dur": round((t1 - t0) * 1e6, 3),
                   "pid": pid, "tid": tid}
+            args = dict(meta) if meta else {}
             if trace_id:
-                ev["args"] = {"trace_id": trace_id}
+                args["trace_id"] = trace_id
+            if args:
+                ev["args"] = args
             evs.append(ev)
         doc = {"traceEvents": evs, "displayTimeUnit": "ms"}
         full_meta = {"clock_offsets": {label: p["offset"]

@@ -49,6 +49,16 @@ exhaustion; ``roles=("prefill", "decode")`` disaggregates prompt and
 decode work with a block-table KV handoff (``pod_worker`` is the pod
 process entry point).
 
+Cross-host data plane (ISSUE 19): pod endpoints are PUBLISHED through
+the rendezvous TCPStore (generation-stamped, stale incarnations
+rejected) instead of local port files, and the prefill→decode KV
+handoff streams pod-to-pod as length-prefixed CRC'd tensor frames
+(``wire`` — ``FrameSender``/``DataPlaneListener``) with per-request
+deadlines, bounded retry/backoff, and router circuit-breaking;
+``testing/netfaults.py`` injects drop/delay/dup/truncate/corrupt/
+half-open chaos at the socket seam to prove zero failed requests under
+a lossy network (a corrupt frame is retried, never decoded).
+
 Quickstart::
 
     from paddle_tpu.serving import GenerationServer
@@ -72,6 +82,8 @@ from .server import (  # noqa: F401
     CheckpointFollower, GenerationServer)
 from .spec_decode import DraftVerifyEngine  # noqa: F401
 from .supervisor import ReplicaSupervisor  # noqa: F401
+from .wire import (  # noqa: F401
+    DataPlaneListener, FrameSender)
 from . import sampling  # noqa: F401
 
 __all__ = [
@@ -80,5 +92,6 @@ __all__ = [
     "ReplicaSupervisor", "WeightSwapError", "FatalEngineError",
     "BlockPool", "PagePoolExhausted", "RadixPrefixCache", "sampling",
     "ServingFleet", "FleetRouter", "FleetRequest", "PodClient",
-    "CheckpointFollower", "DraftVerifyEngine",
+    "CheckpointFollower", "DraftVerifyEngine", "FrameSender",
+    "DataPlaneListener",
 ]

@@ -965,7 +965,9 @@ class GenerationEngine:
         vs = [np.asarray(jnp.take(a, idx, axis=0)) for a in self._v]
         _counters["handoff_exports"] += 1
         if t0:
-            _tracing.add_span(trace, "kv_export", t0, _tracing.clock())
+            _tracing.add_span(
+                trace, "kv_export", t0, _tracing.clock(),
+                meta={"bytes": sum(a.nbytes for a in ks + vs)})
         _tracing.flight("kv_export", trace_id=trace, slot=slot,
                         blocks=len(ids))
         return {
@@ -1083,8 +1085,10 @@ class GenerationEngine:
         _counters["handoff_imports"] += 1
         _counters["tokens_generated"] += 1  # the adopted first token
         if t0:
-            _tracing.add_span(payload.get("trace"), "kv_import", t0,
-                              _tracing.clock())
+            _tracing.add_span(
+                payload.get("trace"), "kv_import", t0, _tracing.clock(),
+                meta={"bytes": sum(np.asarray(a).nbytes for a in
+                                   payload["kv_k"] + payload["kv_v"])})
         _tracing.flight("kv_import", trace_id=payload.get("trace"),
                         slot=slot, blocks=n)
         return int(payload["last_token"])

@@ -30,19 +30,37 @@ Fleet-wide versions of the per-replica contracts:
   merely down hold their traffic for replay instead.
 * **disaggregation** — ``roles=("prefill", "decode", ...)`` splits
   prompt-heavy and decode-heavy work: prefill pods export finished KV
-  blocks through the block-table serialization and decode pods adopt
-  them, token-bitwise with a monolithic pod.
+  blocks and decode pods adopt them, token-bitwise with a monolithic
+  pod. With ``data_plane="binary"`` (the default) the payload streams
+  POD-TO-POD as CRC'd tensor frames over ``serving/wire.py``; the
+  router-mediated JSON transport remains as ``data_plane="json"`` and
+  as the automatic fallback when the wire's retry budget runs out.
+* **store-published endpoints** (ISSUE 19) — the fleet runs (or is
+  handed) a rendezvous TCPStore; every pod publishes
+  ``host:port (+ data port, role, generation)`` through it and the
+  router resolves endpoints from it, stale generations rejected — no
+  shared filesystem in the serving path, and a pod respawning on a
+  fresh port with a bumped generation is rediscovered without router
+  restart. ``pod_logs()`` collects log tails over the wire for the
+  same reason.
+* **chaos-hardened data plane** — ``testing/netfaults.py`` faults
+  (drop/delay/dup/truncate/corrupt/half-open, armed per pod via
+  ``pod_faults``) hit the wire's socket seam; deadlines + bounded
+  retry/backoff + the router's circuit breaker keep every injected
+  fault at ZERO failed requests, and a CRC-mismatched frame is
+  transport loss — retried, never decoded into KV.
 
 Pods default to ``platform="cpu"`` — a host that owns an accelerator
 runs ONE engine per chip, and multiple pods racing to initialize one
-TPU would fight over the device.  For accelerator fleets, ``platform``
-accepts a per-pod dict/list and ``pod_env`` injects per-pod environment
-(visible-device pinning) before any jax import, so one fleet runs one
-pod per chip::
+TPU would fight over the device. Accelerator fleets therefore default
+to one pod per chip: ``platform="tpu"`` with no ``pod_env`` derives
+``TPU_VISIBLE_DEVICES=<pod index>`` per pod (``CUDA_VISIBLE_DEVICES``
+for gpu), and explicit pinnings that make two pods share a chip draw a
+RuntimeWarning. ``platform`` also accepts a per-pod dict/list, and
+``pod_env`` still injects arbitrary per-pod environment before any jax
+import::
 
-    ServingFleet(spec, pods=4, platform="tpu",
-                 pod_env={i: {"TPU_VISIBLE_DEVICES": str(i)}
-                          for i in range(4)})
+    ServingFleet(spec, pods=4, platform="tpu")   # pod i owns chip i
 
 Passing ``draft={model spec}`` (+ ``draft_k``) builds every pod's engine
 as a ``DraftVerifyEngine`` — fleet-wide speculative decoding with the
@@ -127,7 +145,8 @@ class ServingFleet:
                  connect_timeout=120.0, ack_timeout=15.0,
                  prefill_timeout=300.0, platform="cpu", log_dir=None,
                  store=None, watch=None, pod_faults=None, env=None,
-                 pod_env=None, draft=None, draft_k=4):
+                 pod_env=None, draft=None, draft_k=4,
+                 data_plane="binary", wire=None):
         self.model_spec = dict(model_spec)
         self.roles = list(roles) if roles is not None \
             else ["serve"] * int(pods)
@@ -148,6 +167,32 @@ class ServingFleet:
         self.connect_timeout = float(connect_timeout)
         self.platform = platform
         self.store = store
+        self._own_store = False
+        # per-payload wire tuning forwarded into every pod's FrameSender
+        # (attempt_timeout × retries bounds how long a handoff fights a
+        # chaotic link before falling back to the inline JSON payload)
+        self.wire_kwargs = dict(wire or {})
+        self.wire_kwargs.setdefault("attempt_timeout", 5.0)
+        self.wire_kwargs.setdefault("retries", 3)
+        self.data_plane = data_plane
+        if self.store is None:
+            # endpoints are store-published (ISSUE 19): the fleet owns a
+            # rendezvous TCPStore when the caller didn't bring one.
+            # Failure to build/bind it degrades to port-file endpoints
+            # and the JSON handoff — a fleet on one host still works.
+            try:
+                from ..distributed.store import TCPStore
+
+                self.store = TCPStore("127.0.0.1", 0, is_master=True)
+                self._own_store = True
+            except Exception as e:
+                _explain.record(
+                    "fleet_store_unavailable", op="supervise",
+                    why=f"rendezvous store failed to start ({e}); "
+                        "endpoints fall back to port files and the "
+                        "handoff to the inline JSON transport")
+        if self.store is None:
+            self.data_plane = "json"
         self.watch = dict(watch) if watch else None
         self.pod_faults = dict(pod_faults or {})
         self._extra_env = dict(env or {})
@@ -161,6 +206,7 @@ class ServingFleet:
         #                         for i in range(4)})
         self.pod_env = {int(k): dict(v)
                         for k, v in (pod_env or {}).items()}
+        self._default_accel_pinning()
         # speculative decoding in every pod: a drafter model spec + K
         self.draft_spec = dict(draft) if draft else None
         self.draft_k = int(draft_k)
@@ -170,13 +216,19 @@ class ServingFleet:
             policy=policy,
             block_size=int(self.engine_kwargs.get("block_size", 16)),
             affinity_blocks=affinity_blocks, ack_timeout=ack_timeout,
-            prefill_timeout=prefill_timeout)
+            prefill_timeout=prefill_timeout,
+            data_plane=self.data_plane)
+        # binary handoffs demand the decode pod's CURRENT generation
+        # from the store: after a respawn the fleet's restart count for
+        # that pod is the floor, so a dead incarnation's endpoint record
+        # is rejected as stale instead of dialed
+        self.router.pod_min_gen = self._pod_min_gen
         from ..distributed.launch.main import Pod
 
         self._pod = Pod(max_restarts=self.max_restarts,
                         restart_backoff=self.restart_backoff,
                         terminate_grace=float(terminate_grace),
-                        store=store, generation_scope="serving",
+                        store=self.store, generation_scope="serving",
                         log=lambda m: _explain.record(
                             "fleet_pod_event", op="supervise", why=m))
         self._handles: list = []
@@ -191,6 +243,71 @@ class ServingFleet:
         self.trace.set_process("router", pid=os.getpid(), offset=0.0)
 
     # ------------------------------------------------------------ control --
+    _ACCEL_VISIBLE = {"tpu": "TPU_VISIBLE_DEVICES",
+                      "gpu": "CUDA_VISIBLE_DEVICES",
+                      "cuda": "CUDA_VISIBLE_DEVICES"}
+
+    def _default_accel_pinning(self):
+        """Accelerator fleets default to ONE POD PER CHIP (ISSUE 19
+        satellite): with a fleet-wide accelerator platform and no
+        explicit ``pod_env``, each pod's visible-device env is derived
+        from its index — the PR 11 per-pod override machinery does the
+        rest. When the caller DID pin devices and two pods resolve to
+        the same chip (or left some pod seeing every chip), warn: pods
+        racing to initialize one device fight, they don't share."""
+        var = self._ACCEL_VISIBLE.get(self.platform) \
+            if isinstance(self.platform, str) else None
+        if var is None or len(self.roles) < 2:
+            return
+        if not self.pod_env:
+            self.pod_env = {i: {var: str(i)}
+                            for i in range(len(self.roles))}
+            _explain.record(
+                "fleet_auto_device_pinning", op="supervise",
+                why=f"platform={self.platform!r} with no pod_env: "
+                    f"defaulting {var}=<pod index> so each of the "
+                    f"{len(self.roles)} pods owns one chip",
+                pods=len(self.roles))
+            return
+        import warnings
+
+        owner: dict = {}
+        for i in range(len(self.roles)):
+            dev = (self.pod_env.get(i) or {}).get(var)
+            if dev is None:
+                warnings.warn(
+                    f"ServingFleet: platform={self.platform!r} pod {i} "
+                    f"has no {var} in pod_env — it will see every chip "
+                    "and fight its siblings for one device",
+                    RuntimeWarning, stacklevel=3)
+            elif dev in owner:
+                warnings.warn(
+                    f"ServingFleet: pods {owner[dev]} and {i} both pin "
+                    f"{var}={dev} — two engines will fight over one "
+                    "chip", RuntimeWarning, stacklevel=3)
+            else:
+                owner[dev] = i
+
+    def _pod_min_gen(self, pod_id):
+        try:
+            return self._handles[int(pod_id)].restarts
+        except (IndexError, ValueError, TypeError):
+            return 0
+
+    def _endpoint_resolver(self, h):
+        """Per-pod resolver closure for PodClient: one-shot store lookup
+        demanding generation >= the fleet's restart count for that pod,
+        so the connect-retry loop keeps polling until the RESPAWNED
+        incarnation publishes (fresh port, bumped generation) instead of
+        dialing the corpse's address."""
+        from ..distributed.fleet.elastic import resolve_endpoint
+
+        def _resolve():
+            return resolve_endpoint(self.store, str(h.idx),
+                                    min_gen=h.restarts, timeout=0.0)
+
+        return _resolve
+
     @property
     def disaggregated(self):
         return "prefill" in self.roles
@@ -211,8 +328,16 @@ class ServingFleet:
             self._spawn_pod(idx, role)
         deadline = time.monotonic() + self.connect_timeout
         for h in self._handles:
-            h.client = PodClient(h.idx, port_file=h.port_file,
-                                 on_async=self.router.on_pod_message)
+            if self.store is not None:
+                # endpoints resolve through the store — the router path
+                # has NO port-file dependence; the file remains on disk
+                # purely as a debugging artifact
+                h.client = PodClient(
+                    h.idx, resolver=self._endpoint_resolver(h),
+                    on_async=self.router.on_pod_message)
+            else:
+                h.client = PodClient(h.idx, port_file=h.port_file,
+                                     on_async=self.router.on_pod_message)
             remaining = max(1.0, deadline - time.monotonic())
             if not h.client.connect(timeout=remaining):
                 self.shutdown(drain=False)
@@ -254,6 +379,8 @@ class ServingFleet:
         if self.draft_spec:
             spec["draft"] = self.draft_spec
             spec["draft_k"] = self.draft_k
+        if self.data_plane == "binary":
+            spec["wire"] = self.wire_kwargs
         per_env = self.pod_env.get(idx)
         if per_env:
             spec["env"] = {str(k): str(v) for k, v in per_env.items()}
@@ -263,12 +390,16 @@ class ServingFleet:
         with open(spec_path, "w") as f:
             json.dump(spec, f)
         port_file = os.path.join(self._log_dir, f"pod{idx}.port")
+        log_path = os.path.join(self._log_dir, f"pod{idx}.log")
         env = dict(os.environ)
         env.update(self._extra_env)
         env.update({
             "PADDLE_POD_ID": str(idx),
             "PADDLE_POD_PORT": "0",
             "PADDLE_POD_PORT_FILE": port_file,
+            # the pod knows its own log so `pod_logs()` can collect it
+            # over the wire (remote pods share no filesystem)
+            "PADDLE_POD_LOG": log_path,
             "PYTHONPATH": _repo_root() + os.pathsep
             + env.get("PYTHONPATH", ""),
             # a dying pod's flight recorder lands next to its log so the
@@ -276,6 +407,11 @@ class ServingFleet:
             "PADDLE_TPU_FLIGHT_DIR": self._log_dir,
             "PADDLE_TPU_FLIGHT_TAG": f"pod{idx}",
         })
+        if self.store is not None:
+            # the pod publishes its endpoint (and resolves its peers')
+            # through the fleet's rendezvous store
+            env["PADDLE_STORE_HOST"] = self.store.host
+            env["PADDLE_STORE_PORT"] = str(self.store.port)
         if _tracing.enabled():
             # tracing in the router process turns it on fleet-wide: the
             # pods inherit the flag at spawn and ship spans back on
@@ -290,8 +426,7 @@ class ServingFleet:
             env["FLAGS_fault_inject"] = fault_spec
         cmd = [sys.executable, "-m", "paddle_tpu.serving.pod_worker",
                spec_path]
-        self._pod.spawn(cmd, env,
-                        os.path.join(self._log_dir, f"pod{idx}.log"))
+        self._pod.spawn(cmd, env, log_path)
         self._handles.append(_PodHandle(idx, role, port_file))
 
     # -------------------------------------------------------- supervision --
@@ -463,16 +598,61 @@ class ServingFleet:
         hits = sum(p.get("prefix_hits", 0) for p in per_pod.values())
         misses = sum(p.get("prefix_misses", 0) for p in per_pod.values())
         hists: dict = {}
+        # the data plane's wire counters + per-link bytes/retries,
+        # summed across pods (ISSUE 19: fleet.stats() answers "how many
+        # bytes crossed each pod-to-pod link, how many retries did the
+        # chaos cost" without touching any pod's process)
+        data_plane: dict = {}
+        links: dict = {}
         for p in per_pod.values():
             for name, snap in (p.get("hists") or {}).items():
                 _registry.hist_merge(hists.setdefault(name, {}), snap)
+            for k, v in (p.get("data_plane") or {}).items():
+                data_plane[k] = data_plane.get(k, 0) + v
+            for lk, lv in (p.get("links") or {}).items():
+                ent = links.setdefault(lk, {})
+                for k, v in lv.items():
+                    ent[k] = ent.get(k, 0) + v
         return {
             "pods": per_pod,
             "router": self.router.stats(),
             "hists": hists,
+            "data_plane": data_plane,
+            "links": links,
             "prefix_hit_rate": hits / (hits + misses)
             if hits + misses else 0.0,
         }
+
+    def pod_logs(self, tail=100, timeout=10.0):
+        """Collect each pod's log tail OVER THE WIRE (``logs`` op) —
+        the store-published-endpoint world has no shared filesystem to
+        read ``pod<idx>.log`` from. Returns {pod_id: logs_reply | None
+        for unreachable pods}."""
+        out = {}
+        for h in self._handles:
+            reply = None
+            if h.client is not None and not h.retired \
+                    and h.client.alive:
+                reply = h.client.call({"op": "logs", "tail": int(tail)},
+                                      timeout=timeout)
+            out[h.idx] = reply
+        return out
+
+    def flight_snapshot(self, reason="requested", timeout=10.0):
+        """Ask every reachable pod to dump its flight recorder NOW
+        (``flight`` op). Returns {pod_id: dump path | None} — the files
+        land in the fleet log dir alongside crash dumps, so
+        ``flight_dumps()`` picks them up too."""
+        out = {}
+        for h in self._handles:
+            reply = None
+            if h.client is not None and not h.retired \
+                    and h.client.alive:
+                reply = h.client.call(
+                    {"op": "flight", "reason": str(reason)},
+                    timeout=timeout)
+            out[h.idx] = (reply or {}).get("path")
+        return out
 
     def _harvest_trace(self, h, reply, t_send, t_recv):
         """Fold the span buffer a pod piggybacked on a stats/drain reply

@@ -41,6 +41,18 @@ SIGKILL-exits with rc 137 mid-handler. Either way the fleet supervisor
 respawns the pod with backoff and the router replays its orphans. The
 socket is bound only AFTER the engine is built, so the router's
 connect-retry doubles as the readiness probe.
+
+Endpoints + data plane (ISSUE 19): when the fleet hands the pod a
+rendezvous store (``PADDLE_STORE_HOST``/``PADDLE_STORE_PORT``), the pod
+PUBLISHES its control endpoint — and, for adopting roles, its binary
+data-plane listener port — through ``elastic.publish_endpoint`` under
+generation = ``PADDLE_RESTART_COUNT``, instead of relying on a shared
+filesystem; the port file is still written when asked (debugging, the
+storeless fallback). Prefill pods receiving a ``handoff`` target
+resolve the decode pod's data endpoint through the store
+(stale generations rejected) and stream the KV bundle DIRECTLY to it
+over ``serving/wire.py`` frames; the decode pod stashes delivered
+bundles by rid until the router's ``adopt {remote: true}`` claims them.
 """
 from __future__ import annotations
 
@@ -160,6 +172,51 @@ class PodWorker:
                                                            0.5)))
         self._followers: dict = {}
         self._CheckpointFollower = CheckpointFollower
+        # ---- fleet data plane (ISSUE 19) --------------------------------
+        from paddle_tpu.serving import wire as _wire
+
+        self._wire = _wire
+        self.generation = int(os.environ.get("PADDLE_RESTART_COUNT",
+                                             "0") or 0)
+        self.host = os.environ.get("PADDLE_POD_HOST", "127.0.0.1")
+        self.wire_kwargs = dict(spec.get("wire") or {})
+        self.store = None
+        sh = os.environ.get("PADDLE_STORE_HOST")
+        sp = os.environ.get("PADDLE_STORE_PORT")
+        if sh and sp:
+            try:
+                from paddle_tpu.distributed.store import TCPStore
+
+                self.store = TCPStore(sh, int(sp), is_master=False)
+            except Exception as e:
+                # store down at boot: the pod still serves (port-file /
+                # direct-connect fallback); endpoint publication and the
+                # binary handoff degrade, requests do not
+                print(f"pod {self.pod_id}: store unreachable ({e}); "
+                      "serving without endpoint publication",
+                      file=sys.stderr)
+        # adopting roles run a data-plane listener: prefill pods stream
+        # KV bundles straight at it, `adopt {remote: true}` claims them
+        self._stash: dict = {}       # rid -> delivered payload dict
+        self._stash_lock = threading.Lock()
+        self._senders: dict = {}     # target pod id -> FrameSender
+        self._senders_lock = threading.Lock()
+        self.data_plane = None
+        if self.role != "prefill":
+            self.data_plane = _wire.DataPlaneListener(
+                self._stash_payload, host=self.host)
+
+    def _stash_payload(self, rid, payload, meta):
+        """DataPlaneListener delivery callback (connection thread):
+        park the verified bundle until the router's adopt claims it.
+        Idempotent by rid — a resent bundle overwrites its twin. The
+        stash is bounded: under a router that never adopts (died between
+        handoff and adopt), oldest-first eviction keeps the pod's memory
+        flat and the re-routed request simply re-prefills."""
+        with self._stash_lock:
+            while len(self._stash) >= 64:
+                self._stash.pop(next(iter(self._stash)))
+            self._stash[str(rid)] = payload
 
     # ------------------------------------------------------------ serving --
     def run(self):
@@ -173,7 +230,7 @@ class PodWorker:
         port = int(os.environ.get("PADDLE_POD_PORT", "0") or 0)
         srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind(("127.0.0.1", port))
+        srv.bind((self.host, port))
         srv.listen(4)
         port_file = os.environ.get("PADDLE_POD_PORT_FILE")
         if port_file:
@@ -181,6 +238,20 @@ class PodWorker:
             with open(tmp, "w") as f:
                 f.write(str(srv.getsockname()[1]))
             os.replace(tmp, port_file)
+        # publish the endpoint through the store (ISSUE 19): the router
+        # resolves host:port from here — no shared filesystem needed —
+        # and the generation (= restart count) lets it reject this
+        # pod's DEAD incarnations after a respawn
+        if self.store is not None:
+            from paddle_tpu.distributed.fleet.elastic import \
+                publish_endpoint
+
+            publish_endpoint(
+                self.store, self.pod_id, host=self.host,
+                port=srv.getsockname()[1], generation=self.generation,
+                role=self.role,
+                data_port=self.data_plane.port if self.data_plane
+                else 0)
         threading.Thread(target=self._fatal_watchdog, daemon=True,
                          name="paddle-tpu-pod-fatal").start()
         while True:
@@ -316,7 +387,23 @@ class PodWorker:
             return
         req = GenerationRequest(msg["prompt"], **self._options(msg))
         req.trace_id = msg.get("trace")
-        req.kv_payload = unpack_payload(msg["payload"])
+        if msg.get("remote"):
+            # binary transport: the payload arrived pod-to-pod over the
+            # data plane and is waiting in the stash. Missing means the
+            # delivered incarnation died (stash is process memory) — an
+            # explicit nak, which the router treats as loss (re-runs the
+            # pipeline), NOT as backpressure.
+            with self._stash_lock:
+                payload = self._stash.pop(str(msg["rid"]), None)
+            if payload is None:
+                send({"op": "nak", "mid": msg["mid"],
+                      "reason": "no stashed payload for rid "
+                                f"{msg['rid']} (delivered bundle lost "
+                                "across a respawn?)"})
+                return
+            req.kv_payload = payload
+        else:
+            req.kv_payload = unpack_payload(msg["payload"])
         try:
             self.server.submit_request(req)
         except (QueueFullError, RuntimeError) as e:
@@ -378,8 +465,59 @@ class PodWorker:
             send({"op": "error", "mid": msg["mid"],
                   "error": f"{type(e).__name__}: {e}"})
             return
+        handoff = msg.get("handoff")
+        if handoff and self.store is not None:
+            try:
+                nbytes, attempts = self._push_payload(
+                    msg["rid"], payload, handoff, msg.get("trace"))
+                send({"op": "prefill_done", "mid": msg["mid"],
+                      "first": first, "delivered": True,
+                      "bytes": nbytes, "attempts": attempts})
+                return
+            except Exception as e:
+                # data plane exhausted its retry budget (or the target
+                # endpoint never resolved): DEGRADE to the inline JSON
+                # payload — delivery falls back, the request never fails
+                self._registry.inc("fallbacks", scope="wire")
+                from paddle_tpu.profiler import explainer as _explain
+
+                _explain.record(
+                    "handoff_fallback", op="data_plane",
+                    why=f"binary handoff for rid {msg['rid']} failed "
+                        f"({type(e).__name__}: {e}); payload riding the "
+                        "control plane inline instead",
+                    rid=msg["rid"])
         send({"op": "prefill_done", "mid": msg["mid"], "first": first,
-              "payload": pack_payload(payload)})
+              "payload": pack_payload(payload), "delivered": False})
+
+    def _push_payload(self, rid, payload, handoff, trace):
+        """Stream one KV bundle straight to the decode pod named in
+        ``handoff``: resolve its data-plane endpoint through the store
+        (generations below ``min_gen`` — dead incarnations — rejected),
+        then frame it over the pooled per-target FrameSender. Returns
+        (bytes, attempts); raises DataPlaneError past the retry
+        budget."""
+        from paddle_tpu.distributed.fleet.elastic import resolve_endpoint
+
+        target = str(handoff["pod"])
+        min_gen = int(handoff.get("min_gen", 0))
+        doc = resolve_endpoint(self.store, target, min_gen=min_gen,
+                               timeout=5.0)
+        if not doc or not doc.get("data_port"):
+            raise self._wire.DataPlaneError(
+                f"no data-plane endpoint for pod {target} at gen >= "
+                f"{min_gen}")
+        host, dport = doc.get("host", "127.0.0.1"), int(doc["data_port"])
+        with self._senders_lock:
+            snd = self._senders.get(target)
+            if snd is None:
+                snd = self._senders[target] = self._wire.FrameSender(
+                    host, dport, link=f"pod{self.pod_id}->pod{target}",
+                    **self.wire_kwargs)
+            else:
+                # a respawned target published a fresh port: retarget
+                snd.retarget(host, dport)
+        return snd.send_payload(str(rid), payload, trace=trace)
 
     def _op_swap(self, msg, send):
         """Fleet-wide weight swap: reuse the checkpoint watcher's
@@ -443,6 +581,11 @@ class PodWorker:
               "handoff_imports": c["handoff_imports"],
               "kv_blocks_in_use": self.engine.pool.in_use(),
               "swap_count": self._swap_owner.scheduler.swap_count,
+              "generation": self.generation,
+              # data-plane wire counters + per-link byte/retry table:
+              # fleet.stats() sums these across pods
+              "data_plane": self._wire.stats(),
+              "links": self._wire.link_stats(),
               "timings": {k: {"count": v.get("count"),
                               "mean_ms": v.get("mean_ms")}
                           for k, v in
@@ -454,6 +597,37 @@ class PodWorker:
               # sampled as late as possible: the router midpoints its
               # send/recv stamps against this for the clock offset
               "mono_now": self._tracing.clock()})
+
+    def _op_logs(self, msg, send):
+        """Ship the tail of this pod's log OVER THE WIRE: with
+        store-published endpoints a pod may live on a host the router
+        has no filesystem view of, so log collection rides the control
+        socket like everything else."""
+        path = os.environ.get("PADDLE_POD_LOG")
+        text = ""
+        if path and os.path.exists(path):
+            try:
+                with open(path, "rb") as f:
+                    f.seek(0, os.SEEK_END)
+                    size = f.tell()
+                    f.seek(max(0, size - 65536))
+                    text = f.read().decode("utf-8", "replace")
+            except OSError:
+                text = ""
+        lines = text.splitlines()[-int(msg.get("tail", 200)):]
+        send({"op": "logs_reply", "mid": msg["mid"], "pod": self.pod_id,
+              "generation": self.generation, "path": path,
+              "lines": lines})
+
+    def _op_flight(self, msg, send):
+        """On-demand flight-recorder dump from a LIVE pod: write the
+        ring to the fleet log dir (the same place a dying pod leaves
+        it) and reply with the path — chaos drills get a parseable
+        post-mortem without having to kill anything."""
+        path = self._tracing.dump_flight_recorder(
+            reason=str(msg.get("reason") or "requested"))
+        send({"op": "flight_done", "mid": msg["mid"],
+              "pod": self.pod_id, "path": path})
 
     def _op_drain(self, msg, send):
         """Graceful retirement: finish every queued + in-flight request,

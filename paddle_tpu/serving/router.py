@@ -42,19 +42,42 @@ connection per pod, ``PodClient``) and holds the routing policy:
   are dropped, and pods themselves dedup re-sent submits by request id.
 
 * **Disaggregated routing** — with prefill/decode roles the router
-  pipelines each request through two pods: the least-loaded PREFILL pod
-  runs the prompt and returns the exported KV payload
-  (``engine.export_request_kv``), which the router forwards to a DECODE
-  pod chosen by the same affinity scheme; the decode pod adopts the
-  slot (``engine.import_request_kv``) and streams tokens. The handoff
-  rides the block-table serialization — raw block bytes, base64 over
-  the wire — and is token-bitwise with a monolithic pod.  Prefill
-  round-trips PIPELINE per connection (ISSUE 12 satellite, the PR 10
-  one-request-per-round-trip residual): ``PodClient.call`` is
-  mid-matched and thread-safe, and the pod runs each prefill on a side
-  thread, so N concurrent ``submit()`` callers keep N prefills in
-  flight on one socket — replies land as each engine-lock turn
-  finishes, not in lockstep.
+  pipelines each request through two pods: a PREFILL pod runs the
+  prompt and exports the KV payload (``engine.export_request_kv``),
+  a DECODE pod chosen by the same affinity scheme adopts the slot
+  (``engine.import_request_kv``) and streams tokens. Two transports
+  (ISSUE 19):
+
+  - ``data_plane="json"`` (the PR 10 original, kept as fallback and
+    bench baseline): the payload rides the control plane router-
+    mediated, raw block bytes base64 inside the prefill reply.
+  - ``data_plane="binary"``: the router picks the DECODE pod first and
+    hands the prefill pod a handoff target; the prefill pod resolves
+    the decode pod's data-plane endpoint through the store
+    (stale-generation rejected) and pushes the payload DIRECTLY,
+    pod-to-pod, as length-prefixed CRC'd tensor frames
+    (``serving/wire.py``) — the router then sends a payload-less
+    ``adopt {remote: true}`` and the decode pod picks the bundle out
+    of its stash. When the data plane exhausts its retry budget the
+    prefill reply carries the JSON payload instead (counted as
+    ``handoffs_fallback``) — delivery degrades, it never fails.
+
+  Both transports are token-bitwise with a monolithic pod. Prefill
+  round-trips PIPELINE per connection (ISSUE 12 satellite):
+  ``PodClient.call`` is mid-matched and thread-safe, and the pod runs
+  each prefill on a side thread, so N concurrent ``submit()`` callers
+  keep N prefills in flight on one socket — and in binary mode the
+  frame protocol pipelines the same way (bundles are contiguous,
+  ACKs are mid-matched).
+
+* **Circuit breaking** (ISSUE 19) — a FLAPPING pod (alive socket,
+  lost/timed-out replies) stops being routable before it can eat every
+  request's retry budget: ``breaker_threshold`` consecutive losses open
+  the pod's breaker for an exponentially growing cooldown, during
+  which ``_candidates`` skips it — its requests re-route or are held
+  and replayed, exactly like a down pod, so callers still NEVER see an
+  error from flapping. One success after the cooldown closes the
+  breaker.
 """
 from __future__ import annotations
 
@@ -80,7 +103,8 @@ _counters = _registry.scoped_counters("fleet", {
     "requests_routed": 0, "requests_completed": 0, "requests_failed": 0,
     "router_rejects": 0, "router_resubmits": 0, "affinity_hits": 0,
     "affinity_misses": 0, "affinity_spills": 0, "orphans_replayed": 0,
-    "handoffs": 0})
+    "handoffs": 0, "handoffs_binary": 0, "handoffs_fallback": 0,
+    "handoff_bytes": 0, "breaker_trips": 0})
 
 
 # ------------------------------------------------------------ wire utils --
@@ -163,10 +187,10 @@ class PodClient:
     caller treats that exactly like a lost message: re-route)."""
 
     def __init__(self, pod_id, port=None, on_async=None,
-                 host="127.0.0.1", port_file=None):
-        if (port is None) == (port_file is None):
+                 host="127.0.0.1", port_file=None, resolver=None):
+        if sum(x is not None for x in (port, port_file, resolver)) != 1:
             raise ValueError("PodClient needs exactly one of port / "
-                             "port_file")
+                             "port_file / resolver")
         self.pod_id = pod_id
         self.host = host
         self.port = None if port is None else int(port)
@@ -174,6 +198,12 @@ class PodClient:
         # port here (no preallocation race); re-read every connect
         # attempt so a respawned pod's fresh port is picked up
         self.port_file = port_file
+        # resolver: () -> {"host", "port", ...} | None — the ISSUE 19
+        # store-published path: endpoints come out of the rendezvous
+        # TCPStore (elastic.resolve_endpoint), re-resolved on every
+        # connect attempt so a pod respawning on a NEW host:port (with
+        # a bumped generation) is rediscovered without router restart
+        self.resolver = resolver
         self._on_async = on_async
         self._mid = itertools.count(1)
         self._pending: dict = {}   # mid -> [Event, reply|None]
@@ -186,29 +216,39 @@ class PodClient:
     def alive(self):
         return self._alive
 
-    def _resolve_port(self):
+    def _resolve_addr(self):
+        """(host, port) for this connect attempt, or None when the pod
+        hasn't published yet."""
+        if self.resolver is not None:
+            try:
+                doc = self.resolver()
+            except Exception:
+                return None
+            if not doc or not doc.get("port"):
+                return None
+            return doc.get("host", self.host), int(doc["port"])
         if self.port_file is None:
-            return self.port
+            return None if self.port is None else (self.host, self.port)
         try:
             with open(self.port_file) as f:
-                return int(f.read().strip() or 0) or None
+                port = int(f.read().strip() or 0) or None
         except (OSError, ValueError):
             return None
+        return None if port is None else (self.host, port)
 
     def connect(self, timeout=60.0):
         """Retry-connect until the pod's handler loop is up (the pod
-        binds its socket — and publishes its port — only after the
+        binds its socket — and publishes its endpoint — only after the
         engine is built, so a successful connect doubles as the
         readiness probe). Returns True on success."""
         deadline = time.monotonic() + float(timeout)
         while time.monotonic() < deadline:
-            port = self._resolve_port()
-            if port is None:
+            addr = self._resolve_addr()
+            if addr is None:
                 time.sleep(0.1)
                 continue
             try:
-                s = socket.create_connection((self.host, port),
-                                             timeout=1.0)
+                s = socket.create_connection(addr, timeout=1.0)
                 s.settimeout(None)
                 # small JSON lines in a request/response pattern: Nagle
                 # + delayed-ACK stalls every ack ~40ms without this
@@ -308,7 +348,8 @@ class PodClient:
 
 class _PodRec:
     __slots__ = ("pod_id", "client", "role", "healthy", "outstanding",
-                 "queued", "active")
+                 "queued", "active", "fail_streak", "breaker_until",
+                 "breaker_trips")
 
     def __init__(self, pod_id, client, role):
         self.pod_id = pod_id
@@ -318,6 +359,9 @@ class _PodRec:
         self.outstanding: set = set()  # rids acked on this pod, not done
         self.queued = 0
         self.active = 0
+        self.fail_streak = 0       # consecutive lost/timed-out replies
+        self.breaker_until = 0.0   # monotonic deadline while open
+        self.breaker_trips = 0     # lifetime trips (cooldown grows)
 
     @property
     def load(self):
@@ -330,14 +374,25 @@ class FleetRouter:
     ``redistribute``."""
 
     def __init__(self, policy="prefix", block_size=16, affinity_blocks=2,
-                 ack_timeout=15.0, prefill_timeout=300.0):
+                 ack_timeout=15.0, prefill_timeout=300.0,
+                 data_plane="json", breaker_threshold=3,
+                 breaker_cooldown=0.5):
         if policy not in ("prefix", "round_robin", "least_loaded"):
             raise ValueError(f"unknown routing policy {policy!r}")
+        if data_plane not in ("json", "binary"):
+            raise ValueError(f"unknown data plane {data_plane!r}")
         self.policy = policy
         self.block_size = int(block_size)
         self.affinity_blocks = int(affinity_blocks)
         self.ack_timeout = float(ack_timeout)
         self.prefill_timeout = float(prefill_timeout)
+        self.data_plane = data_plane
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown = float(breaker_cooldown)
+        # optional (pod_id) -> int hook the fleet installs so binary
+        # handoffs demand the decode pod's CURRENT generation from the
+        # store (a dead incarnation's endpoint is rejected as stale)
+        self.pod_min_gen = None
         self._pods: dict = {}       # pod_id -> _PodRec
         self._reqs: dict = {}       # rid -> FleetRequest
         self._affinity: dict = {}   # prefix key -> pod_id
@@ -432,10 +487,13 @@ class FleetRouter:
             return {pid: rec.load for pid, rec in self._pods.items()}
 
     def stats(self):
+        now = time.monotonic()
         with self._lock:
             pods = {pid: {"role": rec.role, "healthy": rec.healthy,
                           "outstanding": rec.load, "queued": rec.queued,
-                          "active": rec.active}
+                          "active": rec.active,
+                          "breaker_open": rec.breaker_until > now,
+                          "fail_streak": rec.fail_streak}
                     for pid, rec in self._pods.items()}
             held = len(self._held)
         return {"pods": pods, "held": held,
@@ -469,10 +527,12 @@ class FleetRouter:
         """Ordered candidate pods for a request. Returns (pods, sticky)
         where sticky is the affinity pod id that led the list (for hit
         accounting)."""
+        now = time.monotonic()
         with self._lock:
             live = [rec for rec in self._pods.values()
                     if rec.healthy and rec.role in roles
-                    and rec.client.alive]
+                    and rec.client.alive
+                    and rec.breaker_until <= now]
             if not live:
                 return [], None
             if self.policy == "round_robin":
@@ -493,6 +553,39 @@ class FleetRouter:
                                          if r is not rec], sticky)
                 sticky = None  # mapped pod gone; remap below
             return ordered, None
+
+    def _note_loss(self, rec):
+        """One lost/timed-out reply from a pod whose socket still looks
+        alive. ``breaker_threshold`` in a row opens the breaker: the pod
+        leaves the candidate set for an exponentially growing cooldown
+        (flapping pods re-trip with longer timeouts), so its traffic
+        degrades to held-and-replayed instead of burning every
+        request's attempt budget on a zombie."""
+        with self._lock:
+            rec.fail_streak += 1
+            if rec.fail_streak < self.breaker_threshold:
+                return
+            rec.fail_streak = 0
+            rec.breaker_trips += 1
+            cooldown = min(
+                self.breaker_cooldown * (2 ** (rec.breaker_trips - 1)),
+                10 * self.breaker_cooldown)
+            rec.breaker_until = time.monotonic() + cooldown
+        _counters["breaker_trips"] += 1
+        _explain.record(
+            "fleet_breaker_open", op="router",
+            why=f"pod {rec.pod_id} lost {self.breaker_threshold} "
+                f"consecutive replies; circuit open {cooldown:.2f}s — "
+                "its requests re-route or are held, never failed",
+            pod=rec.pod_id, cooldown=round(cooldown, 3),
+            trips=rec.breaker_trips)
+
+    def _note_ok(self, rec):
+        if rec.fail_streak or rec.breaker_until or rec.breaker_trips:
+            with self._lock:
+                rec.fail_streak = 0
+                rec.breaker_until = 0.0
+                rec.breaker_trips = 0
 
     def _remember_affinity(self, req, pod_id, sticky):
         if self.policy != "prefix":
@@ -534,7 +627,9 @@ class FleetRouter:
                      "trace": req.trace_id},
                     timeout=self.ack_timeout)
             if reply is None:
+                self._note_loss(rec)
                 continue  # lost before ack: try the next pod
+            self._note_ok(rec)
             if reply.get("op") == "ack":
                 if not self._bind(req, rec, reply):
                     continue  # pod died as it acked: next candidate
@@ -558,10 +653,12 @@ class FleetRouter:
 
     def _route_disagg(self, req):
         """Two-stage placement: prefill pod computes the prompt KV and
-        first token, the payload hops (router-mediated) to a decode pod
-        that adopts the slot. Either stage failing falls back to the
-        next candidate; a mid-pipeline pod death just re-runs the whole
-        pipeline (prefill is idempotent by seed)."""
+        first token, the payload hops to a decode pod that adopts the
+        slot. Either stage failing falls back to the next candidate; a
+        mid-pipeline pod death just re-runs the whole pipeline (prefill
+        is idempotent by seed)."""
+        if self.data_plane == "binary":
+            return self._route_disagg_binary(req)
         opts = req.options
         pre_pods, _ = self._candidates(req, roles=("prefill",))
         payload = None
@@ -573,12 +670,18 @@ class FleetRouter:
                  "trace": req.trace_id},
                 timeout=self.prefill_timeout)
             if reply is not None and reply.get("op") == "prefill_done":
+                self._note_ok(rec)
                 payload = reply["payload"]
                 break
+            self._note_loss(rec)
         if payload is None:
             self._hold(req)
             return
         _counters["handoffs"] += 1
+        # what the handoff costs the CONTROL channel: the payload as it
+        # rides the JSON line protocol (base64 + framing), comparable
+        # against the binary plane's frame bytes
+        _counters["handoff_bytes"] += len(json.dumps(payload))
         if h0:
             # prefill RPC + payload hop, as seen from the router — the
             # pods' own kv_export/kv_import spans nest inside this
@@ -599,7 +702,9 @@ class FleetRouter:
                      "payload": payload, "trace": req.trace_id},
                     timeout=self.ack_timeout)
             if reply is None:
+                self._note_loss(rec)
                 continue
+            self._note_ok(rec)
             if reply.get("op") == "ack":
                 if not self._bind(req, rec, reply):
                     continue
@@ -607,6 +712,95 @@ class FleetRouter:
                 return
             rejects += 1
             _counters["router_rejects"] += 1
+        if dec_pods and rejects == len(dec_pods):
+            with self._lock:
+                self._reqs.pop(req.rid, None)
+            raise QueueFullError(
+                f"all {rejects} eligible decode pods rejected request "
+                f"{req.rid} (admission budgets exhausted); retry later")
+        self._hold(req)
+
+    def _route_disagg_binary(self, req):
+        """Binary-transport disaggregation (ISSUE 19): the DECODE pod is
+        chosen FIRST (it is the affinity anchor and the handoff's
+        destination), then the prefill op carries a handoff target —
+        the prefill pod resolves the decode pod's data-plane endpoint
+        through the store (rejecting generations older than the fleet's
+        current restart count for that pod) and streams the KV bundle
+        straight to it; the router never touches a payload byte. The
+        prefill reply says whether direct delivery landed
+        (``delivered``) or the wire's retry budget ran out and the JSON
+        payload rode back inline (``handoffs_fallback`` — degraded,
+        never failed). A decode-side loss re-runs the whole pipeline
+        against the next decode candidate: prefill is idempotent by
+        seed, so the replay is bitwise."""
+        opts = req.options
+        dec_pods, sticky = self._candidates(req, roles=("decode",))
+        rejects = 0
+        for dec in dec_pods:
+            h0 = _tracing.clock() if _tracing.enabled() else 0.0
+            min_gen = (self.pod_min_gen(dec.pod_id)
+                       if self.pod_min_gen is not None else 0)
+            pre_pods, _ = self._candidates(req, roles=("prefill",))
+            reply = None
+            for rec in pre_pods:
+                reply = rec.client.call(
+                    {"op": "prefill", "rid": req.rid,
+                     "prompt": req.prompt_ids, "options": opts,
+                     "trace": req.trace_id,
+                     "handoff": {"pod": dec.pod_id,
+                                 "min_gen": int(min_gen)}},
+                    timeout=self.prefill_timeout)
+                if (reply is not None
+                        and reply.get("op") == "prefill_done"):
+                    self._note_ok(rec)
+                    break
+                self._note_loss(rec)
+                reply = None
+            if reply is None:
+                break  # no prefill capacity at all: hold below
+            delivered = bool(reply.get("delivered"))
+            _counters["handoffs"] += 1
+            _counters["handoffs_binary" if delivered
+                      else "handoffs_fallback"] += 1
+            _counters["handoff_bytes"] += (
+                int(reply.get("bytes", 0)) if delivered
+                else len(json.dumps(reply.get("payload") or {})))
+            if h0:
+                _tracing.add_span(
+                    req.trace_id, "handoff", h0, _tracing.clock(),
+                    meta={"bytes": int(reply.get("bytes", 0)),
+                          "transport": "binary" if delivered
+                          else "json_fallback", "decode_pod": dec.pod_id})
+            req.attempts += 1
+            if req.attempts > 1:
+                _counters["router_resubmits"] += 1
+            msg = {"op": "adopt", "rid": req.rid,
+                   "prompt": req.prompt_ids, "options": opts,
+                   "trace": req.trace_id}
+            if delivered:
+                msg["remote"] = True
+            else:
+                msg["payload"] = reply.get("payload")
+            if _faults.ACTIVE and _faults.fire("router_drop"):
+                areply = None
+            else:
+                areply = dec.client.call(msg, timeout=self.ack_timeout)
+            if areply is None:
+                self._note_loss(dec)
+                continue  # next decode pod; the pipeline re-runs
+            self._note_ok(dec)
+            if areply.get("op") == "ack":
+                if not self._bind(req, dec, areply):
+                    continue
+                self._remember_affinity(req, dec.pod_id, sticky)
+                return
+            if areply.get("op") == "reject":
+                rejects += 1
+                _counters["router_rejects"] += 1
+                continue
+            # anything else (stash lost across a respawn, protocol
+            # surprise): that's loss, not backpressure — next candidate
         if dec_pods and rejects == len(dec_pods):
             with self._lock:
                 self._reqs.pop(req.rid, None)

@@ -1,0 +1,751 @@
+"""paddle_tpu.serving.wire — the fleet's binary framed data plane.
+
+ISSUE 19 tentpole (2): the prefill→decode KV handoff used to ride the
+router's line-JSON control plane as base64 — three copies of every KV
+byte (prefill pod → router → decode pod, 4/3 inflated) on the same
+socket that carries acks. This module is the replacement: direct
+pod-to-pod length-prefixed tensor frames over a dedicated data socket,
+designed so a lossy link degrades to RETRIES, never to garbage KV.
+
+Frame layout (big-endian, 21-byte header)::
+
+    offset  size  field
+    0       2     magic   b"PF"
+    2       1     version (1)
+    3       1     kind    (OPEN/TENSOR/COMMIT/ACK/NACK/PING/PONG)
+    4       1     flags   bit0: payload CRC is CRC32C (Castagnoli),
+                          else CRC-32 (zlib). Each frame names its own
+                          checksum so mixed builds interoperate.
+    5       8     frame id (u64, sender-assigned, mid-matched by ACK/NACK)
+    13      4     payload length (u32; 0 is a valid frame)
+    17      4     payload checksum (u32)
+
+A KV payload is one contiguous *bundle* on the wire: ``OPEN`` (JSON
+meta: rid, trace id, scalar fields, tensor specs) → one ``TENSOR``
+frame per array (raw little-endian bytes, zero-copy out of numpy) →
+``COMMIT``. The receiver assembles the bundle, verifies every frame's
+checksum, and answers the COMMIT's frame id with ``ACK`` — or ``NACK``
+when anything in the bundle was bad. Fault model, by construction:
+
+* **corrupt payload** — checksum mismatch marks the bundle poisoned;
+  the COMMIT is NACKed and the sender retries. A corrupt frame is
+  *transport loss*, it is NEVER decoded into KV.
+* **truncation mid-frame / dead peer** — a short read desynchronizes
+  the stream, so the connection is dropped and both ends discard the
+  partial bundle; the sender reconnects and resends.
+* **half-open link / silent peer** — the per-request deadline trips,
+  the sender abandons the connection and retries on a fresh one.
+* **duplicate frames** — a duplicated COMMIT re-delivers an
+  already-complete bundle; receivers are idempotent by rid.
+
+``FrameSender`` keeps ONE pooled connection per destination and holds
+the write lock only while emitting a bundle's frames, so N prefill
+requests stay in flight per connection: bundles are contiguous on the
+wire but their ACKs return asynchronously, mid-matched by frame id,
+each with its own deadline and bounded retry/backoff budget.
+
+Counters land in the ``wire`` telemetry scope (tx/rx bytes + frames,
+retries, crc errors, nacks, fallbacks) plus a per-link byte/retry table
+(`link_stats()`); pods ship both inside their ``stats`` replies so
+``fleet.stats()`` can render the whole data plane.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+from ..profiler import tracing as _tracing
+from ..testing import netfaults as _netfaults
+
+__all__ = [
+    "MAGIC", "VERSION", "HEADER", "FrameError", "FrameProtocolError",
+    "FrameVersionError", "FrameCRCError", "FrameTruncatedError",
+    "DataPlaneError", "crc32c_sw", "checksum", "verify_checksum",
+    "pack_frame", "read_frame", "encode_payload", "decode_payload",
+    "payload_nbytes", "FrameSender", "DataPlaneListener", "stats",
+    "link_stats", "reset_stats",
+]
+
+MAGIC = b"PF"
+VERSION = 1
+
+# frame kinds
+OPEN = 1       # bundle meta (JSON): rid, trace, scalars, tensor specs
+TENSOR = 2     # one raw tensor body
+COMMIT = 3     # bundle end; ACK/NACK answers THIS frame id
+ACK = 4        # bundle delivered + verified (payload: JSON {rid})
+NACK = 5       # bundle refused (payload: JSON {rid, reason})
+PING = 6
+PONG = 7
+
+FLAG_CRC32C = 0x01
+
+# magic(2) version(1) kind(1) flags(1) frame_id(8) length(4) crc(4)
+HEADER = struct.Struct("!2sBBBQII")
+
+# a frame longer than this is a desynchronized stream, not a payload
+MAX_FRAME_BYTES = 1 << 31
+
+_counters = _registry.scoped_counters("wire", {
+    "tx_frames": 0, "tx_bytes": 0, "rx_frames": 0, "rx_bytes": 0,
+    "tx_retries": 0, "tx_payloads": 0, "rx_payloads": 0,
+    "crc_errors": 0, "nacks_sent": 0, "nacks_seen": 0,
+    "conn_resets": 0, "fallbacks": 0})
+
+_links: dict = {}          # link label -> {"tx_bytes", "tx_payloads", ...}
+_links_lock = threading.Lock()
+
+
+def _link(label):
+    with _links_lock:
+        ent = _links.get(label)
+        if ent is None:
+            ent = _links[label] = {"tx_bytes": 0, "rx_bytes": 0,
+                                   "tx_payloads": 0, "rx_payloads": 0,
+                                   "retries": 0}
+        return ent
+
+
+def stats():
+    """The wire scope's counter snapshot (what a pod ships as its
+    ``data_plane`` stats block)."""
+    return dict(_registry.counters("wire"))
+
+
+def link_stats():
+    with _links_lock:
+        return {k: dict(v) for k, v in _links.items()}
+
+
+def reset_stats():
+    with _links_lock:
+        _links.clear()
+
+
+# ------------------------------------------------------------- checksums --
+
+class FrameError(Exception):
+    """Base for every framing failure. All of them mean TRANSPORT LOSS:
+    the caller retries or drops the connection, it never decodes."""
+
+
+class FrameProtocolError(FrameError):
+    """Bad magic / insane length: the stream is desynchronized."""
+
+
+class FrameVersionError(FrameError):
+    """Peer speaks a frame version this build does not."""
+
+
+class FrameCRCError(FrameError):
+    """Payload checksum mismatch (carries .frame_id for the NACK)."""
+
+    def __init__(self, msg, frame_id=0):
+        super().__init__(msg)
+        self.frame_id = frame_id
+
+
+class FrameTruncatedError(FrameError):
+    """Short read mid-header or mid-payload (link cut / peer died)."""
+
+
+class DataPlaneError(RuntimeError):
+    """A payload could not be delivered within its retry/deadline
+    budget. The prefill pod falls back to the inline-JSON handoff."""
+
+
+def _crc32c_table():
+    poly = 0x82F63B78
+    table = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ poly if c & 1 else c >> 1
+        table.append(c)
+    return table
+
+
+_CRC32C_TABLE = _crc32c_table()
+
+
+def crc32c_sw(data, crc=0):
+    """Pure-python CRC32C (Castagnoli, the iSCSI polynomial) — the
+    reference implementation every build shares, used to VERIFY
+    FLAG_CRC32C frames when no accelerated library is importable.
+    Test vector: crc32c_sw(b"123456789") == 0xE3069283."""
+    crc = crc ^ 0xFFFFFFFF
+    table = _CRC32C_TABLE
+    for b in data:
+        crc = table[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+try:  # accelerated CRC32C when the wheel exists; never a hard dep
+    import crc32c as _crc32c_mod
+
+    def _crc32c_fast(data):
+        return _crc32c_mod.crc32c(data)
+except Exception:  # pragma: no cover - env-dependent
+    _crc32c_mod = None
+    _crc32c_fast = None
+
+
+def checksum(data):
+    """(crc, flags) for an outgoing frame: CRC32C when the accelerated
+    library is present (flagged so the peer verifies with the right
+    polynomial), zlib's C-speed CRC-32 otherwise. Large KV payloads
+    must not pay a per-byte python loop on the send path."""
+    if _crc32c_fast is not None:
+        return _crc32c_fast(data), FLAG_CRC32C
+    return zlib.crc32(data) & 0xFFFFFFFF, 0
+
+
+def verify_checksum(data, crc, flags):
+    if flags & FLAG_CRC32C:
+        got = (_crc32c_fast(data) if _crc32c_fast is not None
+               else crc32c_sw(data))
+    else:
+        got = zlib.crc32(data) & 0xFFFFFFFF
+    return got == (crc & 0xFFFFFFFF)
+
+
+# ----------------------------------------------------------- frame codec --
+
+def pack_frame(kind, frame_id, payload=b"", flags=None):
+    """One frame as bytes. ``payload`` may be empty (a zero-length
+    frame is valid — COMMIT/PING carry no body)."""
+    payload = bytes(payload)
+    crc, crc_flag = checksum(payload)
+    flags = crc_flag if flags is None else flags
+    return HEADER.pack(MAGIC, VERSION, kind, flags, frame_id,
+                       len(payload), crc) + payload
+
+
+def _read_exact(read, n):
+    """Read exactly n bytes through ``read(k) -> bytes`` (socket.recv
+    semantics: b"" means the peer closed). Returns None on a clean EOF
+    at a frame boundary; raises FrameTruncatedError mid-read."""
+    if n == 0:
+        return b""
+    chunks = []
+    got = 0
+    while got < n:
+        b = read(n - got)
+        if not b:
+            if got == 0:
+                return None
+            raise FrameTruncatedError(
+                f"stream cut {got}/{n} bytes into a read")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)
+
+
+def read_frame(read):
+    """Read one frame through ``read(k) -> bytes``. Returns
+    (kind, flags, frame_id, payload) or None on clean EOF. Raises a
+    FrameError subclass on anything malformed — callers treat every one
+    of them as transport loss (drop the connection / NACK + retry),
+    NEVER as data."""
+    hdr = _read_exact(read, HEADER.size)
+    if hdr is None:
+        return None
+    magic, version, kind, flags, frame_id, length, crc = \
+        HEADER.unpack(hdr)
+    if magic != MAGIC:
+        raise FrameProtocolError(
+            f"bad magic {magic!r}: stream desynchronized")
+    if version != VERSION:
+        raise FrameVersionError(
+            f"peer frame version {version}, this build speaks "
+            f"{VERSION} only")
+    if length > MAX_FRAME_BYTES:
+        raise FrameProtocolError(
+            f"frame length {length} is not a sane payload")
+    payload = _read_exact(read, length)
+    if payload is None and length:
+        raise FrameTruncatedError("stream cut between header and payload")
+    payload = payload or b""
+    if not verify_checksum(payload, crc, flags):
+        raise FrameCRCError(
+            f"frame {frame_id} checksum mismatch over {length} bytes",
+            frame_id=frame_id)
+    return kind, flags, frame_id, payload
+
+
+# --------------------------------------------------------- payload codec --
+
+def encode_payload(payload):
+    """``engine.export_request_kv`` dict → (meta dict, [ndarray, ...]).
+    ndarray-valued fields (and lists of ndarrays) become TENSOR frames
+    in spec order; everything else rides the OPEN frame's JSON meta.
+    Bitwise: raw little-endian bytes, dtype/shape in the spec."""
+    meta, specs, tensors = {}, [], []
+    for k in sorted(payload):
+        v = payload[k]
+        if isinstance(v, np.ndarray):
+            a = np.ascontiguousarray(v)
+            specs.append({"field": k, "list": False,
+                          "shape": list(a.shape), "dtype": str(a.dtype)})
+            tensors.append(a)
+        elif (isinstance(v, (list, tuple)) and v
+              and all(isinstance(a, np.ndarray) for a in v)):
+            arrs = [np.ascontiguousarray(a) for a in v]
+            specs.append({"field": k, "list": True,
+                          "shape": [list(a.shape) for a in arrs],
+                          "dtype": [str(a.dtype) for a in arrs]})
+            tensors.extend(arrs)
+        else:
+            meta[k] = v
+    return {"meta": meta, "tensors": specs}, tensors
+
+
+def decode_payload(doc, bodies):
+    """Inverse of :func:`encode_payload` — bit-exact reconstruction
+    (zero-length tensors included)."""
+    out = dict(doc["meta"])
+    i = 0
+    for spec in doc["tensors"]:
+        if spec["list"]:
+            arrs = []
+            for shape, dtype in zip(spec["shape"], spec["dtype"]):
+                arrs.append(np.frombuffer(
+                    bodies[i], dtype=np.dtype(dtype)).reshape(shape)
+                    .copy())
+                i += 1
+            out[spec["field"]] = arrs
+        else:
+            out[spec["field"]] = np.frombuffer(
+                bodies[i], dtype=np.dtype(spec["dtype"])
+            ).reshape(spec["shape"]).copy()
+            i += 1
+    if i != len(bodies):
+        raise FrameProtocolError(
+            f"bundle carried {len(bodies)} tensors, meta names {i}")
+    return out
+
+
+def payload_nbytes(payload):
+    n = 0
+    for v in payload.values():
+        if isinstance(v, np.ndarray):
+            n += v.nbytes
+        elif isinstance(v, (list, tuple)):
+            n += sum(a.nbytes for a in v if isinstance(a, np.ndarray))
+    return n
+
+
+# ---------------------------------------------------------------- sender --
+
+def _tx(sock, data, wire_counts=True):
+    """The ONE socket-send seam: every data-plane byte leaves through
+    here, so the chaos layer (`testing/netfaults.py`) can drop, delay,
+    duplicate, truncate or corrupt frames without touching protocol
+    code. Returns False when the injected plan says the link died."""
+    chunks, close_after, delay = ([data], False, 0.0)
+    if _netfaults.ACTIVE:
+        chunks, close_after, delay = _netfaults.tx_plan(data)
+    if delay:
+        time.sleep(delay)
+    for c in chunks:
+        sock.sendall(c)
+        if wire_counts:
+            _counters["tx_bytes"] += len(c)
+    if close_after:
+        try:
+            sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        sock.close()
+        return False
+    return True
+
+
+class FrameSender:
+    """One pooled data-plane connection to one destination pod.
+
+    ``send_payload`` is thread-safe and pipelined: the write lock is
+    held only while a bundle's frames are emitted; ACK/NACKs come back
+    on a reader thread, mid-matched by the COMMIT's frame id, so many
+    payloads ride one connection concurrently, each with its own
+    deadline and retry budget."""
+
+    def __init__(self, host, port, link="", connect_timeout=5.0,
+                 attempt_timeout=10.0, retries=4, backoff=0.05):
+        self.host = host
+        self.port = int(port)
+        self.link = link or f"{host}:{port}"
+        self.connect_timeout = float(connect_timeout)
+        self.attempt_timeout = float(attempt_timeout)
+        self.retries = int(retries)
+        self.backoff = float(backoff)
+        self._fids = itertools.count(1)
+        self._pending: dict = {}   # frame_id -> [Event, ok, reason]
+        self._plock = threading.Lock()
+        self._wlock = threading.Lock()
+        self._sock = None
+
+    def retarget(self, host, port):
+        """Point this sender at a respawned destination (fresh store-
+        published endpoint). The old connection is dropped; in-flight
+        bundles fail their attempt and retry against the new address."""
+        if (host, int(port)) == (self.host, self.port):
+            return
+        self.host, self.port = host, int(port)
+        self.close()
+
+    # -------------------------------------------------------- connection --
+    def _connect(self, deadline):
+        while time.monotonic() < deadline:
+            try:
+                s = socket.create_connection(
+                    (self.host, self.port),
+                    timeout=min(1.0, self.connect_timeout))
+                s.settimeout(None)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                return s
+            except OSError:
+                time.sleep(0.05)
+        return None
+
+    def _ensure_conn(self, deadline):
+        with self._wlock:
+            if self._sock is not None:
+                return self._sock
+        s = self._connect(deadline)
+        if s is None:
+            return None
+        with self._wlock:
+            if self._sock is None:
+                self._sock = s
+                threading.Thread(
+                    target=self._read_loop, args=(s,), daemon=True,
+                    name=f"paddle-tpu-wire-tx-{self.link}").start()
+                return s
+        # raced another connector; keep theirs
+        try:
+            s.close()
+        except OSError:
+            pass
+        return self._sock
+
+    def _drop_conn(self, sock):
+        with self._wlock:
+            if self._sock is sock:
+                self._sock = None
+                _counters["conn_resets"] += 1
+        try:
+            sock.close()
+        except OSError:
+            pass
+
+    def close(self):
+        with self._wlock:
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        with self._plock:
+            pending, self._pending = self._pending, {}
+        for ev, *_ in pending.values():
+            ev.set()
+
+    def _read_loop(self, sock):
+        try:
+            while True:
+                fr = read_frame(sock.recv)
+                if fr is None:
+                    break
+                kind, _flags, fid, body = fr
+                if kind not in (ACK, NACK):
+                    continue
+                if kind == NACK:
+                    _counters["nacks_seen"] += 1
+                reason = None
+                if kind == NACK and body:
+                    try:
+                        reason = json.loads(body).get("reason")
+                    except ValueError:
+                        pass
+                with self._plock:
+                    ent = self._pending.pop(fid, None)
+                if ent is not None:
+                    ent[1] = kind == ACK
+                    ent[2] = reason
+                    ent[0].set()
+        except FrameError:
+            pass
+        except OSError:
+            pass
+        finally:
+            self._drop_conn(sock)
+            # in-flight bundles on this connection will time out and
+            # retry on a fresh one; waking them now is just faster
+            with self._plock:
+                stale = [ent for ent in self._pending.values()
+                         if ent[1] is None]
+            for ent in stale:
+                ent[0].set()
+
+    # ------------------------------------------------------------- sends --
+    def _emit_bundle(self, sock, rid, doc, bodies):
+        """Write OPEN + TENSOR* + COMMIT contiguously (write lock held
+        by the caller). Returns (commit_fid, bytes, frames) or None when
+        the injected chaos plan killed the link mid-bundle."""
+        frames = []
+        open_body = json.dumps({"rid": rid, **doc}).encode("utf-8")
+        frames.append(pack_frame(OPEN, next(self._fids), open_body))
+        for a in bodies:
+            frames.append(pack_frame(TENSOR, next(self._fids),
+                                     a.tobytes() if hasattr(a, "tobytes")
+                                     else bytes(a)))
+        commit_fid = next(self._fids)
+        frames.append(pack_frame(COMMIT, commit_fid))
+        ent = [threading.Event(), None, None]
+        with self._plock:
+            self._pending[commit_fid] = ent
+        total = 0
+        for fb in frames:
+            total += len(fb)
+            if not _tx(sock, fb):
+                with self._plock:
+                    self._pending.pop(commit_fid, None)
+                return None
+            _counters["tx_frames"] += 1
+        return commit_fid, ent, total, len(frames)
+
+    def send_payload(self, rid, payload, trace=None, deadline=None,
+                     retries=None, on_retry=None):
+        """Deliver one KV payload bundle; returns (bytes_sent, attempts).
+        Retries with exponential backoff inside ``deadline`` (seconds
+        from now; default retries × attempt_timeout); raises
+        DataPlaneError when the budget is exhausted — the caller decides
+        the fallback, this layer never fakes success."""
+        doc, bodies = encode_payload(payload)
+        if trace:
+            doc["meta"]["trace"] = trace
+        retries = self.retries if retries is None else int(retries)
+        deadline = time.monotonic() + (
+            float(deadline) if deadline is not None
+            else (retries + 1) * self.attempt_timeout)
+        link = _link(self.link)
+        last = "unreachable"
+        for attempt in range(retries + 1):
+            if attempt:
+                _counters["tx_retries"] += 1
+                link["retries"] += 1
+                if on_retry is not None:
+                    on_retry(attempt, last)
+                time.sleep(min(self.backoff * (2 ** (attempt - 1)), 1.0))
+            if time.monotonic() >= deadline:
+                break
+            t0 = _tracing.clock() if _tracing.enabled() else 0.0
+            sock = self._ensure_conn(deadline)
+            if sock is None:
+                last = "connect timeout"
+                continue
+            with self._wlock:
+                if self._sock is not sock:
+                    continue
+                emitted = self._emit_bundle(sock, rid, doc, bodies)
+            if emitted is None:
+                last = "link dropped mid-bundle"
+                self._drop_conn(sock)
+                continue
+            commit_fid, ent, nbytes, nframes = emitted
+            wait = min(self.attempt_timeout,
+                       max(0.01, deadline - time.monotonic()))
+            ent[0].wait(wait)
+            with self._plock:
+                self._pending.pop(commit_fid, None)
+            if ent[1]:
+                _counters["tx_payloads"] += 1
+                link["tx_bytes"] += nbytes
+                link["tx_payloads"] += 1
+                if t0:
+                    _tracing.add_span(
+                        trace, "frame_tx", t0, _tracing.clock(),
+                        meta={"frame": commit_fid, "bytes": nbytes,
+                              "frames": nframes, "rid": rid,
+                              "link": self.link, "attempt": attempt + 1})
+                return nbytes, attempt + 1
+            last = ent[2] or ("nack" if ent[1] is False else
+                              "ack deadline")
+            # a NACK means the stream itself is fine (the peer answered)
+            # but the bundle was poisoned; a timeout means the link may
+            # be half-open — drop it so the retry starts clean
+            if ent[1] is None:
+                self._drop_conn(sock)
+        raise DataPlaneError(
+            f"payload for rid {rid} undeliverable to {self.link} after "
+            f"{retries + 1} attempts (last: {last})")
+
+
+# -------------------------------------------------------------- listener --
+
+class DataPlaneListener:
+    """The receiving end of the data plane: every serve/decode pod binds
+    one (port 0, kernel-assigned, published through the store) and
+    assembles inbound bundles. ``deliver(rid, payload, meta)`` runs on
+    the connection thread once a bundle is COMPLETE AND VERIFIED; a
+    poisoned bundle is NACKed and discarded — checksum failures are
+    transport loss, the payload dict is never built from them."""
+
+    def __init__(self, deliver, host="127.0.0.1", port=0, backlog=8):
+        self.deliver = deliver
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, int(port)))
+        self._srv.listen(backlog)
+        self.host, self.port = self._srv.getsockname()[:2]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name="paddle-tpu-wire-rx")
+        self._thread.start()
+
+    def close(self):
+        self._stop.set()
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name="paddle-tpu-wire-rx-conn").start()
+
+    def _reply(self, conn, kind, fid, rid=None, reason=None):
+        body = {}
+        if rid is not None:
+            body["rid"] = rid
+        if reason is not None:
+            body["reason"] = reason
+        try:
+            _tx(conn, pack_frame(kind, fid,
+                                 json.dumps(body).encode("utf-8")),
+                wire_counts=False)
+        except OSError:
+            pass
+
+    def _serve_conn(self, conn):
+        bundle = None  # {"rid", "doc", "bodies", "bad", "t0", "bytes"}
+        try:
+            while True:
+                if _netfaults.ACTIVE and _netfaults.rx_hold():
+                    # injected half-open link: the peer's socket stays
+                    # connected but this end goes silent — their
+                    # deadline must trip and retry on a new connection
+                    while conn.recv(65536):
+                        pass
+                    return
+                try:
+                    fr = read_frame(conn.recv)
+                except FrameCRCError as e:
+                    # stream framing is intact (length was readable):
+                    # poison the open bundle, keep the connection
+                    _counters["crc_errors"] += 1
+                    _explain.record(
+                        "wire_crc_mismatch", op="data_plane",
+                        why=f"frame {e.frame_id} failed its checksum; "
+                            "treated as transport loss (bundle NACKed, "
+                            "sender retries) — never decoded",
+                        frame=e.frame_id)
+                    if bundle is not None:
+                        bundle["bad"] = "crc"
+                    continue
+                except FrameError:
+                    # desynchronized / truncated / alien version: the
+                    # only safe move is dropping the connection; the
+                    # sender's deadline retries on a fresh one
+                    _counters["conn_resets"] += 1
+                    return
+                if fr is None:
+                    return
+                kind, _flags, fid, body = fr
+                _counters["rx_frames"] += 1
+                _counters["rx_bytes"] += HEADER.size + len(body)
+                if kind == PING:
+                    self._reply(conn, PONG, fid)
+                    continue
+                if kind == OPEN:
+                    try:
+                        doc = json.loads(body)
+                    except ValueError:
+                        bundle = {"rid": None, "bad": "open_json"}
+                        continue
+                    bundle = {"rid": doc.pop("rid", None), "doc": doc,
+                              "bodies": [], "bad": None,
+                              "bytes": HEADER.size + len(body),
+                              "t0": _tracing.clock()
+                              if _tracing.enabled() else 0.0}
+                    continue
+                if kind == TENSOR:
+                    if bundle is None:
+                        continue  # stray tensor (dup after commit)
+                    bundle["bodies"].append(body)
+                    bundle["bytes"] += HEADER.size + len(body)
+                    continue
+                if kind == COMMIT:
+                    cur, bundle = bundle, None
+                    if cur is None:
+                        self._reply(conn, NACK, fid,
+                                    reason="commit without bundle")
+                        _counters["nacks_sent"] += 1
+                        continue
+                    if cur["bad"]:
+                        self._reply(conn, NACK, fid, rid=cur["rid"],
+                                    reason=cur["bad"])
+                        _counters["nacks_sent"] += 1
+                        continue
+                    try:
+                        payload = decode_payload(cur["doc"],
+                                                 cur["bodies"])
+                    except (FrameError, KeyError, ValueError,
+                            TypeError) as e:
+                        self._reply(conn, NACK, fid, rid=cur["rid"],
+                                    reason=f"decode: {e}")
+                        _counters["nacks_sent"] += 1
+                        continue
+                    meta = cur["doc"].get("meta", {})
+                    trace = meta.get("trace") or payload.get("trace")
+                    try:
+                        self.deliver(cur["rid"], payload, meta)
+                    except Exception as e:
+                        self._reply(conn, NACK, fid, rid=cur["rid"],
+                                    reason=f"deliver: {e}")
+                        _counters["nacks_sent"] += 1
+                        continue
+                    _counters["rx_payloads"] += 1
+                    if cur["t0"]:
+                        _tracing.add_span(
+                            trace, "frame_rx", cur["t0"],
+                            _tracing.clock(),
+                            meta={"frame": fid, "bytes": cur["bytes"],
+                                  "rid": cur["rid"]})
+                    self._reply(conn, ACK, fid, rid=cur["rid"])
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -58,6 +58,12 @@ accepted by :func:`configure` directly::
     "step_hang:step=5,secs=30"           sleep 30 s inside the step-5
                                          body — the step watchdog must
                                          trip, dump stacks, escalate
+    "net_drop:nth=2" (and net_delay, net_dup, net_truncate,
+    net_corrupt, net_half_open)          data-plane chaos; same grammar,
+                                         forwarded to testing.netfaults
+                                         (see its docstring) and fired
+                                         at the serving/wire.py socket
+                                         seam
 
 Points (consumed by the named subsystems):
 
@@ -139,16 +145,25 @@ def parse_spec(text):
 
 def configure(spec_or_table):
     """Arm the harness. Accepts a spec string or a parsed table; an
-    empty/falsy argument disarms (same as :func:`reset`)."""
+    empty/falsy argument disarms (same as :func:`reset`). `net_*`
+    points (the data-plane chaos layer) are forwarded to
+    `testing.netfaults`, so one spec arms both surfaces."""
     global ACTIVE
     table = parse_spec(spec_or_table) if isinstance(spec_or_table, str) \
         else dict(spec_or_table or {})
     _points.clear()
+    net = {}
     for point, params in table.items():
+        if point.startswith("net_"):
+            net[point] = params
+            continue
         _points[point] = {"params": dict(params), "count": 0}
         _counters.setdefault(f"armed.{point}", 0)
         _counters[f"armed.{point}"] += 1
     ACTIVE = bool(_points)
+    from . import netfaults as _netfaults
+
+    _netfaults.configure(net)
     return dict(table)
 
 
@@ -158,6 +173,9 @@ def reset():
     global ACTIVE
     _points.clear()
     ACTIVE = False
+    from . import netfaults as _netfaults
+
+    _netfaults.reset()
 
 
 def spec():

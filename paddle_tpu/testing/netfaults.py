@@ -1,0 +1,193 @@
+"""Network chaos for the fleet data plane (ISSUE 19 tentpole level 3).
+
+`serving/wire.py` funnels every data-plane byte through one send seam
+(`_tx`) and marks one hold point in its receive loop, so THIS module
+can misbehave like a real lossy network without any protocol code
+knowing: frames get dropped, delayed, duplicated, truncated mid-frame,
+bit-flipped, or the receiving end goes silent on a connection that
+stays open. The store-partition window (the seventh fault the issue
+names) already lives in `faults.py` at the TCPStore op seam.
+
+Armed through the SAME spec grammar as `testing.faults` — a `net_*`
+point in `FLAGS_fault_inject` is forwarded here by `faults.configure`
+(and by this module's own env check, for processes that import the
+wire before any fault site)::
+
+    "net_delay:delay=0.05"            every data-plane send sleeps 50 ms
+    "net_delay:delay=0.05,times=3"    ... only the first 3 sends
+    "net_drop:nth=2"                  the 2nd frame vanishes and the
+                                      link dies (sender must reconnect
+                                      and resend the bundle)
+    "net_dup:nth=2"                   the 2nd frame is sent twice
+                                      (receiver must stay idempotent)
+    "net_truncate:nth=2"              the 2nd frame is cut mid-frame and
+                                      the link dies (desync = conn loss)
+    "net_truncate:nth=2,bytes=9"      ... keeping only 9 bytes
+    "net_corrupt:nth=2"               one byte of the 2nd frame flips —
+                                      the CRC must catch it; the bundle
+                                      is NACKed and resent, the corrupt
+                                      payload is NEVER decoded
+    "net_corrupt:nth=2,times=3"       ... and the next 2 after it
+    "net_half_open:nth=1"             the 1st receiving connection goes
+                                      silent (reads forever, never acks)
+                                      — the sender's deadline must trip
+
+`nth` counts that point's opportunities process-wide, 1-based, and
+fires once (plus `times-1` repeats when given). Every firing bumps
+`fault.injected.<point>` and records a `fault_injected` explainer
+event, same contract as `faults.fire` — chaos is observable, never
+silent.
+
+Fault seams (consumed by `serving/wire.py`):
+
+* ``tx_plan(data) -> (chunks, close_after, delay)`` — called per
+  outgoing frame; the wire sends each chunk in order, sleeps `delay`
+  first, and kills the connection after when `close_after`.
+* ``rx_hold() -> bool`` — called as each receiving connection starts
+  serving; True turns that connection into a black hole.
+"""
+from __future__ import annotations
+
+import os
+
+from ..profiler import explainer as _explain
+from ..profiler import registry as _registry
+
+__all__ = ["configure", "reset", "spec", "tx_plan", "rx_hold", "ACTIVE"]
+
+# fast-path gate, same idiom as faults.ACTIVE: the wire checks this
+# module global before calling tx_plan/rx_hold
+ACTIVE = False
+
+_points: dict = {}
+_counters = _registry.scoped_counters("fault", {})
+
+TX_POINTS = ("net_delay", "net_drop", "net_dup", "net_truncate",
+             "net_corrupt")
+RX_POINTS = ("net_half_open",)
+
+
+def configure(table):
+    """Arm from {point: {param: value}} (already-parsed spec, net_*
+    names only — `faults.configure` forwards them). Falsy disarms."""
+    global ACTIVE
+    _points.clear()
+    for point, params in dict(table or {}).items():
+        if point in TX_POINTS or point in RX_POINTS:
+            _points[point] = {"params": dict(params), "count": 0}
+            _counters.setdefault(f"armed.{point}", 0)
+            _counters[f"armed.{point}"] += 1
+    ACTIVE = bool(_points)
+    return spec()
+
+
+def reset():
+    global ACTIVE
+    _points.clear()
+    ACTIVE = False
+
+
+def spec():
+    return {k: dict(v["params"]) for k, v in _points.items()}
+
+
+def _from_flag():
+    """Self-arm from FLAGS_fault_inject for processes where the wire is
+    hit before any faults.fire site imports faults (the forwarding in
+    faults.configure covers every other path)."""
+    text = os.environ.get("FLAGS_fault_inject", "")
+    if not text or "net_" not in text:
+        return
+    try:
+        from . import faults as _faults
+
+        configure({k: v for k, v in _faults.parse_spec(text).items()})
+    except Exception:
+        pass
+
+
+_from_flag()
+
+
+def _due(point):
+    """Count one opportunity at `point`; True when the armed window
+    (nth .. nth+times-1, default times=1; or first `times` when no nth)
+    covers it."""
+    ent = _points.get(point)
+    if ent is None:
+        return False
+    ent["count"] += 1
+    p = ent["params"]
+    times = int(p.get("times", 1))
+    nth = p.get("nth")
+    if nth is None:
+        first, last = 1, times if "times" in p else 1 << 62
+    else:
+        first, last = int(nth), int(nth) + times - 1
+    return first <= ent["count"] <= last
+
+
+def _record(point, why, **detail):
+    key = f"injected.{point}"
+    _counters[key] = _counters.get(key, 0) + 1
+    _explain.record("fault_injected", op=point, why=why, **detail)
+
+
+def tx_plan(data):
+    """The send-seam verdict for one outgoing frame. Returns
+    (chunks, close_after, delay): the wire sends each chunk after
+    sleeping `delay`, then drops the connection when `close_after`.
+    At most one destructive fault applies per frame (delay stacks)."""
+    chunks, close_after, delay = [data], False, 0.0
+
+    ent = _points.get("net_delay")
+    if ent is not None and _due("net_delay"):
+        delay = float(ent["params"].get("delay", 0.05))
+        _record("net_delay", f"data-plane send delayed {delay}s",
+                bytes=len(data))
+
+    if _due("net_drop"):
+        _record("net_drop",
+                f"frame of {len(data)} bytes dropped, link killed",
+                bytes=len(data))
+        return [], True, delay
+
+    if _due("net_truncate"):
+        p = _points["net_truncate"]["params"]
+        keep = int(p.get("bytes", max(1, len(data) // 2)))
+        keep = max(0, min(keep, len(data) - 1))
+        _record("net_truncate",
+                f"frame cut at byte {keep}/{len(data)}, link killed",
+                bytes=len(data), kept=keep)
+        return [data[:keep]], True, delay
+
+    if _due("net_corrupt"):
+        p = _points["net_corrupt"]["params"]
+        # default: flip a byte past the 21-byte header so the payload
+        # CRC (not stream desync) is what catches it
+        off = int(p.get("offset",
+                        21 + (len(data) - 22) // 2 if len(data) > 22
+                        else len(data) - 1))
+        off = max(0, min(off, len(data) - 1))
+        data = data[:off] + bytes([data[off] ^ 0xFF]) + data[off + 1:]
+        _record("net_corrupt",
+                f"byte {off} of a {len(data)}-byte frame flipped",
+                bytes=len(data), offset=off)
+        return [data], False, delay
+
+    if _due("net_dup"):
+        _record("net_dup", f"frame of {len(data)} bytes duplicated",
+                bytes=len(data))
+        return [data, data], False, delay
+
+    return chunks, close_after, delay
+
+
+def rx_hold():
+    """True when the receiving connection asking should go half-open:
+    stay connected, read everything, answer nothing."""
+    if _due("net_half_open"):
+        _record("net_half_open",
+                "receiving connection going silent (half-open link)")
+        return True
+    return False

@@ -530,6 +530,126 @@ print("FLEET-DROP-OK")
                        "unchanged")
 
 
+@scenario("fleet-corrupt-frame", "corrupt KV frame on the disagg "
+                                  "handoff wire: CRC catches it, retry "
+                                  "delivers, tokens bitwise, 0 failed")
+def _fleet_corrupt_frame(timeout):
+    code = _FLEET_PRELUDE + r"""
+from paddle_tpu.profiler.tracing import load_flight_dump
+
+want = reference_tokens()
+# pod 0 = prefill (the frame SENDER): its first data-plane send gets a
+# payload byte flipped in flight. The decode listener must NACK on CRC,
+# never decode the garbage KV, and the retried bundle must land bitwise.
+fleet = ServingFleet(MODEL_SPEC, roles=("prefill", "decode"),
+                     engine=ENGINE_KW,
+                     pod_faults={0: "net_corrupt:nth=1"}).start()
+reqs = [fleet.submit(p, **OPTS) for p in PROMPTS]
+got = [list(r.result(180).tokens) for r in reqs]
+assert [r.status for r in reqs] == ["done"] * 3, [r.status for r in reqs]
+assert got == want, "tokens after corrupt-frame retry not bitwise"
+st = fleet.stats()
+assert st["router"]["requests_failed"] == 0
+assert st["router"]["handoffs_binary"] >= 3, st["router"]
+assert st["router"]["handoffs_fallback"] == 0, st["router"]
+assert st["data_plane"]["crc_errors"] >= 1, st["data_plane"]
+assert st["data_plane"]["nacks_sent"] >= 1, st["data_plane"]
+assert st["data_plane"]["tx_retries"] >= 1, st["data_plane"]
+# every pod dumps a parseable flight recorder ON DEMAND (nothing died)
+paths = fleet.flight_snapshot(reason="chaos-drill")
+assert all(paths.values()), paths
+for pth in paths.values():
+    doc = load_flight_dump(pth)
+    assert doc["reason"] == "chaos-drill" and doc["events"]
+fleet.shutdown()
+print("FLEET-CORRUPT-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "FLEET-CORRUPT-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or ("corrupt frame NACKed + retried, never decoded; "
+                       "tokens bitwise, 0 failed, flight dumps parsed")
+
+
+@scenario("fleet-slow-link", "lossy-slow prefill->decode link: delayed "
+                             "frames ride the deadline budget, handoffs "
+                             "stay binary, 0 failed")
+def _fleet_slow_link(timeout):
+    code = _FLEET_PRELUDE + r"""
+from paddle_tpu.profiler.tracing import load_flight_dump
+
+want = reference_tokens()
+# every data-plane send from the prefill pod sleeps 50 ms: well inside
+# the per-request deadline, so nothing should retry OR fall back to the
+# JSON control channel -- slow is not broken
+fleet = ServingFleet(MODEL_SPEC, roles=("prefill", "decode"),
+                     engine=ENGINE_KW,
+                     pod_faults={0: "net_delay:delay=0.05"}).start()
+reqs = [fleet.submit(p, **OPTS) for p in PROMPTS]
+got = [list(r.result(180).tokens) for r in reqs]
+assert [r.status for r in reqs] == ["done"] * 3, [r.status for r in reqs]
+assert got == want, "tokens over the slow link not bitwise"
+st = fleet.stats()
+assert st["router"]["requests_failed"] == 0
+assert st["router"]["handoffs_binary"] >= 3, st["router"]
+assert st["router"]["handoffs_fallback"] == 0, st["router"]
+assert st["data_plane"]["tx_bytes"] > 0 and st["links"], st["data_plane"]
+paths = fleet.flight_snapshot(reason="chaos-drill")
+assert all(paths.values()), paths
+for pth in paths.values():
+    assert load_flight_dump(pth)["events"]
+fleet.shutdown()
+print("FLEET-SLOWLINK-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "FLEET-SLOWLINK-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or ("50 ms-per-frame link absorbed inside the "
+                       "deadline budget; all handoffs binary, 0 failed")
+
+
+@scenario("fleet-store-partition", "TCPStore partitioned while a killed "
+                                   "pod respawns: rediscovery rides the "
+                                   "retry, orphans replay, 0 failed")
+def _fleet_store_partition(timeout):
+    code = _FLEET_PRELUDE + r"""
+from paddle_tpu.profiler.tracing import load_flight_dump
+
+want = reference_tokens()
+fleet = ServingFleet(MODEL_SPEC, pods=1, engine=ENGINE_KW,
+                     restart_backoff=0.05,
+                     pod_faults={0: "pod_kill:at_request=2"}).start()
+reqs = [fleet.submit(p, **OPTS) for p in PROMPTS]
+# the pod is now dying at its 2nd request; partition the STORE in the
+# router's process so the respawned pod's endpoint (fresh port, bumped
+# generation) cannot be resolved for a while -- the reconnect loop must
+# ride it out and rediscover WITHOUT a router restart
+faults.configure("store_partition:secs=1.0")
+got = [list(r.result(180).tokens) for r in reqs]
+faults.reset()
+assert [r.status for r in reqs] == ["done"] * 3, [r.status for r in reqs]
+assert got == want, "replayed tokens not bitwise after partition"
+st = fleet.stats()
+assert st["router"]["requests_failed"] == 0
+assert st["pods"][0]["restarts"] >= 1
+assert st["pods"][0]["generation"] >= 1, st["pods"][0]
+assert registry.counters("fault").get("injected.store_partition", 0) >= 1
+assert registry.counters("fleet")["orphans_replayed"] >= 1
+# the killed incarnation left its post-mortem on the way out
+dumps = fleet.flight_dumps()
+assert dumps, "pod_kill left no flight-recorder dump"
+assert load_flight_dump(dumps[0])["events"]
+fleet.shutdown()
+print("FLEET-PARTITION-OK")
+"""
+    ok, why, out = _run_child(code, timeout)
+    if ok and "FLEET-PARTITION-OK" not in out:
+        return False, "scenario exited 0 without completing"
+    return ok, why or ("store partition during respawn healed by the "
+                       "resolver's retry; generation bumped, replays "
+                       "bitwise, 0 failed")
+
+
 @scenario("spec-pod-kill", "speculative-decode pod SIGKILLed mid-flight: "
                            "respawn + bitwise orphan replay vs plain "
                            "decode, zero failed")

@@ -419,8 +419,24 @@ def _dump_waterfall(doc):
             bar = " " * lead + "#" * min(fill, bar_w - lead)
             label = (f"{procs.get(e.get('pid'), e.get('pid'))}:"
                      f"{e.get('name', '?')}")
+            args = e.get("args") or {}
+            extra = ""
+            if args.get("bytes") is not None:
+                extra = f"  {_fmt_bytes(int(args['bytes']))}"
+                if args.get("attempt", 1) not in (1, None):
+                    extra += f" (attempt {args['attempt']})"
             print(f"  {label:<{w}}  [{bar:<{bar_w}}] "
-                  f"+{(ts - t0) / 1e3:>9.3f}ms {dur / 1e3:>9.3f}ms")
+                  f"+{(ts - t0) / 1e3:>9.3f}ms {dur / 1e3:>9.3f}ms"
+                  f"{extra}")
+        # the data-plane cost of this request: time + bytes its KV
+        # handoff spent on the wire (frame_tx spans, ISSUE 19)
+        tx = [e for e in evs if e.get("name") == "frame_tx"]
+        if tx:
+            nbytes = sum(int((e.get("args") or {}).get("bytes", 0))
+                         for e in tx)
+            wire_ms = sum(float(e.get("dur", 0.0)) for e in tx) / 1e3
+            print(f"  handoff wire: {len(tx)} bundle(s), "
+                  f"{_fmt_bytes(nbytes)}, {wire_ms:.3f}ms on the wire")
 
 
 def _dump_trace(doc):
