@@ -492,7 +492,8 @@ def _run_spmd_child():
     print(json.dumps(rec), flush=True)
     pp_ok = _run_spmd_pp_leg(slint)
     ppz_ok = _run_spmd_pp_zero_leg(slint)
-    return 0 if (steady_ok and pp_ok and ppz_ok) else 1
+    moe_ok = _run_moe_ep_leg(slint)
+    return 0 if (steady_ok and pp_ok and ppz_ok and moe_ok) else 1
 
 
 def _run_spmd_pp_leg(slint):
@@ -708,6 +709,130 @@ def _run_spmd_pp_zero_leg(slint):
     }
     print(json.dumps(rec), flush=True)
     return ppz_ok
+
+
+def _run_moe_ep_leg(slint):
+    """dp=2 x ep=2 gate (ISSUE 20): a gpt2-tiny-moe model (fixed-shape
+    top-k routing, expert banks sharded over 'ep') trains through the
+    one-compilation path with VARYING batches — routing changes every
+    step, the executable must not. The steady window must show zero new
+    compiles, zero Python collectives and full capture/donation, with
+    loss parity vs the identical model at ep=1 (the all-to-all moves
+    experts, not math) and a throughput line vs ep=1 and vs the dense
+    (moe_num_experts=0) model of the same dims. Emits the
+    {"metric": "moe-ep"} line; False fails the --spmd child."""
+    import time as _time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.core import lazy
+    from paddle_tpu.distributed import fleet, spmd
+    from paddle_tpu.models import (GPTConfig, GPTForPretraining, GPTModel,
+                                   GPTPretrainingCriterion)
+    from paddle_tpu.profiler import registry as _reg
+
+    V, T, B = 64, 16, 8
+    WARM, WINDOW = 8, 6
+
+    def make_model(moe):
+        preset = "gpt2-tiny-moe" if moe else "gpt2-tiny"
+        cfg = GPTConfig.preset(preset, vocab_size=V, n_layer=2,
+                               seq_len=T, dropout=0.0, n_head=2,
+                               d_model=32)
+        paddle.seed(123)
+        model = GPTForPretraining(GPTModel(cfg))
+        opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=model.parameters())
+        return model, opt, GPTPretrainingCriterion()
+
+    def init_fleet(ep):
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {
+            "dp_degree": 2, "mp_degree": 1, "pp_degree": 1,
+            "sharding_degree": 1, "ep_degree": ep, "use_spmd": True}
+        fleet.init(is_collective=True, strategy=strategy)
+
+    def run_leg(ep, moe=True):
+        init_fleet(ep)
+        model, opt, crit = make_model(moe)
+        model = fleet.distributed_model(model)
+        rng = np.random.default_rng(0)
+
+        def step():
+            toks = rng.integers(0, V, (B, T)).astype(np.int64)
+            tt = spmd.shard_batch(paddle.to_tensor(toks))
+            lt = spmd.shard_batch(paddle.to_tensor(
+                np.roll(toks, -1, 1)))
+            with lazy.capture_guard(True), paddle.incubate.lazy_eval():
+                loss = crit(model(tt), lt)
+                aux = model.moe_aux_loss()
+                if aux is not None:
+                    loss = loss + aux
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return float(loss)
+
+        warm = [step() for _ in range(WARM)]
+        c0, s0 = dict(_reg.counters("spmd")), lazy.stats()
+        t0 = _time.perf_counter()
+        steady = [step() for _ in range(WINDOW)]
+        step_s = (_time.perf_counter() - t0) / WINDOW
+        c1, s1 = dict(_reg.counters("spmd")), lazy.stats()
+        return {
+            "losses": warm + steady,
+            "step_ms": step_s * 1e3,
+            "tokens_per_s": B * T / step_s,
+            "new_compiles": c1["step_compiles"] - c0["step_compiles"],
+            "captured": s1["captured_steps"] - s0["captured_steps"],
+            "donated": s1["donated_steps"] - s0["donated_steps"],
+            "nodes_built": s1["nodes_built"] - s0["nodes_built"],
+            "py_collectives": c1["python_collectives"]
+            - c0["python_collectives"],
+            "desc": spmd.describe_plans(),
+        }
+
+    ep2 = run_leg(2)
+    problems = slint.lint(ep2["desc"])
+    ep_leaves = sum(
+        1 for p in ep2["desc"]["plans"] if p.get("spmd")
+        for lf in p["leaves"]
+        if lf.get("expert_membership") == "sharded")
+    # ep=1 and dense legs re-init the mesh (dropping ep2's plans — its
+    # description is already banked above)
+    ep1 = run_leg(1)
+    dense = run_leg(1, moe=False)
+    parity = max(abs(a - b)
+                 for a, b in zip(ep2["losses"], ep1["losses"]))
+    moe_ok = (
+        ep2["new_compiles"] == 0
+        and ep2["captured"] == WINDOW
+        and ep2["donated"] == WINDOW
+        and ep2["nodes_built"] == 0
+        and ep2["py_collectives"] == 0
+        and ep_leaves > 0
+        and parity < 5e-2
+        and not problems)
+    rec = {
+        "metric": "moe-ep",
+        "value": round(ep2["tokens_per_s"], 1),
+        "unit": "tokens/sec (ep=2)",
+        "vs_baseline": 1.0 if moe_ok else 0.0,
+        "mesh": "dp2xep2",
+        "step_ms_ep2": round(ep2["step_ms"], 3),
+        "step_ms_ep1": round(ep1["step_ms"], 3),
+        "step_ms_dense": round(dense["step_ms"], 3),
+        "tokens_per_s_ep1": round(ep1["tokens_per_s"], 1),
+        "tokens_per_s_dense": round(dense["tokens_per_s"], 1),
+        "steady_new_compiles": ep2["new_compiles"],
+        "captured_steps": ep2["captured"],
+        "donated_steps": ep2["donated"],
+        "ep_sharded_leaves": ep_leaves,
+        "parity_max_abs_ep2_vs_ep1": round(parity, 8),
+        "lint_warnings": problems,
+        "platform": "cpu",
+    }
+    print(json.dumps(rec), flush=True)
+    return moe_ok
 
 
 def _spmd_line():

@@ -280,6 +280,13 @@ int64_t tcpstore_add(void* handle, const char* key, int64_t delta) {
   return v;
 }
 
+// Returns 0 when the key existed and was erased, 1 when it was missing,
+// -1 on transport error (server op 5 reports erase-vs-missing in status).
+int tcpstore_delete(void* handle, const char* key) {
+  std::vector<uint8_t> out;
+  return request(static_cast<Client*>(handle), 5, key, nullptr, 0, &out);
+}
+
 int tcpstore_check(void* handle, const char* key) {
   std::vector<uint8_t> out;
   int st = request(static_cast<Client*>(handle), 4, key, nullptr, 0, &out);

@@ -40,9 +40,10 @@ def init(role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
     strategy = strategy or DistributedStrategy()
     hc = strategy.hybrid_configs
     topo = CommunicateTopology(
-        ["data", "pipe", "sharding", "model"],
+        ["data", "pipe", "sharding", "expert", "model"],
         [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
-         hc.get("sharding_degree", 1), hc.get("mp_degree", 1)])
+         hc.get("sharding_degree", 1), hc.get("ep_degree", 1),
+         hc.get("mp_degree", 1)])
     hcg = HybridCommunicateGroup(topo)
     _fleet_state.update(initialized=True, hcg=hcg, strategy=strategy)
     # One-compilation SPMD path (ISSUE 6): hybrid_configs['use_spmd']

@@ -30,6 +30,7 @@ class DistributedStrategy:
             "mp_degree": 1,
             "pp_degree": 1,
             "sharding_degree": 1,
+            "ep_degree": 1,
         }
         self.amp = False
         self.amp_configs = {"init_loss_scaling": 32768.0,

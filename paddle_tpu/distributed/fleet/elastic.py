@@ -69,7 +69,15 @@ def publish_generation(store, world, log=None, scope="elastic"):
     the gen pointer. Membership is the full 0..world-1 range — an
     in-place restart replaces a member, it does not shrink the job.
     Best-effort: store errors are logged and swallowed (the restart
-    itself must proceed). Returns True when this call owned the bump."""
+    itself must proceed). Returns True when this call owned the bump.
+
+    Superseded generations are garbage-collected at publish time
+    (ISSUE 20 satellite): once gen N+1 is live no watcher may consume
+    a ``members/claim`` record older than N — watchers poll the gen
+    pointer and read only the CURRENT generation's members — so a
+    long-running elastic job no longer accretes one key pair per
+    restart. Generation N itself is kept (a watcher mid-read of the
+    previous generation must not lose it)."""
     if store is None:
         return False
     try:
@@ -81,6 +89,14 @@ def publish_generation(store, world, log=None, scope="elastic"):
         if int(store.add(f"{scope}/gen", 0)) == gen:
             store.add(f"{scope}/gen", 1)
         _counters["elastic.generation_bumps"] += 1
+        # expire everything older than the PREVIOUS generation; the
+        # backward walk stops at the first missing record, so steady
+        # state deletes exactly one superseded pair per bump
+        if hasattr(store, "delete_key"):
+            g = gen - 1
+            while g > 0 and (store.delete_key(f"{scope}/members/{g}")
+                             | store.delete_key(f"{scope}/claim/{g}")):
+                g -= 1
         return True
     except Exception as e:  # rendezvous best-effort: restart anyway
         if log is not None:
@@ -145,6 +161,31 @@ def publish_endpoint(store, pod, host, port, generation, role="serve",
     except Exception as e:
         if log is not None:
             log(f"endpoint publish failed for pod {pod}: {e}")
+        return False
+
+
+def unpublish_endpoint(store, pod, scope="serving", log=None):
+    """Garbage-collect a pod's endpoint record on CLEAN teardown
+    (ISSUE 20 satellite): a drained fleet must not leave `endpoint/*`
+    keys behind for the next job sharing the rendezvous store to trip
+    over (resolve_endpoint would happily return the dead incarnation's
+    address — same-generation records pass the staleness check).
+    Deletes the JSON doc and its poll counter; best-effort like every
+    rendezvous op (a crashed pod leaves its record, and the next
+    incarnation's higher generation supersedes it). Returns True when
+    the record existed and is now gone."""
+    if store is None:
+        return False
+    key = endpoint_key(pod, scope)
+    try:
+        if not hasattr(store, "delete_key"):
+            return False
+        existed = store.delete_key(key)
+        store.delete_key(f"{key}/gen")
+        return existed
+    except Exception as e:
+        if log is not None:
+            log(f"endpoint unpublish failed for pod {pod}: {e}")
         return False
 
 
@@ -965,11 +1006,14 @@ class GenerationFence:
 def request_resize(store, world, scope="elastic"):
     """Ask the supervising Pod to resize the job to ``world`` ranks at
     its next supervision tick (operator shrink ahead of a maintenance
-    event, or grow when capacity returns). Append-only protocol over
-    the store (it has no delete): bump ``<scope>/resize_seq``, write the
-    target world under the new sequence number; the Pod consumes
-    requests by tracking the last sequence it acted on. Returns the
-    sequence number."""
+    event, or grow when capacity returns). Append-only protocol: bump
+    ``<scope>/resize_seq``, write the target world under the new
+    sequence number; the Pod consumes requests by tracking the last
+    sequence it acted on (the store does support delete_key now, but
+    consume-by-sequence needs no GC — a request key is one small write
+    per OPERATOR action, unlike the per-restart generation/endpoint
+    records that publish_generation/unpublish_endpoint collect).
+    Returns the sequence number."""
     seq = int(store.add(f"{scope}/resize_seq", 1))
     store.set(f"{scope}/resize/{seq}", str(int(world)))
     _explain.record("elastic_resize_request", op="request_resize",

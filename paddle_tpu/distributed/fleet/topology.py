@@ -86,10 +86,17 @@ class CommunicateTopology:
 
 class HybridCommunicateGroup:
     """Reference topology.py:140. Axis name mapping to mesh axes:
-    data→'dp', pipe→'pp', sharding→'sharding', model→'mp'."""
+    data→'dp', pipe→'pp', sharding→'sharding', expert→'ep', model→'mp'.
+
+    The 'expert' axis (ISSUE 20, MoE expert parallelism) is OPTIONAL in
+    the topology — a 4-axis CommunicateTopology (every pre-MoE caller)
+    reads as expert degree 1, and the hybrid mesh keeps its historical
+    4-axis shape in that case so existing shardings stay valid. With
+    ep>1 the mesh grows a fifth axis between 'sharding' and 'mp':
+    hcg linear index ((((d*pp + p)*sh + s)*ep + e)*mp + m."""
 
     AXIS_MAP = {"data": "dp", "pipe": "pp", "sharding": "sharding",
-                "model": "mp"}
+                "expert": "ep", "model": "mp"}
 
     def __init__(self, topology: CommunicateTopology):
         self._topo = topology
@@ -99,6 +106,9 @@ class HybridCommunicateGroup:
         self._pp_degree = topology.get_dim("pipe")
         self._sharding_degree = topology.get_dim("sharding")
         self._mp_degree = topology.get_dim("model")
+        names = topology.get_hybrid_group_names()
+        self._ep_degree = (topology.get_dim("expert")
+                           if "expert" in names else 1)
 
         devs = jax.devices()
         if len(devs) < self.nranks:
@@ -106,10 +116,17 @@ class HybridCommunicateGroup:
                 f"hybrid topology needs {self.nranks} devices, have "
                 f"{len(devs)} (set --xla_force_host_platform_device_count "
                 "for CPU testing)")
-        dev_array = np.array(devs[: self.nranks]).reshape(
-            self._dp_degree, self._pp_degree, self._sharding_degree,
-            self._mp_degree)
-        self.mesh = Mesh(dev_array, ("dp", "pp", "sharding", "mp"))
+        if self._ep_degree > 1:
+            dev_array = np.array(devs[: self.nranks]).reshape(
+                self._dp_degree, self._pp_degree, self._sharding_degree,
+                self._ep_degree, self._mp_degree)
+            self.mesh = Mesh(dev_array, ("dp", "pp", "sharding", "ep",
+                                         "mp"))
+        else:
+            dev_array = np.array(devs[: self.nranks]).reshape(
+                self._dp_degree, self._pp_degree, self._sharding_degree,
+                self._mp_degree)
+            self.mesh = Mesh(dev_array, ("dp", "pp", "sharding", "mp"))
         self._spmd_mesh = _MESH_UNSET
         collective.set_global_mesh(self.mesh)
 
@@ -118,6 +135,8 @@ class HybridCommunicateGroup:
         self._sharding_group = collective.split_group_mesh(self.mesh,
                                                            "sharding")
         self._mp_group = collective.split_group_mesh(self.mesh, "mp")
+        self._ep_group = (collective.split_group_mesh(self.mesh, "ep")
+                          if self._ep_degree > 1 else None)
 
     # -- degrees --------------------------------------------------------------
     def get_data_parallel_world_size(self):
@@ -131,6 +150,9 @@ class HybridCommunicateGroup:
 
     def get_sharding_parallel_world_size(self):
         return self._sharding_degree
+
+    def get_expert_parallel_world_size(self):
+        return self._ep_degree
 
     # -- ranks (single-controller: coordinate of logical rank 0 is 0s; kept
     # for API parity — per-device values exist only inside compiled code) ----
@@ -146,6 +168,9 @@ class HybridCommunicateGroup:
     def get_sharding_parallel_rank(self):
         return 0
 
+    def get_expert_parallel_rank(self):
+        return 0
+
     # -- groups (topology.py:348,364,380,401) --------------------------------
     def get_data_parallel_group(self):
         return self._dp_group
@@ -158,6 +183,9 @@ class HybridCommunicateGroup:
 
     def get_sharding_parallel_group(self):
         return self._sharding_group
+
+    def get_expert_parallel_group(self):
+        return self._ep_group
 
     def spmd_mesh(self):
         """Folded mesh for the one-compilation SPMD path: 2-axis
@@ -187,9 +215,10 @@ class HybridCommunicateGroup:
     def get_parallel_mode(self):
         # reference returns enum; string keeps it simple
         if self._mp_degree == 1 and self._pp_degree == 1 and \
-                self._sharding_degree == 1 and self._dp_degree > 1:
+                self._sharding_degree == 1 and self._ep_degree == 1 and \
+                self._dp_degree > 1:
             return "data_parallel"
         if self._mp_degree > 1 or self._pp_degree > 1 or \
-                self._sharding_degree > 1:
+                self._sharding_degree > 1 or self._ep_degree > 1:
             return "hybrid_parallel"
         return "single"

@@ -26,6 +26,12 @@ Mesh mapping (Fleet `HybridCommunicateGroup` topology → named mesh):
                                          its 4-axis hcg coordinate —
                                          see mesh_from_hcg)
     model        mp_degree       'mp'
+    expert       ep_degree       'ep'   (ISSUE 20: MoE expert
+                                         parallelism — expert banks
+                                         shard over 'ep', the batch
+                                         splits over ('dp','ep'), and
+                                         the dispatch/combine einsums
+                                         become the expert all-to-all)
     pipe         pp_degree       'pp'   (ISSUE 15: pp>1 folds to a
                                          3-axis ('dp','pp','mp') mesh;
                                          distributed/pp_spmd.py stacks
@@ -136,19 +142,40 @@ def mesh_from_hcg(hcg):
     (data, pipe, sharding, model) coordinate and collectives over the
     folded 'dp' axis span exactly the union of the hcg data and
     sharding groups. At sh=1 the transpose is the identity, so the
-    pre-ISSUE-16 3-axis mesh is unchanged."""
+    pre-ISSUE-16 3-axis mesh is unchanged.
+
+    Expert parallelism (ISSUE 20): an hcg with expert degree > 1 keeps
+    its own 'ep' axis in the folded mesh — ('dp', 'ep', 'mp') at pp=1,
+    ('dp', 'pp', 'ep', 'mp') at pp>1. The hcg device order is
+    (data, pipe, sharding, expert, model) with 'expert' adjacent to
+    'model', so at pp=1 the fold is a plain reshape and at pp>1 the
+    same (data, sharding) ↔ pipe transpose as above applies with
+    'ep' riding along untouched — every device keeps its 5-axis hcg
+    coordinate. The batch splits over BOTH 'dp' and 'ep'
+    (shard_batch): ep ranks are data-parallel for the dense trunk, and
+    only the expert banks (sharding_spec ('ep', ...)) shard over 'ep',
+    which is what turns the MoE dispatch/combine einsums into the
+    expert all-to-all under GSPMD. ep=1 leaves every fold unchanged."""
     pp = hcg.get_pipe_parallel_world_size()
     sh = hcg.get_sharding_parallel_world_size()
     dp = hcg.get_data_parallel_world_size()
     mp = hcg.get_model_parallel_world_size()
+    ep = getattr(hcg, "get_expert_parallel_world_size", lambda: 1)()
     if pp > 1:
-        devs = np.array(jax.devices()[: dp * pp * sh * mp]).reshape(
-            dp, pp, sh, mp)
-        devs = devs.transpose(0, 2, 1, 3).reshape(dp * sh, pp, mp)
-        return Mesh(devs, ("dp", "pp", "mp"))
+        devs = np.array(jax.devices()[: dp * pp * sh * ep * mp]).reshape(
+            dp, pp, sh, ep, mp)
+        devs = devs.transpose(0, 2, 1, 3, 4)
+        if ep > 1:
+            return Mesh(devs.reshape(dp * sh, pp, ep, mp),
+                        ("dp", "pp", "ep", "mp"))
+        return Mesh(devs.reshape(dp * sh, pp, mp), ("dp", "pp", "mp"))
     dp *= sh
-    # same flat device order as hcg.mesh at pp=1: (d, s, m) flattens to
-    # (d*sh + s)*mp + m either way, so the two meshes may coexist
+    # same flat device order as hcg.mesh at pp=1: (d, s, e, m) flattens
+    # to ((d*sh + s)*ep + e)*mp + m either way, so the two meshes may
+    # coexist
+    if ep > 1:
+        devs = np.array(jax.devices()[: dp * ep * mp]).reshape(dp, ep, mp)
+        return Mesh(devs, ("dp", "ep", "mp"))
     devs = np.array(jax.devices()[: dp * mp]).reshape(dp, mp)
     return Mesh(devs, ("dp", "mp"))
 
@@ -294,11 +321,16 @@ def shard_model(model, mesh=None):
 
 def shard_batch(data, mesh=None, batch_axis=0):
     """Place one batch tensor/array onto the mesh, split over 'dp' on
-    `batch_axis` (replicated when the dim does not divide). Returns a
-    Tensor. The explicit put matters twice over: to_tensor commits to a
-    single device (incompatible with mesh-committed params inside one
-    jit), and the captured executable pins its in_shardings — a batch
-    arriving with a different layout forces a per-step reshard."""
+    `batch_axis` (replicated when the dim does not divide). On an
+    expert-parallel mesh (an 'ep' axis with >1 devices) the batch
+    splits over ('dp', 'ep') JOINTLY — ep ranks are data-parallel for
+    the dense trunk, so MoE training wastes no devices on replicated
+    batches (falls back to 'dp' alone, then replicated, as
+    divisibility allows). Returns a Tensor. The explicit put matters
+    twice over: to_tensor commits to a single device (incompatible
+    with mesh-committed params inside one jit), and the captured
+    executable pins its in_shardings — a batch arriving with a
+    different layout forces a per-step reshard."""
     from ..core.tensor import Tensor
 
     mesh = mesh or current_mesh()
@@ -307,10 +339,18 @@ def shard_batch(data, mesh=None, batch_axis=0):
     t = data if isinstance(data, Tensor) else Tensor(jax.numpy.asarray(
         np.asarray(data)))
     arr = _lazy.force(t._data)
-    dp = dict(zip(mesh.axis_names, mesh.devices.shape)).get("dp", 1)
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    dp = axes.get("dp", 1)
+    ep = axes.get("ep", 1)
     parts = [None] * arr.ndim
-    if dp > 1 and arr.ndim > batch_axis and arr.shape[batch_axis] % dp == 0:
-        parts[batch_axis] = "dp"
+    if arr.ndim > batch_axis:
+        n = arr.shape[batch_axis]
+        if ep > 1 and dp > 1 and n % (dp * ep) == 0:
+            parts[batch_axis] = ("dp", "ep")
+        elif ep > 1 and dp <= 1 and n % ep == 0:
+            parts[batch_axis] = "ep"
+        elif dp > 1 and n % dp == 0:
+            parts[batch_axis] = "dp"
     t._data = jax.device_put(arr, NamedSharding(mesh,
                                                 PartitionSpec(*parts)))
     return t
@@ -348,5 +388,14 @@ def describe_plans():
                 for lf in plan.get("leaves", ()):
                     lf["stage_membership"] = (
                         "sharded" if _spec_has_axis(lf.get("spec"), "pp")
+                        else "all")
+        if axes.get("ep", 1) > 1:
+            # mirror of stage_membership for expert parallelism: an
+            # 'ep'-sharded leaf is an expert bank each ep rank holds
+            # E/ep slices of; 'all' leaves replicate across ep ranks
+            for plan in desc["plans"]:
+                for lf in plan.get("leaves", ()):
+                    lf["expert_membership"] = (
+                        "sharded" if _spec_has_axis(lf.get("spec"), "ep")
                         else "all")
     return desc

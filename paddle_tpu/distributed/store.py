@@ -56,6 +56,13 @@ def _load():
                                  ctypes.c_int64]
     lib.tcpstore_check.restype = ctypes.c_int
     lib.tcpstore_check.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    # delete (ISSUE 20 satellite: endpoint-record GC) — guard the symbol
+    # lookup so a stale prebuilt .so (built before the op existed) still
+    # loads; delete_key then degrades to a no-op instead of breaking
+    # every store user at import
+    if hasattr(lib, "tcpstore_delete"):
+        lib.tcpstore_delete.restype = ctypes.c_int
+        lib.tcpstore_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
     lib.tcpstore_num_keys.restype = ctypes.c_int64
     lib.tcpstore_num_keys.argtypes = [ctypes.c_void_p]
     _LIB = lib
@@ -207,6 +214,26 @@ class TCPStore:
             return rc == 1
 
         return self._retry("check", attempt)
+
+    def delete_key(self, key):
+        """Delete one key (reference TCPStore::deleteKey). Returns True
+        when the key existed and was erased, False when it was already
+        missing. Rendezvous GC (endpoint records, superseded
+        generations) is the intended caller — a store whose native lib
+        predates the op reports False rather than failing teardown."""
+        if not hasattr(self._lib, "tcpstore_delete"):
+            return False
+
+        def attempt():
+            if _faults.ACTIVE:
+                _faults.store_op("delete")
+            with self._lock:
+                rc = self._lib.tcpstore_delete(self._client, key.encode())
+            if rc < 0:
+                raise RuntimeError("TCPStore.delete transport failure")
+            return rc == 0
+
+        return self._retry("delete", attempt)
 
     def num_keys(self):
         with self._lock:

@@ -8,4 +8,4 @@ from .ernie import (ErnieConfig, ErnieForSequenceClassification,  # noqa: F401
                     ernie_3p0_medium, ernie_tiny)
 from .gpt import (GPTConfig, GPTForPretraining, GPTModel,  # noqa: F401
                   GPTPretrainingCriterion, gpt2_small, gpt3_1p3b, gpt3_6p7b,
-                  gpt_tiny)
+                  gpt_tiny, gpt_tiny_moe)

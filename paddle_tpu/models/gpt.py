@@ -52,6 +52,8 @@ def _warn_legacy_cache():
 class GPTConfig:
     PRESETS = {
         "gpt2-tiny": dict(n_layer=2, n_head=4, d_model=128, seq_len=128),
+        "gpt2-tiny-moe": dict(n_layer=2, n_head=4, d_model=128,
+                              seq_len=128, moe_num_experts=4),
         "gpt2-small": dict(n_layer=12, n_head=12, d_model=768, seq_len=1024),
         "gpt2-medium": dict(n_layer=24, n_head=16, d_model=1024, seq_len=1024),
         "gpt2-large": dict(n_layer=36, n_head=20, d_model=1280, seq_len=1024),
@@ -63,7 +65,9 @@ class GPTConfig:
     def __init__(self, vocab_size=50304, n_layer=12, n_head=12, d_model=768,
                  seq_len=1024, d_ff=None, dropout=0.0, attn_dropout=0.0,
                  dtype="float32", use_recompute=False, recompute_policy=None,
-                 initializer_range=0.02):
+                 initializer_range=0.02, moe_num_experts=0, moe_top_k=2,
+                 moe_capacity_factor=1.25, moe_every=1,
+                 moe_aux_weight=0.01):
         self.vocab_size = vocab_size
         self.n_layer = n_layer
         self.n_head = n_head
@@ -79,6 +83,22 @@ class GPTConfig:
         # ~25-30% less recompute FLOPs for a modest activation-memory cost
         self.recompute_policy = recompute_policy
         self.initializer_range = initializer_range
+        # MoE trunk (ISSUE 20): moe_num_experts=0 keeps the dense MLP;
+        # >0 swaps every `moe_every`-th block's MLP for nn.moe.MoEMLP.
+        # Hyperparameters are validated HERE (structured
+        # moe_config_refused + MoEConfigError), not inside a trace —
+        # the ep-divisibility half re-checks at layer construction when
+        # the mesh is known.
+        self.moe_num_experts = int(moe_num_experts)
+        self.moe_top_k = int(moe_top_k)
+        self.moe_capacity_factor = float(moe_capacity_factor)
+        self.moe_every = int(moe_every)
+        self.moe_aux_weight = float(moe_aux_weight)
+        if self.moe_num_experts > 0:
+            from ..nn.moe import validate_moe_config
+
+            validate_moe_config(self.moe_num_experts, self.moe_top_k,
+                                self.moe_capacity_factor, op="GPTConfig")
 
     @classmethod
     def preset(cls, name, **overrides):
@@ -268,12 +288,23 @@ class GPTMLP(nn.Layer):
 
 
 class GPTBlock(nn.Layer):
-    def __init__(self, cfg: GPTConfig):
+    def __init__(self, cfg: GPTConfig, layer_idx=0):
         super().__init__()
         self.ln1 = nn.LayerNorm(cfg.d_model)
         self.attn = GPTAttention(cfg)
         self.ln2 = nn.LayerNorm(cfg.d_model)
-        self.mlp = GPTMLP(cfg)
+        if cfg.moe_num_experts > 0 and layer_idx % cfg.moe_every == 0:
+            from ..nn.moe import MoEMLP
+
+            self.mlp = MoEMLP(
+                cfg.d_model, cfg.d_ff, cfg.moe_num_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dropout=cfg.dropout, init_std=cfg.initializer_range,
+                out_init_std=cfg.initializer_range
+                / math.sqrt(2 * cfg.n_layer))
+        else:
+            self.mlp = GPTMLP(cfg)
         self.dropout = nn.Dropout(cfg.dropout)
         self._recompute = cfg.use_recompute
         self._recompute_policy = getattr(cfg, "recompute_policy", None)
@@ -329,7 +360,8 @@ class GPTModel(nn.Layer):
         super().__init__()
         self.cfg = cfg
         self.embeddings = GPTEmbeddings(cfg)
-        self.blocks = nn.LayerList([GPTBlock(cfg) for _ in range(cfg.n_layer)])
+        self.blocks = nn.LayerList([GPTBlock(cfg, layer_idx=i)
+                                    for i in range(cfg.n_layer)])
         self.ln_f = nn.LayerNorm(cfg.d_model)
         if cfg.dtype != "float32":
             self.to(dtype=cfg.dtype)
@@ -353,6 +385,21 @@ class GPTModel(nn.Layer):
             x = blk(x)
         return self.ln_f(x)
 
+    def moe_aux_loss(self):
+        """Weighted sum of every MoE block's load-balancing loss from
+        the MOST RECENT forward (each MoEMLP re-assigns its aux_loss per
+        step, so this must be read inside the same train step). None
+        for a dense trunk — callers add it to the loss only when set."""
+        total = None
+        for blk in self.blocks:
+            aux = getattr(blk.mlp, "aux_loss", None)
+            if aux is None:
+                continue
+            total = aux if total is None else total + aux
+        if total is None:
+            return None
+        return total * self.cfg.moe_aux_weight
+
 
 class GPTForPretraining(nn.Layer):
     """LM head tied to word embeddings (reference GPTForPretraining)."""
@@ -371,6 +418,9 @@ class GPTForPretraining(nn.Layer):
     def forward(self, input_ids, position_ids=None):
         return self._lm_logits(self.gpt(input_ids, position_ids))
 
+    def moe_aux_loss(self):
+        return self.gpt.moe_aux_loss()
+
     def pipeline_parts(self, pp):
         """Stage slicing for the one-compilation SPMD pipeline
         (`distributed.pp_spmd.PipelineSpmdStep`): embeddings ride stage
@@ -382,6 +432,23 @@ class GPTForPretraining(nn.Layer):
         explainer event) when n_layer does not divide into pp equal
         stage slices."""
         L = len(self.gpt.blocks)
+        if self.gpt.cfg.moe_num_experts > 0:
+            from ..distributed.meta_parallel.pp_layers import \
+                PipelineStageError
+            from ..profiler import explainer as _explain
+
+            _explain.record(
+                "spmd_pp_refused", op="gpt.pipeline_parts",
+                reason="moe_trunk",
+                why=("MoE blocks cannot ride the pp trunk: the pipeline "
+                     "step stacks blocks into one scanned bank, but "
+                     "each MoE block carries its own routing state and "
+                     "aux loss — train MoE with dp/ep/mp instead"),
+                n_layers=L, pp=pp,
+                moe_num_experts=self.gpt.cfg.moe_num_experts)
+            raise PipelineStageError(
+                "MoE-bearing GPT configs do not support pipeline "
+                "parallelism (pp>1): use dp/ep/mp degrees instead")
         if pp < 1 or L % pp != 0:
             from ..distributed.meta_parallel.pp_layers import \
                 PipelineStageError
@@ -422,6 +489,11 @@ class GPTPretrainingCriterion(nn.Layer):
 
 def gpt_tiny(**kw):
     return GPTForPretraining(GPTModel(GPTConfig.preset("gpt2-tiny", **kw)))
+
+
+def gpt_tiny_moe(**kw):
+    return GPTForPretraining(
+        GPTModel(GPTConfig.preset("gpt2-tiny-moe", **kw)))
 
 
 def gpt2_small(**kw):

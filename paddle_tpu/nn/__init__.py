@@ -16,3 +16,4 @@ from . import layer  # noqa: F401
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
 from ..core.tensor import Parameter  # noqa: F401
 from .initializer import ParamAttr  # noqa: F401
+from . import moe  # noqa: F401,E402  (after layer exports: moe builds on Layer)

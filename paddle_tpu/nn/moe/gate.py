@@ -1,0 +1,202 @@
+"""Top-k gating with capacity-factor token dropping — FIXED shapes.
+
+Reference: the GShard / Switch-Transformer dispatch formulation (and the
+reference Paddle tree's `incubate/distributed/models/moe/gate/`), recast
+for the one-compilation capture engine: routing is data-DEPENDENT but the
+tensors it produces are shape-INVARIANT. The gate never builds ragged
+per-expert token lists; it builds dense one-hot dispatch/combine masks
+
+    dispatch [G, S, E, C]   0/1: token s of group g occupies slot c of
+                            expert e (zero when dropped)
+    combine  [G, S, E, C]   dispatch scaled by the normalized gate weight
+
+so every step of a training run — whatever the router decides — runs the
+exact same XLA executable. Tokens beyond an expert's capacity
+C = ceil(S * capacity_factor * top_k / E) are dropped deterministically
+(k-major, then sequence-position priority), which is the price of fixed
+shapes; see DESIGN_DECISIONS "MoE under fixed shapes".
+
+Capture-safety: the top-k selection runs through `argmax`/`one_hot`
+(nondiff ops whose outputs carry stop_gradient), so the integer-input
+grad-path bail in core/dispatch never triggers — gradients flow to the
+gate projection only through the softmax probabilities, and the whole
+gate records into the captured segment like any other op chain.
+"""
+from __future__ import annotations
+
+import math
+
+from ... import ops
+from ...profiler import explainer as _explain
+from ..initializer import Normal
+from ..layer.layers import Layer
+
+__all__ = ["MoEConfigError", "validate_moe_config", "TopKGate",
+           "moe_capacity"]
+
+
+class MoEConfigError(ValueError):
+    """A MoE hyperparameter combination that cannot route correctly.
+
+    Raised UP FRONT at construction (mirroring the kernel_fallback /
+    spmd_pp_refused pattern) so a bad config fails with a named reason
+    instead of an opaque shape error deep inside a trace."""
+
+
+def validate_moe_config(num_experts, top_k, capacity_factor, ep=1,
+                        op="moe"):
+    """Validate the (num_experts, top_k, capacity_factor, ep) tuple,
+    recording a structured `moe_config_refused` explainer event and
+    raising :class:`MoEConfigError` on the first violation."""
+
+    def refuse(reason, why):
+        _explain.record("moe_config_refused", op=op, reason=reason,
+                        why=why, num_experts=int(num_experts),
+                        top_k=int(top_k),
+                        capacity_factor=float(capacity_factor),
+                        ep=int(ep))
+        raise MoEConfigError(f"{why} (reason={reason})")
+
+    if int(num_experts) < 1:
+        refuse("no_experts",
+               f"num_experts={num_experts} must be >= 1")
+    if not (1 <= int(top_k) <= int(num_experts)):
+        refuse("top_k_exceeds_experts",
+               f"top_k={top_k} must satisfy 1 <= top_k <= "
+               f"num_experts={num_experts}: each token needs top_k "
+               f"DISTINCT experts to route to")
+    if float(capacity_factor) < 1.0:
+        refuse("capacity_factor_too_small",
+               f"capacity_factor={capacity_factor} must be >= 1.0: "
+               f"below 1.0 even a perfectly balanced router is forced "
+               f"to drop tokens")
+    if int(ep) < 1 or int(num_experts) % int(ep) != 0:
+        refuse("experts_indivisible_by_ep",
+               f"num_experts={num_experts} is not divisible by expert-"
+               f"parallel degree ep={ep}: each ep rank must own an "
+               f"equal [E/ep] slice of every expert bank")
+
+
+def moe_capacity(seq_len, num_experts, top_k, capacity_factor):
+    """Per-expert slot count C = ceil(S * cf * k / E), floored at 1."""
+    return max(1, int(math.ceil(
+        float(seq_len) * float(capacity_factor) * int(top_k)
+        / int(num_experts))))
+
+
+class TopKGate(Layer):
+    """Dense top-k router producing fixed-shape dispatch/combine masks.
+
+    forward(x[G, S, H]) -> (dispatch[G, S, E, C], combine[G, S, E, C],
+    aux_loss scalar, stats dict). Gate math runs in float32 regardless
+    of the model dtype (router logits are notoriously precision-
+    sensitive); dispatch/combine come back as float32 masks for the
+    caller to cast.
+
+    The aux loss is the Switch-Transformer load-balancing term
+    E * sum_e(f_e * P_e) over the top-1 assignment fraction f_e and the
+    mean router probability P_e — minimized (value 1.0) at uniform
+    load, differentiable through P_e only.
+    """
+
+    def __init__(self, d_model, num_experts, top_k=2,
+                 capacity_factor=1.25, init_std=0.02):
+        super().__init__()
+        validate_moe_config(num_experts, top_k, capacity_factor,
+                            op="TopKGate")
+        self.num_experts = int(num_experts)
+        self.top_k = int(top_k)
+        self.capacity_factor = float(capacity_factor)
+        self.weight = self.create_parameter(
+            [d_model, num_experts], dtype="float32",
+            default_initializer=Normal(0.0, init_std))
+
+    def forward(self, x):
+        G, S, _ = x.shape
+        E, K = self.num_experts, self.top_k
+        C = moe_capacity(S, E, K, self.capacity_factor)
+
+        logits = ops.einsum("gsh,he->gse", x.cast("float32"), self.weight)
+        probs = ops.softmax(logits, axis=-1)  # [G, S, E] fp32
+
+        # Iterative top-k: k argmax/one_hot rounds over progressively
+        # masked probabilities. k is a static Python int, so the loop
+        # unrolls into a fixed op sequence — nothing here depends on
+        # runtime routing decisions except the VALUES flowing through.
+        masked = probs
+        top_masks = []   # k x [G, S, E] one-hot (stop_gradient)
+        top_gates = []   # k x [G, S] gate prob of the chosen expert
+        for _k in range(K):
+            idx = ops.argmax(masked, axis=-1)           # [G, S] nondiff
+            mask = ops.one_hot(idx, E)                  # [G, S, E]
+            top_masks.append(mask)
+            top_gates.append((probs * mask).sum(axis=-1))
+            if _k + 1 < K:
+                masked = masked * (1.0 - mask)
+
+        # Capacity slots, k-major then position-major priority: a
+        # token's k=0 choice outranks every k=1 choice, and within one
+        # k earlier sequence positions win — deterministic drops.
+        base = None  # [G, 1, E] slots consumed by earlier k rounds
+        keeps = []   # k x [G, S, E] mask with over-capacity zeroed
+        positions = []  # k x [G, S, E] slot index (valid where kept)
+        for _k, mask in enumerate(top_masks):
+            pos = ops.cumsum(mask, axis=1) - mask       # [G, S, E]
+            if base is not None:
+                pos = pos + base
+            if _k + 1 < K:
+                # the last round's base update would be a DEAD node:
+                # the captured plan prunes it, then replay diverges on
+                # the op Python still dispatches — never build it
+                counts = mask.sum(axis=1, keepdim=True)
+                base = counts if base is None else base + counts
+            keep = mask * (pos < float(C)).cast("float32")
+            keeps.append(keep)
+            positions.append(pos)
+
+        # Combine weights: each kept assignment's router prob,
+        # normalized over the token's KEPT assignments (dropped ones
+        # contribute zero, so a token with every choice dropped passes
+        # zeros through — the residual connection carries it).
+        kept_tok = [(k_.sum(axis=-1)) for k_ in keeps]  # k x [G, S]
+        denom = kept_tok[0] * top_gates[0]
+        for g, kt in zip(top_gates[1:], kept_tok[1:]):
+            denom = denom + g * kt
+        # guard only the all-dropped tokens (their combine row is zero
+        # anyway): an unconditional +eps would scale EVERY weight and
+        # break exact dense parity in the degenerate configs
+        denom = denom + (denom < 1e-12).cast("float32")
+
+        dispatch = None
+        combine = None
+        for g, keep, pos in zip(top_gates, keeps, positions):
+            slot = ops.one_hot(
+                ops.clip(pos, min=0.0, max=float(C - 1)).cast("int32"),
+                C)                                      # [G, S, E, C]
+            d = keep.unsqueeze(-1) * slot
+            w = (g / denom).unsqueeze(-1).unsqueeze(-1)  # [G, S, 1, 1]
+            dispatch = d if dispatch is None else dispatch + d
+            combine = w * d if combine is None else combine + w * d
+
+        # Switch aux loss from the top-1 assignment (pre-drop: the
+        # router should balance INTENT, drops are the capacity's job).
+        f_e = top_masks[0].mean(axis=(0, 1))            # [E]
+        p_e = probs.mean(axis=(0, 1))                   # [E]
+        aux_loss = (f_e * p_e).sum() * float(E)
+
+        # Routing observability (fixed [E]-shaped tensors, derived from
+        # stop_gradient masks — free to compute every step, published
+        # by moe.metrics on audit steps only).
+        kept_total = dispatch.sum(axis=(0, 1, 3))       # [E] tokens kept
+        assigned = None
+        for m in top_masks:
+            s = m.sum(axis=(0, 1))
+            assigned = s if assigned is None else assigned + s
+        stats = {
+            "expert_tokens": kept_total,                # [E]
+            "expert_assigned": assigned,                # [E] pre-drop
+            "dropped": (assigned - kept_total).sum(),
+            "total": float(G * S * K),
+            "capacity": C,
+        }
+        return dispatch, combine, aux_loss, stats

@@ -1,0 +1,157 @@
+"""MoE expert-parallel FFN layer over dense dispatch/combine einsums.
+
+Expert banks are stored STACKED — w1 [E, H, F], w2 [E, F, H] — with
+``sharding_spec ("ep", None, None)``, so under an expert-parallel mesh
+each ep rank physically holds [E/ep] experts (the reference tree's
+`E_local` banks) while the Python program stays single-logical-device
+SPMD. The data path is three einsums:
+
+    dispatch   'gsec,gsh->egch'   gather each expert's C token slots
+    expert FFN 'egch,ehf->egcf'   bank matmul (per-expert weights)
+               'egcf,efh->egch'
+    combine    'gsec,egch->gsh'   scatter expert outputs back, scaled
+                                  by the gate weights
+
+With the batch sharded over ('dp','ep') and the banks over 'ep', the
+recorded sharding constraints around the expert compute make the E axis
+the partitioned one — GSPMD lowers the dispatch/combine resharding as
+the expert all-to-all on the device mesh. At ep=1 the constraints are
+skipped and the same program is a purely local MoE (dense parity when
+gating is forced uniform).
+"""
+from __future__ import annotations
+
+from ... import ops
+from ...ops import activation as F
+from ..initializer import Normal
+from ..layer.layers import Layer
+from .gate import TopKGate, validate_moe_config
+
+__all__ = ["MoEMLP"]
+
+
+def _mesh_axes():
+    """{axis: degree} of the active layout mesh (spmd mesh, else the
+    fleet hybrid mesh), or None outside any mesh."""
+    from ...distributed.meta_parallel import mp_ops
+
+    mesh = mp_ops._layout_mesh()
+    if mesh is None:
+        return None
+    return dict(zip(mesh.axis_names, (int(s) for s in
+                                      mesh.devices.shape)))
+
+
+def _ep_degree():
+    axes = _mesh_axes()
+    return int(axes.get("ep", 1)) if axes else 1
+
+
+def _batch_entry(axes, n):
+    """The mesh-axis entry shard_batch gave the batch dimension (so the
+    combine output's constraint matches the input layout exactly)."""
+    dp, ep = axes.get("dp", 1), axes.get("ep", 1)
+    if ep > 1 and dp > 1 and n % (dp * ep) == 0:
+        return ("dp", "ep")
+    if ep > 1 and dp <= 1 and n % ep == 0:
+        return "ep"
+    if dp > 1 and n % dp == 0:
+        return "dp"
+    return None
+
+
+class MoEMLP(Layer):
+    """Drop-in MLP replacement routing each token to top_k of
+    num_experts expert FFNs (same in/out shape as a dense MLP).
+
+    forward(x[B, T, H]) -> y[B, T, H]; the step's auxiliary
+    load-balancing loss lands on ``self.aux_loss`` (re-assigned every
+    forward — add ``aux_weight * layer.aux_loss`` to the training loss
+    INSIDE the same step) and the latest routing stats on
+    ``self.last_stats`` (lazy [E] tensors; see nn.moe.metrics).
+    """
+
+    def __init__(self, d_model, d_ff, num_experts, top_k=2,
+                 capacity_factor=1.25, dropout=0.0, init_std=0.02,
+                 out_init_std=None):
+        super().__init__()
+        validate_moe_config(num_experts, top_k, capacity_factor,
+                            ep=_ep_degree(), op="MoEMLP")
+        self.num_experts = int(num_experts)
+        self.gate = TopKGate(d_model, num_experts, top_k=top_k,
+                             capacity_factor=capacity_factor,
+                             init_std=init_std)
+        init = Normal(0.0, init_std)
+        out_init = Normal(0.0, out_init_std or init_std)
+        self.w1 = self.create_parameter([num_experts, d_model, d_ff],
+                                        default_initializer=init)
+        self.b1 = self.create_parameter([num_experts, d_ff],
+                                        is_bias=True)
+        self.w2 = self.create_parameter([num_experts, d_ff, d_model],
+                                        default_initializer=out_init)
+        self.b2 = self.create_parameter([num_experts, d_model],
+                                        is_bias=True)
+        from ...distributed.meta_parallel import mp_ops
+
+        for p in (self.w1, self.w2):
+            p.sharding_spec = ("ep", None, None)
+            mp_ops.shard_parameter(p)
+        for p in (self.b1, self.b2):
+            p.sharding_spec = ("ep", None)
+            mp_ops.shard_parameter(p)
+        self.dropout = None
+        if dropout:
+            from ..layer.common import Dropout
+
+            self.dropout = Dropout(dropout)
+        self.aux_loss = None
+        self.last_stats = None
+
+    def _constrain_expert(self, t, batch_entry):
+        """[E, G, C, *] intermediate: E over 'ep', G over what remains
+        of the batch layout once 'ep' moved to the expert axis."""
+        from jax.sharding import PartitionSpec as P
+
+        from ...distributed.meta_parallel import mp_ops
+
+        g_entry = "dp" if batch_entry in (("dp", "ep"), "dp") else None
+        spec = P(*(("ep", g_entry) + (None,) * (t.ndim - 2)))
+        t._data = mp_ops._constrain(t._data, spec)
+        return t
+
+    def forward(self, x):
+        G = x.shape[0]
+        axes = _mesh_axes()
+        ep_active = bool(axes) and axes.get("ep", 1) > 1
+        batch_entry = _batch_entry(axes, G) if ep_active else None
+
+        dispatch, combine, self.aux_loss, stats = self.gate(x)
+        self.last_stats = stats
+        dispatch = dispatch.cast(x.dtype)
+        combine = combine.cast(x.dtype)
+
+        # dispatch: every expert gathers its C slots from every group's
+        # tokens — under ep>1 the constraint reshards G:('dp','ep')→
+        # ('dp',) and E:(replicated)→('ep',), which IS the all-to-all
+        h = ops.einsum("gsec,gsh->egch", dispatch, x)
+        if ep_active:
+            h = self._constrain_expert(h, batch_entry)
+        h = ops.einsum("egch,ehf->egcf", h, self.w1) \
+            + self.b1.unsqueeze(1).unsqueeze(1)
+        h = F.gelu(h, approximate=True)
+        h = ops.einsum("egcf,efh->egch", h, self.w2) \
+            + self.b2.unsqueeze(1).unsqueeze(1)
+        if ep_active:
+            h = self._constrain_expert(h, batch_entry)
+
+        y = ops.einsum("gsec,egch->gsh", combine, h)
+        if ep_active:
+            from jax.sharding import PartitionSpec as P
+
+            from ...distributed.meta_parallel import mp_ops
+
+            y._data = mp_ops._constrain(
+                y._data, P(batch_entry, None, None))
+        if self.dropout is not None:
+            y = self.dropout(y)
+        return y

@@ -1,0 +1,105 @@
+"""Expert-load observability — routing stats into the metrics registry.
+
+The gate computes fixed-[E]-shape routing stats every forward (cheap
+sums over stop_gradient masks, part of the captured step's graph), but
+PUBLISHING them requires forcing values to the host — which must never
+happen inside the captured steady state (a mid-step force splits the
+executable). So publication is an explicit AUDIT-step call:
+
+    y = model(batch)           # eager or warmup step
+    moe.metrics.publish(model) # forces the [E] stats, fills the registry
+
+Registry layout (all mergeable across processes):
+
+    counters scope "moe":  expert_tokens.e<i>  kept tokens per expert
+                           tokens_assigned / tokens_kept / tokens_dropped
+    gauge:                 moe.drop_fraction   latest drop fraction
+    histogram scope "moe": expert_load_frac    log2 histogram of each
+                           expert's share of kept tokens per observation
+                           (uniform load piles into the 1/E bucket;
+                           a hot-expert collapse spreads mass toward 1)
+
+`fleet.stats()` and `tools/stats_dump.py` surface the "moe" scope as an
+"expert load" section.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...profiler import registry as _registry
+
+__all__ = ["publish", "collect", "snapshot"]
+
+
+def _moe_layers(model):
+    from .layer import MoEMLP
+
+    if isinstance(model, MoEMLP):
+        return [model]
+    out = []
+    for lyr in model.sublayers(include_self=False):
+        if isinstance(lyr, MoEMLP):
+            out.append(lyr)
+    return out
+
+
+def collect(model):
+    """Force and sum the latest routing stats across every MoEMLP in
+    `model`. Returns {expert_tokens [E], expert_assigned [E], dropped,
+    total, drop_fraction} as host numpy/floats, or None when the model
+    has no MoE layer that has run a forward yet."""
+    tokens = assigned = None
+    dropped = total = 0.0
+    seen = False
+    for lyr in _moe_layers(model):
+        st = lyr.last_stats
+        if st is None:
+            continue
+        seen = True
+        t = np.asarray(st["expert_tokens"].numpy(), dtype=np.float64)
+        a = np.asarray(st["expert_assigned"].numpy(), dtype=np.float64)
+        tokens = t if tokens is None else tokens + t
+        assigned = a if assigned is None else assigned + a
+        dropped += float(st["dropped"].numpy())
+        total += float(st["total"])
+    if not seen:
+        return None
+    return {
+        "expert_tokens": tokens,
+        "expert_assigned": assigned,
+        "dropped": dropped,
+        "total": total,
+        "drop_fraction": dropped / total if total else 0.0,
+    }
+
+
+def publish(model):
+    """collect() + write into the registry (audit steps only — forcing
+    the stats inside a captured steady window would split the
+    executable). Returns the collected dict (None when nothing ran)."""
+    snap = collect(model)
+    if snap is None:
+        return None
+    tokens = snap["expert_tokens"]
+    kept_sum = float(tokens.sum())
+    for i, n in enumerate(tokens):
+        _registry.inc(f"expert_tokens.e{i}", int(n), scope="moe")
+        if kept_sum > 0.0:
+            _registry.hist_record("expert_load_frac",
+                                  float(n) / kept_sum, scope="moe")
+    _registry.inc("tokens_assigned", int(snap["total"]), scope="moe")
+    _registry.inc("tokens_kept", int(kept_sum), scope="moe")
+    _registry.inc("tokens_dropped", int(snap["dropped"]), scope="moe")
+    _registry.gauge_set("moe.drop_fraction", snap["drop_fraction"])
+    return snap
+
+
+def snapshot():
+    """The registry's view of expert load: {"counters", "hists",
+    "drop_fraction"} — what fleet.stats() embeds as its "moe" section."""
+    counters = _registry.counters("moe")
+    hists = _registry.histograms("moe")
+    if not counters and not hists:
+        return None
+    return {"counters": counters, "hists": hists,
+            "drop_fraction": _registry.gauge("moe.drop_fraction", 0.0)}

@@ -613,7 +613,7 @@ class ServingFleet:
                 ent = links.setdefault(lk, {})
                 for k, v in lv.items():
                     ent[k] = ent.get(k, 0) + v
-        return {
+        out = {
             "pods": per_pod,
             "router": self.router.stats(),
             "hists": hists,
@@ -622,6 +622,15 @@ class ServingFleet:
             "prefix_hit_rate": hits / (hits + misses)
             if hits + misses else 0.0,
         }
+        # expert-load section (ISSUE 20 satellite): this process's MoE
+        # routing registry scope, when anything published into it —
+        # per-pod "moe.*" histograms already merged above ride `hists`
+        from ..nn.moe import metrics as _moe_metrics
+
+        moe = _moe_metrics.snapshot()
+        if moe is not None:
+            out["moe"] = moe
+        return out
 
     def pod_logs(self, tail=100, timeout=10.0):
         """Collect each pod's log tail OVER THE WIRE (``logs`` op) —
@@ -746,6 +755,14 @@ class ServingFleet:
             for t in threads:
                 t.join(timeout + 15.0)
         self._pod.terminate()
+        # clean teardown GCs the rendezvous records the pods published
+        # (ISSUE 20 satellite): endpoint docs + poll counters must not
+        # survive the fleet — the next job sharing this store would
+        # resolve dead addresses that PASS the generation check
+        from ..distributed.fleet.elastic import unpublish_endpoint
+
+        for h in self._handles:
+            unpublish_endpoint(self.store, str(h.idx))
         for h in self._handles:
             if h.client is not None:
                 h.client.close()

@@ -21,6 +21,10 @@ Checks:
     whose 'pp' axis has > 1 devices must carry at least one
     stage-sharded leaf — none means the trunk stacking silently
     replicated every stage's params (pp memory scaling lost);
+  * expert coverage (ISSUE 20): a mesh whose 'ep' axis has > 1 devices
+    must carry at least one expert-sharded ('ep' in spec) leaf across
+    its lowered plans — none means every expert bank is replicated on
+    every ep rank and the dispatch/combine all-to-all buys nothing;
   * serving KV replication (ISSUE 16): a serving engine dump
     (`engine.describe_sharding()`, detected by its "kv_pools" key) on
     an mp>1 mesh must head-shard each KV pool whose head count divides
@@ -87,6 +91,7 @@ def lint_plan(plan, axes, min_bytes=MIN_SHARDABLE_BYTES):
         if spec == "opaque":
             continue  # GSPMD-inferred layout: can't judge from the spec
         stage_sharded = _spec_has_axis(spec, "pp")
+        expert_sharded = _spec_has_axis(spec, "ep")
         saw_stage_sharded |= stage_sharded
         if leaf.get("slot_flagged") and axes and _is_replicated(spec) \
                 and leaf.get("bytes", 0) >= min_bytes \
@@ -104,6 +109,13 @@ def lint_plan(plan, axes, min_bytes=MIN_SHARDABLE_BYTES):
                     f"allocates a fresh copy of each stage's layer "
                     f"slice (check for a live Tensor holding the old "
                     f"stacked payload)")
+            elif expert_sharded:
+                problems.append(
+                    f"{tag}: expert-sharded (ep) bank/slot is "
+                    f"loop-carried but not donated — every step "
+                    f"allocates a fresh copy of each ep rank's "
+                    f"[E/ep] expert slice (check for a live Tensor "
+                    f"holding the old bank payload)")
             else:
                 problems.append(
                     f"{tag}: loop-carried optimizer slot is not donated "
@@ -123,9 +135,20 @@ def lint(desc, min_bytes=MIN_SHARDABLE_BYTES):
     """All problem strings for a describe_plans() dict."""
     axes = _mesh_axes(desc)
     problems = []
+    lowered = [p for p in desc.get("plans", ()) if p.get("spmd")]
     for i, plan in enumerate(desc.get("plans", ())):
         for p in lint_plan(plan, axes, min_bytes):
             problems.append(f"plan {i} ({plan.get('first_op', '?')}): {p}")
+    # expert coverage (ISSUE 20): checked across plans (unlike pp there
+    # is no marker op — any lowered plan may carry the expert banks)
+    if axes.get("ep", 0) > 1 and lowered and not any(
+            _spec_has_axis(leaf.get("spec"), "ep")
+            for plan in lowered for leaf in plan.get("leaves", ())):
+        problems.append(
+            f"mesh has ep={axes['ep']} but no lowered plan carries an "
+            f"expert-sharded ('ep') leaf — every expert bank is "
+            f"replicated on every ep rank (check the banks' "
+            f"('ep', ...) sharding_spec and num_experts % ep)")
     return problems
 
 

@@ -243,6 +243,39 @@ def _print_kernels(counters, gauges):
     _print_counters(kn)
 
 
+_MOE_PREFIXES = ("moe.",)
+
+
+def _print_moe(counters, gauges, hists):
+    """Expert-load health (ISSUE 20): per-expert kept-token counts, the
+    assigned/kept/dropped totals and the drop fraction say whether the
+    router is balanced and how much the capacity factor is costing; the
+    expert_load_frac histogram (each expert's share of kept tokens per
+    audit) piles into the 1/E bucket under uniform load and spreads
+    toward 1.0 when one expert goes hot."""
+    mo = {k: counters.pop(k) for k in list(counters)
+          if k.startswith(_MOE_PREFIXES)}
+    mo.update({k: gauges.pop(k) for k in list(gauges)
+               if k.startswith(_MOE_PREFIXES)})
+    mh = {k: hists.pop(k) for k in list(hists)
+          if k.startswith(_MOE_PREFIXES)}
+    if not mo and not mh:
+        return
+    print("expert load (moe routing):")
+    assigned = mo.get("moe.tokens_assigned", 0)
+    if assigned:
+        mo.setdefault("moe.drop_fraction",
+                      round(mo.get("moe.tokens_dropped", 0)
+                            / assigned, 4))
+    _print_counters(mo)
+    for k in sorted(mh):
+        h = mh[k]
+        # not a latency: mean_ms is the mean load fraction x 1e3 by
+        # construction of the shared log2 histogram — undo the scale
+        print(f"  {k}  count={h.get('count', 0)} "
+              f"mean_load={h.get('mean_ms', 0.0) / 1e3:.4f}")
+
+
 _KV_POOL_PREFIXES = ("serving.prefix_", "serving.kv_blocks")
 _KV_POOL_KEYS = frozenset(("serving.pool_exhausted",))
 
@@ -289,6 +322,7 @@ def _print_snapshot(snap):
     counters = dict(snap.get("counters") or {})
     timings = dict(snap.get("timings") or {})
     gauges = dict(snap.get("gauges") or {})
+    hists = dict(snap.get("hists") or {})
     # replay fast path (ISSUE 9) leads: if the hit rate is low or the
     # demotion causes are busy, every other per-step number below is
     # measuring the slow path
@@ -338,6 +372,10 @@ def _print_snapshot(snap):
     # the kv-pool/serving tables: acceptance rate and chunk counts are
     # the draft-verify subsystem's health line
     _print_spec(counters, gauges)
+    # expert load (ISSUE 20) claims its moe.* counters/gauges AND its
+    # moe.* histogram before the latency table: the load-fraction
+    # histogram is a distribution over shares, not a latency
+    _print_moe(counters, gauges, hists)
     # kv pool (ISSUE 10) claims its serving.* keys before the general
     # serving section so cache-memory health reads as one table
     _print_kv_pool(counters, gauges)
@@ -375,7 +413,7 @@ def _print_snapshot(snap):
     if timings:
         print("timings:")
         _print_timings(timings)
-    _print_hists(dict(snap.get("hists") or {}))
+    _print_hists(hists)
 
 
 def _dump_waterfall(doc):
